@@ -1,0 +1,75 @@
+"""PilottAI-TPU: a TPU-native hierarchical multi-agent LLM framework.
+
+Re-designed from scratch with the capability surface of PilottAI
+(reference: /root/reference, see SURVEY.md) but with the whole inference
+path in-tree: a JAX/XLA/Pallas LLM engine (``provider="tpu"``), a
+jit-batched on-device embedding encoder backing semantic memory, and a
+mesh-aware orchestration control plane.
+
+Top-level API (reference parity: ``pilott/__init__.py`` exports ``Serve``;
+here we export the full core surface as ``pilott/core/__init__.py:1-21``
+implies):
+
+    from pilottai_tpu import Serve, Task, AgentConfig, LLMConfig
+
+Heavy submodules (engine/models, which import jax) are loaded lazily so
+``import pilottai_tpu`` stays cheap for control-plane-only users.
+"""
+
+from pilottai_tpu.core.task import (
+    Task,
+    TaskPriority,
+    TaskResult,
+    TaskStatus,
+)
+from pilottai_tpu.core.status import AgentRole, AgentStatus
+from pilottai_tpu.core.config import (
+    AgentConfig,
+    FaultToleranceConfig,
+    LLMConfig,
+    LoadBalancerConfig,
+    LogConfig,
+    RouterConfig,
+    ScalingConfig,
+    ServeConfig,
+)
+
+__version__ = "0.1.0"
+
+# Lazy top-level exports; entries are added as the corresponding modules
+# land so the advertised API never points at missing modules.
+_LAZY = {
+    "Memory": ("pilottai_tpu.core.memory", "Memory"),
+}
+
+
+def __getattr__(name):
+    try:
+        module_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    module = importlib.import_module(module_name)
+    value = getattr(module, attr)
+    globals()[name] = value
+    return value
+
+
+__all__ = [
+    "Task",
+    "TaskPriority",
+    "TaskResult",
+    "TaskStatus",
+    "AgentRole",
+    "AgentStatus",
+    "AgentConfig",
+    "LLMConfig",
+    "LogConfig",
+    "ServeConfig",
+    "RouterConfig",
+    "LoadBalancerConfig",
+    "ScalingConfig",
+    "FaultToleranceConfig",
+    *_LAZY.keys(),
+]

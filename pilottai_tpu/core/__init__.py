@@ -1,0 +1,30 @@
+"""Core runtime: tasks, configs, agents, factory, router, short-term memory.
+
+Reference parity: ``pilott/core/__init__.py:1-21`` re-exports the same
+surface. Unlike the reference there is exactly ONE ``AgentConfig``
+(the reference ships two incompatible ones, SURVEY.md §2.12-c).
+"""
+
+from pilottai_tpu.core.task import Task, TaskPriority, TaskResult, TaskStatus
+from pilottai_tpu.core.status import AgentRole, AgentStatus
+from pilottai_tpu.core.config import (
+    AgentConfig,
+    LLMConfig,
+    LogConfig,
+    RouterConfig,
+    ServeConfig,
+)
+
+__all__ = [
+    "Task",
+    "TaskPriority",
+    "TaskResult",
+    "TaskStatus",
+    "AgentRole",
+    "AgentStatus",
+    "AgentConfig",
+    "LLMConfig",
+    "LogConfig",
+    "RouterConfig",
+    "ServeConfig",
+]

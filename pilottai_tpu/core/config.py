@@ -1,0 +1,280 @@
+"""Unified configuration layer — one pydantic model per subsystem.
+
+Reference parity: ``pilott/core/config.py`` (SecureConfig/LLMConfig/
+LogConfig/AgentConfig), ``pilott/pilott.py:17-27`` (ServeConfig),
+``pilott/core/router.py:15-20`` (RouterConfig),
+``pilott/orchestration/load_balancer.py:22-30`` (LoadBalancerConfig),
+``pilott/orchestration/orchestration.py:19-28`` (ScalingConfig),
+``pilott/orchestration/scaling.py:49-58`` (FaultToleranceConfig).
+
+The reference ships TWO incompatible ``AgentConfig`` classes
+(SURVEY.md §2.12-c); here there is exactly one, carrying the union of the
+fields actually read anywhere in the reference tree.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from pathlib import Path
+from typing import Any, ClassVar, Dict, List, Literal, Optional
+
+from pydantic import BaseModel, Field, SecretStr, field_validator
+
+from pilottai_tpu.core.status import AgentRole
+
+Provider = Literal["tpu", "cpu", "mock"]
+
+
+class SecureConfig:
+    """Symmetric encryption helper for sensitive config values.
+
+    Reference: ``pilott/core/config.py:10-39`` (Fernet). The cryptography
+    dependency is optional here; without it the helpers raise cleanly
+    instead of breaking import of the whole config layer.
+    """
+
+    def __init__(self, key: Optional[bytes] = None) -> None:
+        try:
+            from cryptography.fernet import Fernet
+        except ImportError as exc:  # pragma: no cover - env dependent
+            raise RuntimeError("cryptography is not installed") from exc
+        self._fernet = Fernet(key or Fernet.generate_key())
+
+    @staticmethod
+    def generate_key() -> bytes:
+        from cryptography.fernet import Fernet
+
+        return Fernet.generate_key()
+
+    def encrypt(self, value: str) -> str:
+        return self._fernet.encrypt(value.encode()).decode()
+
+    def decrypt(self, token: str) -> str:
+        return self._fernet.decrypt(token.encode()).decode()
+
+
+class SamplingConfig(BaseModel):
+    """Decode-time sampling parameters (engine surface, no reference analog —
+    the reference forwards temperature/max_tokens to remote APIs,
+    ``pilott/engine/llm.py:49``)."""
+
+    temperature: float = Field(default=0.7, ge=0.0)
+    top_k: int = Field(default=0, ge=0)  # 0 = disabled
+    top_p: float = Field(default=1.0, gt=0.0, le=1.0)
+    max_new_tokens: int = Field(default=256, ge=1)
+    seed: Optional[int] = None
+    json_mode: bool = False  # grammar-constrained JSON decoding
+
+
+class LLMConfig(BaseModel):
+    """LLM engine configuration (reference: ``pilott/core/config.py:41-77``).
+
+    ``provider`` selects an in-tree backend instead of a remote API:
+    ``"tpu"`` (JAX engine on TPU), ``"cpu"`` (same engine on host JAX),
+    ``"mock"`` (deterministic scripted backend for tests — the first-class
+    test fixture SURVEY.md §4 calls for).
+    """
+
+    model_name: str = "llama3-8b"
+    provider: Provider = "mock"
+    api_key: Optional[SecretStr] = None  # kept for config-file parity; unused by in-tree providers
+    checkpoint_path: Optional[str] = None
+    tokenizer_path: Optional[str] = None
+
+    sampling: SamplingConfig = Field(default_factory=SamplingConfig)
+    function_calling: bool = True
+
+    # Client-side throttling (reference: max_rpm limiter ``engine/llm.py:68-89``,
+    # Semaphore(5) concurrency cap ``engine/llm.py:36``).
+    max_rpm: Optional[int] = None
+    max_concurrent_requests: int = Field(default=64, ge=1)
+    retries: int = Field(default=3, ge=0)
+    retry_delay: float = Field(default=1.0, ge=0)
+    timeout: float = Field(default=120.0, gt=0)
+
+    # Engine placement
+    mesh_shape: Optional[Dict[str, int]] = None  # e.g. {"data": 1, "model": 8}
+    dtype: str = "bfloat16"
+
+
+class LogConfig(BaseModel):
+    """Logging configuration (reference: ``pilott/core/config.py:80-100``)."""
+
+    level: str = "INFO"
+    log_to_file: bool = False
+    log_dir: str = "logs"
+    json_format: bool = True
+    rotate_max_bytes: int = 10 * 1024 * 1024
+    rotate_backups: int = 5
+
+    @field_validator("level")
+    @classmethod
+    def _valid_level(cls, v: str) -> str:
+        allowed = {"DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"}
+        v = v.upper()
+        if v not in allowed:
+            raise ValueError(f"log level must be one of {sorted(allowed)}")
+        return v
+
+
+class AgentConfig(BaseModel):
+    """The single, unified agent configuration.
+
+    Union of the fields read anywhere in the reference: identity/prompting
+    (``core/config.py:103-125``), feature flags (``:127-134``), resource
+    limits (``:137-151``), plus the minimal class's fields
+    (``core/agent.py:19-29``).
+    """
+
+    role: str = "worker"
+    role_type: AgentRole = AgentRole.WORKER
+    goal: str = "complete assigned tasks accurately"
+    description: str = ""
+    backstory: str = ""
+
+    knowledge_sources: List[str] = Field(default_factory=list)
+    tools: List[str] = Field(default_factory=list)
+    required_capabilities: List[str] = Field(default_factory=list)
+    specializations: List[str] = Field(default_factory=list)
+
+    # Reasoning loop bounds (reference: max_iterations=20 ``core/config.py:128``)
+    max_iterations: int = Field(default=20, ge=1)
+    max_rpm: Optional[int] = None
+    retry_limit: int = Field(default=2, ge=0)
+    code_execution_mode: Literal["safe", "restricted", "unrestricted"] = "safe"
+
+    # Feature flags (reference ``core/config.py:130-134``)
+    memory_enabled: bool = True
+    delegation_enabled: bool = False
+    caching_enabled: bool = True
+    code_execution_enabled: bool = False
+    verbose: bool = False
+
+    # Resource limits (reference ``core/config.py:137-151``)
+    max_child_agents: int = Field(default=10, ge=0)
+    max_queue_size: int = Field(default=100, ge=1)
+    max_task_complexity: int = Field(default=5, ge=1, le=10)
+    delegation_threshold: float = Field(default=0.7, ge=0.0, le=1.0)
+    max_concurrent_tasks: int = Field(default=5, ge=1)
+    task_timeout: float = Field(default=300.0, gt=0)
+
+    llm: Optional[LLMConfig] = None
+    log: LogConfig = Field(default_factory=LogConfig)
+
+    # ---------------- persistence (reference ``core/config.py:198-249``) --- #
+
+    SENSITIVE_KEYS: ClassVar[tuple] = ("api_key", "secret", "password", "token")
+
+    def has_sensitive_data(self) -> bool:
+        def scan(obj: Any) -> bool:
+            if isinstance(obj, dict):
+                return any(
+                    any(s in str(k).lower() for s in self.SENSITIVE_KEYS) and v
+                    or scan(v)
+                    for k, v in obj.items()
+                )
+            if isinstance(obj, list):
+                return any(scan(x) for x in obj)
+            return False
+
+        return scan(self.model_dump())
+
+    def save(self, path: str | Path) -> None:
+        """Atomic JSON save with backup-and-restore semantics.
+
+        SecretStr fields are revealed on disk (pydantic would otherwise
+        serialize the mask ``**********`` and destroy the key on round-trip);
+        callers holding secrets should prefer env vars or ``SecureConfig``.
+        """
+        path = Path(path)
+        data = self.model_dump(mode="json")
+        if self.llm is not None and self.llm.api_key is not None:
+            data["llm"]["api_key"] = self.llm.api_key.get_secret_value()
+        backup = path.with_suffix(path.suffix + ".bak")
+        if path.exists():
+            shutil.copy2(path, backup)
+        tmp = path.with_suffix(path.suffix + f".tmp{os.getpid()}")
+        try:
+            tmp.write_text(json.dumps(data, indent=2))
+            tmp.replace(path)
+        except Exception:
+            if backup.exists():
+                shutil.copy2(backup, path)
+            raise
+        finally:
+            tmp.unlink(missing_ok=True)
+
+    @classmethod
+    def load(cls, path: str | Path) -> "AgentConfig":
+        return cls(**json.loads(Path(path).read_text()))
+
+
+class ServeConfig(BaseModel):
+    """Orchestrator configuration (reference: ``pilott/pilott.py:17-27``)."""
+
+    name: str = "pilott-tpu"
+    max_concurrent_tasks: int = Field(default=5, ge=1)
+    task_timeout: float = Field(default=300.0, gt=0)
+    max_queue_size: int = Field(default=1000, ge=1)
+    cleanup_interval: float = Field(default=3600.0, gt=0)
+    task_retention: float = Field(default=86400.0, gt=0)
+    max_retry_attempts: int = Field(default=3, ge=0)
+    decomposition_enabled: bool = True
+    evaluation_enabled: bool = True
+    # Integrated side services (the reference never wires these into
+    # Serve.start(), SURVEY.md §3.1 — here they are part of one lifecycle).
+    load_balancing_enabled: bool = False
+    dynamic_scaling_enabled: bool = False
+    fault_tolerance_enabled: bool = False
+
+
+class RouterConfig(BaseModel):
+    """Task router configuration (reference: ``pilott/core/router.py:15-20``)."""
+
+    load_check_interval: float = Field(default=5.0, gt=0)  # score cache TTL
+    load_threshold: float = Field(default=0.8, ge=0.0, le=1.0)
+    route_timeout: float = Field(default=30.0, gt=0)
+    route_attempts: int = Field(default=3, ge=1)
+    retry_backoff: float = Field(default=1.0, ge=0)
+
+
+class LoadBalancerConfig(BaseModel):
+    """Reference: ``pilott/orchestration/load_balancer.py:22-30``."""
+
+    check_interval: float = Field(default=30.0, gt=0)
+    overload_threshold: float = Field(default=0.8, ge=0.0, le=1.0)
+    underload_threshold: float = Field(default=0.2, ge=0.0, le=1.0)
+    max_tasks_per_cycle: int = Field(default=3, ge=1)
+    task_move_timeout: float = Field(default=30.0, gt=0)
+    trend_window: int = Field(default=5, ge=1)
+
+
+class ScalingConfig(BaseModel):
+    """Reference: ``pilott/orchestration/orchestration.py:19-28``."""
+
+    check_interval: float = Field(default=60.0, gt=0)
+    scale_up_threshold: float = Field(default=0.8, ge=0.0, le=1.0)
+    scale_down_threshold: float = Field(default=0.3, ge=0.0, le=1.0)
+    min_agents: int = Field(default=2, ge=0)
+    max_agents: int = Field(default=10, ge=1)
+    cooldown: float = Field(default=300.0, ge=0)
+    trend_window: int = Field(default=5, ge=1)
+
+
+class FaultToleranceConfig(BaseModel):
+    """Reference: ``pilott/orchestration/scaling.py:49-58``."""
+
+    check_interval: float = Field(default=30.0, gt=0)
+    heartbeat_timeout: float = Field(default=60.0, gt=0)
+    max_recovery_attempts: int = Field(default=3, ge=0)
+    recovery_cooldown: float = Field(default=300.0, ge=0)
+    resource_threshold: float = Field(default=0.9, ge=0.0, le=1.0)
+    stuck_task_timeout: float = Field(default=1800.0, gt=0)
+    error_threshold: int = Field(default=5, ge=1)
+
+
+def utcnow() -> float:
+    return time.time()

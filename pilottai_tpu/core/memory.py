@@ -1,0 +1,176 @@
+"""Short-term memory: a bounded, tag- and time-indexed record store.
+
+Reference parity: ``pilott/core/memory.py`` (133 LoC) — ``MemoryEntry``
+(:9-13), bounded deque store (:23), tag index + bisect timestamp index
+(:26-27,51), ``store``/``retrieve``/``retrieve_by_timerange`` (:34-88),
+bounded context/pattern dicts (:90-107). Used by Serve for task-execution
+records (``pilott/pilott.py:96,653-666``).
+
+Fix over the reference (SURVEY.md §2.12-h): the reference's ``tag_index``
+stores positional indices into a bounded deque, so indices drift after
+eviction. Here entries carry stable ids and indexes map tag → id set, with
+eviction removing ids from every index.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import bisect
+import itertools
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set
+
+
+@dataclass
+class MemoryEntry:
+    """One record (reference: ``core/memory.py:9-13``)."""
+
+    data: Any
+    tags: Set[str] = field(default_factory=set)
+    priority: int = 0
+    timestamp: float = field(default_factory=time.time)
+    entry_id: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "id": self.entry_id,
+            "data": self.data,
+            "tags": sorted(self.tags),
+            "priority": self.priority,
+            "timestamp": self.timestamp,
+        }
+
+
+class Memory:
+    """Bounded short-term memory with tag and time-range retrieval."""
+
+    def __init__(self, max_entries: int = 1000, max_context: int = 100, max_patterns: int = 50) -> None:
+        self.max_entries = max_entries
+        self._entries: "OrderedDict[int, MemoryEntry]" = OrderedDict()
+        self._tag_index: Dict[str, Set[int]] = {}
+        self._time_index: List[tuple] = []  # sorted [(timestamp, id)]
+        self._ids = itertools.count()
+        self._lock = asyncio.Lock()
+        # Bounded auxiliary stores (reference ``core/memory.py:90-107``).
+        self.context: "OrderedDict[str, Any]" = OrderedDict()
+        self.patterns: "OrderedDict[str, Any]" = OrderedDict()
+        self.max_context = max_context
+        self.max_patterns = max_patterns
+
+    # ------------------------------------------------------------------ #
+
+    async def store(
+        self,
+        data: Any,
+        tags: Optional[Set[str]] = None,
+        priority: int = 0,
+        timestamp: Optional[float] = None,
+    ) -> int:
+        """Store a record; returns its stable entry id."""
+        async with self._lock:
+            entry = MemoryEntry(
+                data=data,
+                tags=set(tags or ()),
+                priority=priority,
+                timestamp=timestamp if timestamp is not None else time.time(),
+                entry_id=next(self._ids),
+            )
+            self._entries[entry.entry_id] = entry
+            for tag in entry.tags:
+                self._tag_index.setdefault(tag, set()).add(entry.entry_id)
+            bisect.insort(self._time_index, (entry.timestamp, entry.entry_id))
+            while len(self._entries) > self.max_entries:
+                self._evict_oldest()
+            return entry.entry_id
+
+    def _evict_oldest(self) -> None:
+        old_id, old = self._entries.popitem(last=False)
+        for tag in old.tags:
+            ids = self._tag_index.get(tag)
+            if ids:
+                ids.discard(old_id)
+                if not ids:
+                    del self._tag_index[tag]
+        idx = bisect.bisect_left(self._time_index, (old.timestamp, old_id))
+        if idx < len(self._time_index) and self._time_index[idx] == (old.timestamp, old_id):
+            del self._time_index[idx]
+
+    # ------------------------------------------------------------------ #
+
+    async def retrieve(
+        self,
+        tags: Optional[Set[str]] = None,
+        min_priority: Optional[int] = None,
+        limit: int = 50,
+        predicate: Optional[Any] = None,
+    ) -> List[MemoryEntry]:
+        """Filter-match retrieval, newest first (reference ``:53-76``)."""
+        async with self._lock:
+            if tags:
+                id_sets = [self._tag_index.get(t, set()) for t in tags]
+                candidate_ids: Set[int] = set.intersection(*id_sets) if id_sets else set()
+                candidates = [self._entries[i] for i in candidate_ids if i in self._entries]
+            else:
+                candidates = list(self._entries.values())
+            if min_priority is not None:
+                candidates = [e for e in candidates if e.priority >= min_priority]
+            if predicate is not None:
+                candidates = [e for e in candidates if predicate(e)]
+            candidates.sort(key=lambda e: e.timestamp, reverse=True)
+            return candidates[:limit]
+
+    async def retrieve_by_timerange(self, start: float, end: float) -> List[MemoryEntry]:
+        """Binary-search range query (reference ``:78-88``)."""
+        async with self._lock:
+            lo = bisect.bisect_left(self._time_index, (start, -1))
+            hi = bisect.bisect_right(self._time_index, (end, float("inf")))
+            return [
+                self._entries[eid]
+                for _, eid in self._time_index[lo:hi]
+                if eid in self._entries
+            ]
+
+    # ------------------------------------------------------------------ #
+
+    def set_context(self, key: str, value: Any) -> None:
+        self.context[key] = value
+        self.context.move_to_end(key)
+        while len(self.context) > self.max_context:
+            self.context.popitem(last=False)
+
+    def set_pattern(self, key: str, value: Any) -> None:
+        self.patterns[key] = value
+        self.patterns.move_to_end(key)
+        while len(self.patterns) > self.max_patterns:
+            self.patterns.popitem(last=False)
+
+    async def cleanup(self, max_age: Optional[float] = None) -> int:
+        """Drop entries older than ``max_age`` seconds; returns count dropped."""
+        if max_age is None:
+            return 0
+        cutoff = time.time() - max_age
+        async with self._lock:
+            stale = [eid for eid, e in self._entries.items() if e.timestamp < cutoff]
+            for eid in stale:
+                entry = self._entries.pop(eid)
+                for tag in entry.tags:
+                    ids = self._tag_index.get(tag)
+                    if ids:
+                        ids.discard(eid)
+                        if not ids:
+                            del self._tag_index[tag]
+            self._time_index = [(t, i) for (t, i) in self._time_index if i in self._entries]
+            return len(stale)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get_metrics(self) -> Dict[str, Any]:
+        return {
+            "entries": len(self._entries),
+            "tags": len(self._tag_index),
+            "context_keys": len(self.context),
+            "patterns": len(self.patterns),
+        }

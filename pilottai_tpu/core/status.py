@@ -1,0 +1,62 @@
+"""Agent status and role enums.
+
+Reference parity: ``pilott/core/status.py`` / ``pilott/core/role.py``
+(AgentStatus used at ``pilott/core/agent.py:435-444``; AgentRole used by
+the factory and router).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class AgentStatus(str, enum.Enum):
+    """Lifecycle status of an agent."""
+
+    CREATED = "created"
+    STARTING = "starting"
+    IDLE = "idle"
+    BUSY = "busy"
+    PAUSED = "paused"
+    RECOVERING = "recovering"
+    STOPPING = "stopping"
+    STOPPED = "stopped"
+    ERROR = "error"
+
+    @property
+    def is_available(self) -> bool:
+        """Whether the agent can accept new tasks in this state."""
+        return self in (AgentStatus.IDLE, AgentStatus.BUSY)
+
+    @property
+    def is_terminal(self) -> bool:
+        return self in (AgentStatus.STOPPED, AgentStatus.ERROR)
+
+
+class HealthStatus(str, enum.Enum):
+    """4-level agent health classification used by fault tolerance.
+
+    Reference parity: ``pilott/orchestration/scaling.py:209-228``.
+    """
+
+    HEALTHY = "healthy"
+    DEGRADED = "degraded"
+    UNHEALTHY = "unhealthy"
+    CRITICAL = "critical"
+
+
+class AgentRole(str, enum.Enum):
+    """Role of an agent in the hierarchy."""
+
+    WORKER = "worker"
+    MANAGER = "manager"
+    ORCHESTRATOR = "orchestrator"
+    RESEARCHER = "researcher"
+    PROCESSOR = "processor"
+    EVALUATOR = "evaluator"
+    GENERATOR = "generator"
+    EXTRACTOR = "extractor"
+
+    @property
+    def is_manager(self) -> bool:
+        return self in (AgentRole.MANAGER, AgentRole.ORCHESTRATOR)

@@ -1,0 +1,342 @@
+"""Task model: the unit of work flowing through the framework.
+
+Reference parity: ``pilott/core/task.py`` (363 LoC) — 8-state ``TaskStatus``
+(:11-19), ``TaskPriority`` (:22-26), ``TaskResult`` (:29-66), pydantic
+``Task`` (:70-99) with circular-dependency detection (:120-136), lifecycle
+mutators (:247-279), ``to_prompt()`` (:352-363) and ``copy()`` for retry
+mutation (:306-311).
+
+Deliberate fixes over the reference (SURVEY.md §2.12-h):
+  * ``TaskPriority`` is an IntEnum so priority comparisons are numeric, not
+    lexicographic on strings (the reference compares string enums at
+    ``pilott/pilott.py:253-254``).
+  * ``subtasks``/``parent_task_id`` are declared fields (the reference
+    writes them undeclared at ``task.py:347-350``).
+  * ``required_skills`` is declared (read undeclared at ``task.py:359``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import time
+import uuid
+from contextlib import asynccontextmanager
+from typing import Any, Callable, Dict, List, Optional
+
+from pydantic import BaseModel, ConfigDict, Field, field_validator, model_validator
+
+
+class TaskStatus(str, enum.Enum):
+    """8-state task lifecycle (reference: ``pilott/core/task.py:11-19``)."""
+
+    PENDING = "pending"
+    QUEUED = "queued"
+    BLOCKED = "blocked"
+    IN_PROGRESS = "in_progress"
+    RETRYING = "retrying"
+    COMPLETED = "completed"
+    FAILED = "failed"
+    CANCELLED = "cancelled"
+
+    @property
+    def is_terminal(self) -> bool:
+        return self in (TaskStatus.COMPLETED, TaskStatus.FAILED, TaskStatus.CANCELLED)
+
+    @property
+    def is_active(self) -> bool:
+        return self in (TaskStatus.IN_PROGRESS, TaskStatus.RETRYING)
+
+
+class TaskPriority(enum.IntEnum):
+    """Numeric task priority — higher is more urgent.
+
+    IntEnum (not str) so ordering and queue eviction compare numerically;
+    the reference's string enum compares lexicographically
+    (``pilott/pilott.py:253-254``, flagged in SURVEY.md §2.12-h).
+    """
+
+    LOW = 0
+    NORMAL = 1
+    HIGH = 2
+    CRITICAL = 3
+
+    @classmethod
+    def coerce(cls, value: Any) -> "TaskPriority":
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, str):
+            try:
+                return cls[value.upper()]
+            except KeyError:
+                raise ValueError(
+                    f"unknown priority {value!r}; expected one of "
+                    f"{[m.name.lower() for m in cls]}"
+                ) from None
+        return cls(int(value))
+
+
+class TaskResult(BaseModel):
+    """Outcome of one task execution (reference: ``pilott/core/task.py:29-66``)."""
+
+    success: bool
+    output: Any = None
+    error: Optional[str] = None
+    execution_time: float = 0.0
+    metadata: Dict[str, Any] = Field(default_factory=dict)
+    completed_at: float = Field(default_factory=time.time)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return self.model_dump()
+
+
+class Task(BaseModel):
+    """A unit of work with lifecycle, dependencies, retries and deadlines.
+
+    Reference: ``pilott/core/task.py:70-99``. ``context`` holds parent/related
+    tasks for prompt rendering; ``dependencies`` are task ids that must be
+    COMPLETED before this task may run (enforced by the agent at validation
+    time, reference ``core/agent.py:231-246``).
+    """
+
+    model_config = ConfigDict(arbitrary_types_allowed=True, validate_assignment=True)
+
+    id: str = Field(default_factory=lambda: str(uuid.uuid4()))
+    type: str = "generic"
+    description: str
+    priority: TaskPriority = TaskPriority.NORMAL
+    status: TaskStatus = TaskStatus.PENDING
+
+    # Routing / execution hints
+    agent_id: Optional[str] = None
+    required_capabilities: List[str] = Field(default_factory=list)
+    required_skills: List[str] = Field(default_factory=list)
+    tools: List[str] = Field(default_factory=list)
+    complexity: int = Field(default=1, ge=1, le=10)
+
+    # Scheduling
+    max_retries: int = 3
+    retry_count: int = 0
+    timeout: float = Field(default=300.0, gt=0)
+    deadline: Optional[float] = None  # absolute unix timestamp
+
+    # Structure
+    dependencies: List[str] = Field(default_factory=list)
+    parent_task_id: Optional[str] = None
+    subtasks: List[str] = Field(default_factory=list)
+    context: Dict[str, Any] = Field(default_factory=dict)
+    payload: Dict[str, Any] = Field(default_factory=dict)
+
+    # Bookkeeping
+    created_at: float = Field(default_factory=time.time)
+    started_at: Optional[float] = None
+    completed_at: Optional[float] = None
+    result: Optional[TaskResult] = None
+    error_history: List[str] = Field(default_factory=list)
+    metadata: Dict[str, Any] = Field(default_factory=dict)
+
+    # ------------------------------------------------------------------ #
+    # Validation
+    # ------------------------------------------------------------------ #
+
+    @field_validator("priority", mode="before")
+    @classmethod
+    def _coerce_priority(cls, v: Any) -> TaskPriority:
+        return TaskPriority.coerce(v)
+
+    @model_validator(mode="after")
+    def _deadline_after_creation(self) -> "Task":
+        # Reference: deadline validator at ``core/task.py:216-221``. Compared
+        # against created_at (not wall-clock now) so model_dump() round-trips
+        # and clone_for_retry() of an already-expired task keep working.
+        if self.deadline is not None and self.deadline <= self.created_at:
+            raise ValueError("deadline must be after task creation time")
+        return self
+
+    @model_validator(mode="after")
+    def _no_self_dependency(self) -> "Task":
+        # Reference runs a circular-dependency check on construction
+        # (``core/task.py:120-136``); with id-based deps only direct
+        # self-reference is checkable here — graph cycles are checked by
+        # ``detect_cycle`` below against a task registry.
+        if self.id in self.dependencies:
+            raise ValueError(f"task {self.id} depends on itself")
+        return self
+
+    @staticmethod
+    def detect_cycle(tasks: Dict[str, "Task"]) -> Optional[List[str]]:
+        """Return a dependency cycle among ``tasks`` if one exists.
+
+        Iterative DFS with coloring over the dependency graph (replaces the
+        reference's construction-time recursive check, ``task.py:120-136``,
+        which could not see the full graph). Iterative so 1000+-deep chains
+        (ServeConfig.max_queue_size scale) don't hit the recursion limit.
+        """
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = {tid: WHITE for tid in tasks}
+
+        for root in tasks:
+            if color[root] != WHITE:
+                continue
+            path: List[str] = []
+            # Stack of (task_id, iterator over its deps)
+            stack = [(root, iter(tasks[root].dependencies))]
+            color[root] = GRAY
+            path.append(root)
+            while stack:
+                tid, deps = stack[-1]
+                advanced = False
+                for dep in deps:
+                    if dep not in tasks:
+                        continue
+                    if color[dep] == GRAY:
+                        return path[path.index(dep):] + [dep]
+                    if color[dep] == WHITE:
+                        color[dep] = GRAY
+                        path.append(dep)
+                        stack.append((dep, iter(tasks[dep].dependencies)))
+                        advanced = True
+                        break
+                if not advanced:
+                    color[tid] = BLACK
+                    path.pop()
+                    stack.pop()
+        return None
+
+    # ------------------------------------------------------------------ #
+    # Lifecycle mutators (reference: ``core/task.py:247-279,334-345``)
+    # ------------------------------------------------------------------ #
+
+    def mark_queued(self) -> None:
+        self.status = TaskStatus.QUEUED
+
+    def mark_started(self, agent_id: Optional[str] = None) -> None:
+        self.status = TaskStatus.IN_PROGRESS
+        self.started_at = time.time()
+        if agent_id is not None:
+            self.agent_id = agent_id
+
+    def mark_completed(self, result: TaskResult) -> None:
+        self.status = TaskStatus.COMPLETED
+        self.completed_at = time.time()
+        self.result = result
+
+    def mark_failed(self, error: str, result: Optional[TaskResult] = None) -> None:
+        self.status = TaskStatus.FAILED
+        self.completed_at = time.time()
+        self.error_history.append(error)
+        self.result = result or TaskResult(success=False, error=error)
+
+    def mark_cancelled(self) -> None:
+        self.status = TaskStatus.CANCELLED
+        self.completed_at = time.time()
+
+    def prepare_retry(self) -> bool:
+        """Transition to RETRYING if budget remains; returns whether allowed.
+
+        Reference: retry bookkeeping at ``core/task.py:268-279`` and the
+        orchestrator retry path ``pilott/pilott.py:538-551``.
+        """
+        if self.retry_count >= self.max_retries:
+            return False
+        self.retry_count += 1
+        self.status = TaskStatus.RETRYING
+        self.started_at = None
+        self.completed_at = None
+        self.result = None
+        return True
+
+    @property
+    def is_expired(self) -> bool:
+        if self.deadline is not None and time.time() > self.deadline:
+            return True
+        if (
+            self.started_at is not None
+            and self.status.is_active
+            and time.time() - self.started_at > self.timeout
+        ):
+            return True
+        return False
+
+    @property
+    def execution_time(self) -> Optional[float]:
+        if self.started_at is None:
+            return None
+        end = self.completed_at or time.time()
+        return end - self.started_at
+
+    # ------------------------------------------------------------------ #
+    # Prompt rendering (reference: ``core/task.py:352-363``)
+    # ------------------------------------------------------------------ #
+
+    def to_prompt(self) -> str:
+        """Render the task as context for an LLM prompt."""
+        lines = [
+            f"Task ID: {self.id}",
+            f"Type: {self.type}",
+            f"Description: {self.description}",
+            f"Priority: {self.priority.name}",
+        ]
+        if self.required_capabilities:
+            lines.append("Required capabilities: " + ", ".join(self.required_capabilities))
+        if self.required_skills:
+            lines.append("Required skills: " + ", ".join(self.required_skills))
+        if self.tools:
+            lines.append("Available tools: " + ", ".join(self.tools))
+        if self.payload:
+            lines.append(f"Payload: {self.payload}")
+        if self.context:
+            lines.append(f"Context: {self.context}")
+        return "\n".join(lines)
+
+    def clone_for_retry(self) -> "Task":
+        """A fresh copy for retry-with-mutation (reference ``task.py:306-311``)."""
+        data = self.model_dump()
+        data.update(
+            id=str(uuid.uuid4()),
+            status=TaskStatus.PENDING,
+            started_at=None,
+            completed_at=None,
+            result=None,
+            metadata={**self.metadata, "retry_of": self.id},
+        )
+        return Task(**data)
+
+
+class ResourceLockRegistry:
+    """Per-resource asyncio locks with a context-manager interface.
+
+    Reference: ``pilott/core/task.py:138-170`` attaches per-resource locks to
+    each Task; here they are a shared registry so two tasks touching the same
+    named resource actually serialize.
+    """
+
+    def __init__(self) -> None:
+        self._locks: Dict[str, asyncio.Lock] = {}
+
+    def get(self, resource: str) -> asyncio.Lock:
+        if resource not in self._locks:
+            self._locks[resource] = asyncio.Lock()
+        return self._locks[resource]
+
+    @asynccontextmanager
+    async def acquire(self, *resources: str):
+        """Acquire several resource locks in sorted order (deadlock-free).
+
+        The sorted-order discipline mirrors the reference's tool-lock
+        acquisition (``core/agent.py:181-185``).
+        """
+        ordered = sorted(set(resources))
+        acquired: List[asyncio.Lock] = []
+        try:
+            for name in ordered:
+                lock = self.get(name)
+                await lock.acquire()
+                acquired.append(lock)
+            yield
+        finally:
+            for lock in reversed(acquired):
+                lock.release()
+
+
+TaskCallback = Callable[[Task], Any]

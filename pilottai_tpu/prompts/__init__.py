@@ -1,0 +1,3 @@
+from pilottai_tpu.prompts.manager import PromptManager
+
+__all__ = ["PromptManager"]

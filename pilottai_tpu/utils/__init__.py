@@ -1,0 +1,14 @@
+from pilottai_tpu.utils.logging import LogContext, get_logger, setup_logging
+from pilottai_tpu.utils.metrics import MetricsRegistry, global_metrics
+from pilottai_tpu.utils.tracing import Span, Tracer, global_tracer
+
+__all__ = [
+    "get_logger",
+    "setup_logging",
+    "LogContext",
+    "MetricsRegistry",
+    "global_metrics",
+    "Span",
+    "Tracer",
+    "global_tracer",
+]

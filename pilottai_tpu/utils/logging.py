@@ -1,0 +1,152 @@
+"""One structured logging bus for the whole framework.
+
+Reference parity: ``pilott/utils/logger.py`` (JsonFormatter, rotating gzip
+handler, split error file, audit logger, LogContext) — which the reference's
+mainline code ignores, each class wiring its own StreamHandler instead
+(SURVEY.md §5.5). Here every component logs through ``get_logger`` so
+configuration is applied exactly once.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import logging
+import logging.handlers
+import os
+import shutil
+import time
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+from pilottai_tpu.core.config import LogConfig
+
+_ROOT_NAME = "pilottai_tpu"
+_configured = False
+
+
+class JsonFormatter(logging.Formatter):
+    """Structured JSON log lines with component/agent/task context fields.
+
+    Reference: ``pilott/utils/logger.py:34-64``.
+    """
+
+    def format(self, record: logging.LogRecord) -> str:
+        payload: Dict[str, Any] = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        for key in ("agent_id", "task_id", "span_id", "component"):
+            value = getattr(record, key, None)
+            if value is not None:
+                payload[key] = value
+        if record.exc_info:
+            payload["exc"] = self.formatException(record.exc_info)
+        return json.dumps(payload, default=str)
+
+
+class GzipRotatingFileHandler(logging.handlers.RotatingFileHandler):
+    """Size-rotating file handler that gzips rotated logs.
+
+    Reference: ``CustomRotatingFileHandler`` (``pilott/utils/logger.py:14-31``,
+    midnight rotation + gzip); size-based rotation is friendlier for
+    long-running TPU-VM jobs.
+    """
+
+    def rotation_filename(self, default_name: str) -> str:
+        return default_name + ".gz"
+
+    def rotate(self, source: str, dest: str) -> None:
+        with open(source, "rb") as sf, gzip.open(dest, "wb") as df:
+            shutil.copyfileobj(sf, df)
+        os.remove(source)
+
+
+def setup_logging(config: Optional[LogConfig] = None) -> None:
+    """Configure the framework root logger.
+
+    Calling with an explicit config always (re)builds handlers, even if a
+    ``get_logger`` call auto-configured defaults earlier — otherwise
+    ``log_to_file`` would be silently ignored after any import-time logging.
+    Calling with no config is idempotent.
+    """
+    global _configured
+    root = logging.getLogger(_ROOT_NAME)
+    if _configured and config is None:
+        return
+    config = config or LogConfig()
+    for handler in list(root.handlers):
+        root.removeHandler(handler)
+        handler.close()
+    root.setLevel(config.level)
+    root.propagate = False
+
+    console = logging.StreamHandler()
+    console.setFormatter(
+        JsonFormatter()
+        if config.json_format
+        else logging.Formatter("%(asctime)s %(levelname)s %(name)s %(message)s")
+    )
+    root.addHandler(console)
+
+    if config.log_to_file:
+        log_dir = Path(config.log_dir)
+        log_dir.mkdir(parents=True, exist_ok=True)
+        main = GzipRotatingFileHandler(
+            log_dir / "pilottai_tpu.log",
+            maxBytes=config.rotate_max_bytes,
+            backupCount=config.rotate_backups,
+        )
+        main.setFormatter(JsonFormatter())
+        root.addHandler(main)
+        # Split error file (reference ``utils/logger.py:119-129``).
+        errors = GzipRotatingFileHandler(
+            log_dir / "pilottai_tpu.error.log",
+            maxBytes=config.rotate_max_bytes,
+            backupCount=config.rotate_backups,
+        )
+        errors.setLevel(logging.ERROR)
+        errors.setFormatter(JsonFormatter())
+        root.addHandler(errors)
+    _configured = True
+
+
+def get_logger(component: str, **context: Any) -> logging.LoggerAdapter:
+    """Component logger carrying structured context (agent_id, task_id...)."""
+    if not _configured:
+        setup_logging()
+    logger = logging.getLogger(f"{_ROOT_NAME}.{component}")
+    return logging.LoggerAdapter(logger, {"component": component, **context})
+
+
+class LogContext:
+    """Temporarily switch the framework log level (reference
+    ``utils/logger.py:164-177``)."""
+
+    def __init__(self, level: str) -> None:
+        self._level = level.upper()
+        self._prev: Optional[int] = None
+
+    def __enter__(self) -> "LogContext":
+        root = logging.getLogger(_ROOT_NAME)
+        self._prev = root.level
+        root.setLevel(self._level)
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        if self._prev is not None:
+            logging.getLogger(_ROOT_NAME).setLevel(self._prev)
+
+
+def create_audit_logger(path: str | Path) -> logging.Logger:
+    """Append-only audit trail logger (reference ``utils/logger.py:192-207``)."""
+    logger = logging.getLogger(f"{_ROOT_NAME}.audit.{path}")
+    if not logger.handlers:
+        handler = logging.FileHandler(path)
+        handler.setFormatter(JsonFormatter())
+        logger.addHandler(handler)
+        logger.propagate = False
+        logger.setLevel(logging.INFO)
+    return logger

@@ -1,0 +1,124 @@
+"""In-process metrics bus: counters, gauges and latency histograms.
+
+The reference exposes pull-only ``get_metrics()`` dicts per component with
+no aggregation (SURVEY.md §5.5). Here one registry aggregates everything and
+is the source of the headline numbers (agent-steps/sec/chip, p50 step
+latency — BASELINE.json metric).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+
+class _Histogram:
+    """Bounded reservoir of observations with percentile queries."""
+
+    __slots__ = ("values", "count", "total", "max_samples")
+
+    def __init__(self, max_samples: int = 4096) -> None:
+        self.values: List[float] = []
+        self.count = 0
+        self.total = 0.0
+        self.max_samples = max_samples
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        if len(self.values) >= self.max_samples:
+            # Reservoir-style eviction keeping the list sorted: drop an
+            # element at a deterministic rotating index.
+            del self.values[self.count % self.max_samples]
+        bisect.insort(self.values, value)
+
+    def percentile(self, q: float) -> Optional[float]:
+        if not self.values:
+            return None
+        idx = min(len(self.values) - 1, int(q / 100.0 * len(self.values)))
+        return self.values[idx]
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "count": self.count,
+            "mean": self.total / self.count if self.count else None,
+            "p50": self.percentile(50),
+            "p90": self.percentile(90),
+            "p99": self.percentile(99),
+        }
+
+
+class MetricsRegistry:
+    """Thread-safe counters / gauges / histograms, labelled by name."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = defaultdict(float)
+        self._gauges: Dict[str, float] = {}
+        self._histograms: Dict[str, _Histogram] = {}
+        self._started = time.time()
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            if name not in self._histograms:
+                self._histograms[name] = _Histogram()
+            self._histograms[name].observe(value)
+
+    def timer(self, name: str) -> "_Timer":
+        return _Timer(self, name)
+
+    def rate(self, name: str) -> float:
+        """Counter value per second since registry start."""
+        with self._lock:
+            elapsed = max(time.time() - self._started, 1e-9)
+            return self._counters.get(name, 0.0) / elapsed
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            if name in self._counters:
+                return self._counters[name]
+            return self._gauges.get(name, 0.0)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "uptime_s": time.time() - self._started,
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: h.summary() for k, h in self._histograms.items()},
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+            self._started = time.time()
+
+
+class _Timer:
+    def __init__(self, registry: MetricsRegistry, name: str) -> None:
+        self._registry = registry
+        self._name = name
+        self._start = 0.0
+
+    def __enter__(self) -> "_Timer":
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self._registry.observe(self._name, time.perf_counter() - self._start)
+
+
+global_metrics = MetricsRegistry()

@@ -1,0 +1,109 @@
+"""Span tracing threaded through task → agent → engine, with jax.profiler
+integration on device-side spans.
+
+The reference has no tracing at all (SURVEY.md §5.1 — only ad-hoc
+``execution_time`` stamps). Here every task execution opens a span tree;
+device spans additionally emit ``jax.profiler.TraceAnnotation`` markers so
+steps line up with XLA traces in TensorBoard.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+
+@dataclass
+class Span:
+    name: str
+    span_id: str
+    parent_id: Optional[str]
+    trace_id: str
+    start: float = field(default_factory=time.perf_counter)
+    end: Optional[float] = None
+    attributes: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def duration(self) -> Optional[float]:
+        return None if self.end is None else self.end - self.start
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "trace_id": self.trace_id,
+            "duration": self.duration,
+            "attributes": self.attributes,
+        }
+
+
+class Tracer:
+    """Minimal in-process tracer.
+
+    Span stacks live in a ``contextvars.ContextVar`` (not threading.local):
+    interleaved asyncio tasks on one event loop each see their own stack, so
+    concurrent task executions (``ServeConfig.max_concurrent_tasks`` > 1)
+    get correct span parentage.
+    """
+
+    def __init__(self, max_finished: int = 10000) -> None:
+        self._stack_var: contextvars.ContextVar[tuple] = contextvars.ContextVar(
+            f"pilottai_span_stack_{id(self)}", default=()
+        )
+        self._finished: List[Span] = []
+        self._lock = threading.Lock()
+        self._max_finished = max_finished
+
+    def current(self) -> Optional[Span]:
+        stack = self._stack_var.get()
+        return stack[-1] if stack else None
+
+    @contextlib.contextmanager
+    def span(self, name: str, device: bool = False, **attributes: Any) -> Iterator[Span]:
+        parent = self.current()
+        span = Span(
+            name=name,
+            span_id=uuid.uuid4().hex[:16],
+            parent_id=parent.span_id if parent else None,
+            trace_id=parent.trace_id if parent else uuid.uuid4().hex[:16],
+            attributes=attributes,
+        )
+        token = self._stack_var.set(self._stack_var.get() + (span,))
+        annotation = contextlib.nullcontext()
+        if device:
+            try:
+                import jax.profiler
+
+                annotation = jax.profiler.TraceAnnotation(name)
+            except Exception:  # pragma: no cover - profiler optional
+                pass
+        try:
+            with annotation:
+                yield span
+        finally:
+            span.end = time.perf_counter()
+            self._stack_var.reset(token)
+            with self._lock:
+                self._finished.append(span)
+                if len(self._finished) > self._max_finished:
+                    del self._finished[: len(self._finished) // 2]
+
+    def finished(self, name: Optional[str] = None) -> List[Span]:
+        with self._lock:
+            spans = list(self._finished)
+        if name is not None:
+            spans = [s for s in spans if s.name == name]
+        return spans
+
+    def clear(self) -> None:
+        with self._lock:
+            self._finished.clear()
+
+
+global_tracer = Tracer()

@@ -1,0 +1,41 @@
+"""Test harness: force JAX onto a virtual 8-device CPU mesh.
+
+SURVEY.md §4: the build's test strategy is (1) deterministic mock-LLM
+fixtures, (2) a CPU-jax path so the whole stack runs in CI without TPUs,
+(3) multi-device simulation via ``xla_force_host_platform_device_count``.
+Environment variables must be set before jax is first imported, hence the
+module-level os.environ writes here.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
+import pytest  # noqa: E402
+
+# pytest-asyncio is not available in this image; provide a minimal strict-mode
+# equivalent: coroutine tests marked ``@pytest.mark.asyncio`` run under
+# ``asyncio.run`` on a fresh event loop per test.
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: run coroutine test on an event loop")
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    test_fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(test_fn):
+        sig_names = set(inspect.signature(test_fn).parameters)
+        kwargs = {k: v for k, v in pyfuncitem.funcargs.items() if k in sig_names}
+        asyncio.run(test_fn(**kwargs))
+        return True
+    return None

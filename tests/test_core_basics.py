@@ -1,0 +1,150 @@
+"""Tests for config, prompts, short-term memory, metrics and tracing."""
+
+import time
+
+import pytest
+
+from pilottai_tpu.core.config import (
+    AgentConfig,
+    LLMConfig,
+    LogConfig,
+    ServeConfig,
+)
+from pilottai_tpu.core.memory import Memory
+from pilottai_tpu.prompts.manager import PromptError, PromptManager
+from pilottai_tpu.utils.metrics import MetricsRegistry
+from pilottai_tpu.utils.tracing import Tracer
+
+
+# ---------------------------- config ---------------------------------- #
+
+def test_agent_config_roundtrip(tmp_path):
+    cfg = AgentConfig(role="researcher", goal="find things", max_iterations=7)
+    path = tmp_path / "agent.json"
+    cfg.save(path)
+    loaded = AgentConfig.load(path)
+    assert loaded.role == "researcher" and loaded.max_iterations == 7
+
+
+def test_agent_config_save_backup(tmp_path):
+    path = tmp_path / "agent.json"
+    AgentConfig(role="a").save(path)
+    AgentConfig(role="b").save(path)
+    assert AgentConfig.load(path).role == "b"
+    assert (tmp_path / "agent.json.bak").exists()
+
+
+def test_log_level_validated():
+    with pytest.raises(ValueError):
+        LogConfig(level="chatty")
+    assert LogConfig(level="debug").level == "DEBUG"
+
+
+def test_llm_config_defaults():
+    cfg = LLMConfig()
+    assert cfg.provider == "mock"
+    assert cfg.sampling.max_new_tokens >= 1
+
+
+def test_serve_config_defaults():
+    cfg = ServeConfig()
+    assert cfg.max_concurrent_tasks == 5
+    assert cfg.max_queue_size == 1000
+
+
+# ---------------------------- prompts --------------------------------- #
+
+def test_prompt_placeholders_and_format():
+    pm = PromptManager("agent")
+    out = pm.format_prompt("system.base", role="tester", goal="g", backstory="b")
+    assert "tester" in out
+    # JSON braces in templates must survive formatting
+    analysis = pm.format_prompt("task_analysis", task="T")
+    assert '"understanding"' in analysis and "{understanding}" not in analysis
+
+
+def test_prompt_missing_param_raises():
+    pm = PromptManager("agent")
+    with pytest.raises(PromptError):
+        pm.format_prompt("task_analysis")
+
+
+def test_orchestrator_namespace():
+    pm = PromptManager("orchestrator")
+    out = pm.format_prompt("task_decomposition", task="big job")
+    assert "subtasks" in out
+
+
+def test_unknown_prompt_raises():
+    pm = PromptManager("agent")
+    with pytest.raises(PromptError):
+        pm.format_prompt("nope")
+
+
+# ---------------------------- memory ---------------------------------- #
+
+@pytest.mark.asyncio
+async def test_memory_store_retrieve_by_tag():
+    mem = Memory(max_entries=10)
+    await mem.store({"a": 1}, tags={"x"})
+    await mem.store({"a": 2}, tags={"x", "y"})
+    await mem.store({"a": 3}, tags={"y"})
+    got = await mem.retrieve(tags={"x"})
+    assert {e.data["a"] for e in got} == {1, 2}
+    both = await mem.retrieve(tags={"x", "y"})
+    assert [e.data["a"] for e in both] == [2]
+
+
+@pytest.mark.asyncio
+async def test_memory_eviction_keeps_indexes_consistent():
+    # Reference bug: positional indices drift after deque eviction
+    # (SURVEY §2.12-h). Stable ids must survive eviction.
+    mem = Memory(max_entries=3)
+    for i in range(6):
+        await mem.store(i, tags={f"t{i % 2}"})
+    assert len(mem) == 3
+    got = await mem.retrieve(tags={"t1"})
+    assert all(isinstance(e.data, int) and e.data >= 3 for e in got)
+
+
+@pytest.mark.asyncio
+async def test_memory_timerange():
+    mem = Memory()
+    now = time.time()
+    await mem.store("old", timestamp=now - 100)
+    await mem.store("new", timestamp=now)
+    got = await mem.retrieve_by_timerange(now - 10, now + 10)
+    assert [e.data for e in got] == ["new"]
+
+
+@pytest.mark.asyncio
+async def test_memory_cleanup():
+    mem = Memory()
+    await mem.store("stale", timestamp=time.time() - 1000)
+    await mem.store("fresh")
+    dropped = await mem.cleanup(max_age=500)
+    assert dropped == 1 and len(mem) == 1
+
+
+# ---------------------------- metrics / tracing ------------------------ #
+
+def test_metrics_counters_and_percentiles():
+    m = MetricsRegistry()
+    for _ in range(10):
+        m.inc("steps")
+    for v in range(100):
+        m.observe("latency", v / 100)
+    snap = m.snapshot()
+    assert snap["counters"]["steps"] == 10
+    assert 0.4 < snap["histograms"]["latency"]["p50"] < 0.6
+
+
+def test_tracer_span_tree():
+    tr = Tracer()
+    with tr.span("parent") as p:
+        with tr.span("child") as c:
+            assert c.parent_id == p.span_id
+            assert c.trace_id == p.trace_id
+    spans = tr.finished()
+    assert {s.name for s in spans} == {"parent", "child"}
+    assert all(s.duration is not None for s in spans)

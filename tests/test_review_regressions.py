@@ -1,0 +1,86 @@
+"""Regression tests for issues found in code review of the core runtime."""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from pilottai_tpu.core.config import AgentConfig, LLMConfig
+from pilottai_tpu.core.task import Task
+from pilottai_tpu.prompts.manager import PromptManager
+from pilottai_tpu.utils.tracing import Tracer
+
+
+def test_clone_for_retry_after_deadline_passed():
+    # clone/round-trip must not re-reject a deadline that has since passed.
+    t = Task(description="x", deadline=time.time() + 0.05)
+    time.sleep(0.06)
+    clone = t.clone_for_retry()
+    assert clone.deadline == t.deadline
+    roundtrip = Task(**t.model_dump())
+    assert roundtrip.id == t.id
+
+
+def test_detect_cycle_deep_chain_no_recursion_error():
+    n = 3000
+    tasks = {
+        str(i): Task(id=str(i), description="x", dependencies=[str(i + 1)] if i + 1 < n else [])
+        for i in range(n)
+    }
+    assert Task.detect_cycle(tasks) is None
+    tasks[str(n - 1)].dependencies = ["0"]
+    assert Task.detect_cycle(tasks) is not None
+
+
+@pytest.mark.asyncio
+async def test_tracer_concurrent_asyncio_tasks_have_independent_stacks():
+    tr = Tracer()
+    parents = {}
+
+    async def work(name):
+        with tr.span(name) as outer:
+            await asyncio.sleep(0.01)
+            with tr.span(f"{name}.inner") as inner:
+                parents[name] = (inner.parent_id, outer.span_id)
+                await asyncio.sleep(0.01)
+
+    await asyncio.gather(work("a"), work("b"), work("c"))
+    for name, (parent_id, outer_id) in parents.items():
+        assert parent_id == outer_id, f"span parentage corrupted for {name}"
+
+
+def test_prompt_no_cross_kwarg_injection():
+    pm = PromptManager("agent")
+    out = pm.format_prompt(
+        "step_planning",
+        task="user asked about the {history} feature and {{braces}}",
+        history="SECRET-STEP-LOG",
+    )
+    # The literal {history} inside the task VALUE must survive untouched.
+    assert "user asked about the {history} feature and {{braces}}" in out
+    assert out.count("SECRET-STEP-LOG") == 1
+
+
+def test_agent_config_secret_roundtrip(tmp_path):
+    cfg = AgentConfig(role="r", llm=LLMConfig(api_key="sk-real-key"))
+    path = tmp_path / "cfg.json"
+    cfg.save(path)
+    on_disk = json.loads(path.read_text())
+    assert on_disk["llm"]["api_key"] == "sk-real-key"
+    loaded = AgentConfig.load(path)
+    assert loaded.llm.api_key.get_secret_value() == "sk-real-key"
+
+
+def test_setup_logging_explicit_config_wins_after_autoconfig(tmp_path):
+    import logging as stdlog
+
+    from pilottai_tpu.core.config import LogConfig
+    from pilottai_tpu.utils import logging as plog
+
+    plog.get_logger("early").info("auto-configures with defaults")
+    plog.setup_logging(LogConfig(log_to_file=True, log_dir=str(tmp_path)))
+    root = stdlog.getLogger("pilottai_tpu")
+    file_handlers = [h for h in root.handlers if isinstance(h, stdlog.FileHandler)]
+    assert file_handlers, "explicit setup_logging must attach file handlers"
+    plog.setup_logging(LogConfig())  # restore console-only for other tests

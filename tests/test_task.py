@@ -1,0 +1,98 @@
+"""Tests for the Task model lifecycle, priorities and dependency checks."""
+
+import time
+
+import pytest
+
+from pilottai_tpu.core.task import (
+    ResourceLockRegistry,
+    Task,
+    TaskPriority,
+    TaskResult,
+    TaskStatus,
+)
+
+
+def test_task_defaults():
+    t = Task(description="do a thing")
+    assert t.status == TaskStatus.PENDING
+    assert t.priority == TaskPriority.NORMAL
+    assert t.id and t.created_at > 0
+
+
+def test_priority_is_numeric():
+    # The reference compared string enums lexicographically (SURVEY §2.12-h);
+    # priorities here must order numerically.
+    assert TaskPriority.CRITICAL > TaskPriority.HIGH > TaskPriority.NORMAL > TaskPriority.LOW
+    assert TaskPriority.coerce("high") == TaskPriority.HIGH
+    assert TaskPriority.coerce(2) == TaskPriority.HIGH
+
+
+def test_lifecycle_transitions():
+    t = Task(description="x")
+    t.mark_queued()
+    assert t.status == TaskStatus.QUEUED
+    t.mark_started(agent_id="a1")
+    assert t.status == TaskStatus.IN_PROGRESS and t.agent_id == "a1"
+    t.mark_completed(TaskResult(success=True, output="ok"))
+    assert t.status == TaskStatus.COMPLETED
+    assert t.result.output == "ok"
+    assert t.execution_time is not None
+
+
+def test_retry_budget():
+    t = Task(description="x", max_retries=2)
+    t.mark_started()
+    t.mark_failed("boom")
+    assert t.prepare_retry() and t.retry_count == 1
+    assert t.prepare_retry() and t.retry_count == 2
+    assert not t.prepare_retry()
+
+
+def test_self_dependency_rejected():
+    with pytest.raises(ValueError):
+        Task(id="t1", description="x", dependencies=["t1"])
+
+
+def test_cycle_detection():
+    a = Task(id="a", description="a", dependencies=["b"])
+    b = Task(id="b", description="b", dependencies=["c"])
+    c = Task(id="c", description="c", dependencies=["a"])
+    cycle = Task.detect_cycle({"a": a, "b": b, "c": c})
+    assert cycle is not None
+    ok_c = Task(id="c", description="c")
+    assert Task.detect_cycle({"a": a, "b": b, "c": ok_c}) is None
+
+
+def test_deadline_must_be_future():
+    with pytest.raises(ValueError):
+        Task(description="x", deadline=time.time() - 10)
+
+
+def test_clone_for_retry():
+    t = Task(description="x", payload={"k": 1})
+    t.mark_started()
+    t.mark_failed("err")
+    clone = t.clone_for_retry()
+    assert clone.id != t.id
+    assert clone.status == TaskStatus.PENDING
+    assert clone.metadata["retry_of"] == t.id
+    assert clone.payload == {"k": 1}
+
+
+@pytest.mark.asyncio
+async def test_resource_locks_sorted_acquisition():
+    reg = ResourceLockRegistry()
+    order = []
+
+    async with reg.acquire("b", "a"):
+        order.append("outer")
+        assert reg.get("a").locked() and reg.get("b").locked()
+    assert not reg.get("a").locked() and not reg.get("b").locked()
+    assert order == ["outer"]
+
+
+def test_to_prompt_contains_fields():
+    t = Task(description="summarize doc", type="summarize", tools=["reader"])
+    prompt = t.to_prompt()
+    assert "summarize doc" in prompt and "reader" in prompt and t.id in prompt
