@@ -95,9 +95,12 @@ class LLMConfig(BaseModel):
     retry_delay: float = Field(default=1.0, ge=0)
     timeout: float = Field(default=120.0, gt=0)
 
-    # Engine placement
+    # Engine placement / serving shape
     mesh_shape: Optional[Dict[str, int]] = None  # e.g. {"data": 1, "model": 8}
     dtype: str = "bfloat16"
+    engine_slots: int = Field(default=8, ge=1)       # continuous-batching slots
+    engine_max_seq: Optional[int] = None             # KV length cap (default model max)
+    seed: int = 0                                    # param init seed when no checkpoint
 
 
 class LogConfig(BaseModel):

@@ -1,0 +1,34 @@
+"""LLM engine: the only inference path in the framework.
+
+Reference parity: ``pilott/engine/llm.py`` — but instead of delegating to
+remote HTTP APIs via litellm, providers here are in-tree:
+
+* ``"tpu"`` — JAX/XLA engine serving Llama/Gemma on TPU (continuous
+  batching over a device thread, pjit-sharded weights).
+* ``"cpu"`` — identical engine on host JAX devices (CI path).
+* ``"mock"`` — deterministic scripted backend speaking the framework's
+  structured-JSON prompt protocol (the first-class test fixture the
+  reference never had, SURVEY.md §4).
+"""
+
+from pilottai_tpu.engine.types import (
+    ChatMessage,
+    GenerationParams,
+    LLMResponse,
+    ToolCall,
+    ToolSpec,
+)
+from pilottai_tpu.engine.base import LLMBackend
+from pilottai_tpu.engine.handler import LLMHandler, create_backend, register_backend
+
+__all__ = [
+    "ChatMessage",
+    "GenerationParams",
+    "LLMResponse",
+    "ToolCall",
+    "ToolSpec",
+    "LLMBackend",
+    "LLMHandler",
+    "create_backend",
+    "register_backend",
+]

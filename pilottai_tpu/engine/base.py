@@ -1,0 +1,53 @@
+"""Backend protocol every provider implements.
+
+Reference parity: the surface of ``LLMHandler`` the rest of the reference
+calls — ``generate_response`` (``pilott/engine/llm.py:38``), ``apredict``
+(:181), ``apredict_messages`` (:201) — distilled to one async ``generate``
+primitive; the convenience forms live on the ``LLMHandler`` facade.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, List, Optional, Sequence
+
+from pilottai_tpu.engine.types import (
+    ChatMessage,
+    GenerationParams,
+    LLMResponse,
+    ToolSpec,
+)
+
+
+class LLMBackend(abc.ABC):
+    """An in-tree inference provider."""
+
+    name: str = "base"
+
+    @abc.abstractmethod
+    async def generate(
+        self,
+        messages: Sequence[ChatMessage],
+        tools: Optional[Sequence[ToolSpec]] = None,
+        params: Optional[GenerationParams] = None,
+    ) -> LLMResponse:
+        """Run one chat generation."""
+
+    async def start(self) -> None:  # noqa: B027 - optional lifecycle hook
+        """Bring up device resources (compile, load weights)."""
+
+    async def stop(self) -> None:  # noqa: B027 - optional lifecycle hook
+        """Release device resources."""
+
+    def get_metrics(self) -> Dict[str, Any]:
+        return {"backend": self.name}
+
+
+def render_chat(messages: Sequence[ChatMessage]) -> str:
+    """Canonical plain-text chat transcript used by providers without a
+    model-specific chat template."""
+    parts: List[str] = []
+    for m in messages:
+        parts.append(f"<|{m.role}|>\n{m.content}")
+    parts.append("<|assistant|>\n")
+    return "\n".join(parts)

@@ -1,0 +1,309 @@
+"""Continuous batcher: many concurrent small generations on one device loop.
+
+The workload shape (SURVEY.md §3.4): agent steps are bursty, short,
+JSON-bound generations — dozens in flight, each a few hundred tokens. The
+batcher multiplexes them onto fixed-shape device computations:
+
+* a dedicated *device thread* runs prefill/decode (never the asyncio loop —
+  the reference's blocking-psutil-in-async-loop bug, SURVEY §2.12-h, is the
+  cautionary tale);
+* requests admit into KV-cache *slots* between decode steps (continuous
+  batching: no head-of-line blocking on long generations);
+* prefills compile per power-of-two length bucket; decode compiles once.
+
+All shapes static → zero recompiles at steady state.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pilottai_tpu.engine.sampling import SamplingState, sample_tokens, update_slot
+from pilottai_tpu.models.common import ModelConfig
+from pilottai_tpu.models.transformer import forward_decode, forward_prefill
+from pilottai_tpu.ops.kvcache import KVCache, write_prompt
+from pilottai_tpu.utils.logging import get_logger
+from pilottai_tpu.utils.metrics import global_metrics
+
+
+@dataclass
+class GenRequest:
+    prompt_ids: List[int]
+    max_new_tokens: int = 256
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+    eos_id: int = -1
+    stop_ids: List[int] = field(default_factory=list)
+    future: Future = field(default_factory=Future)
+    submitted_at: float = field(default_factory=time.perf_counter)
+    # Set by the caller (any thread) to abandon the request; the device loop
+    # frees its slot at the next step instead of decoding dead work.
+    cancelled: bool = False
+
+
+@dataclass
+class _Slot:
+    request: GenRequest
+    generated: List[int] = field(default_factory=list)
+    prompt_len: int = 0
+    # (cancellation lives on the request: see GenRequest.cancelled)
+
+
+class ContinuousBatcher:
+    """Slot-based continuous batching over a jitted prefill/decode pair."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: Any,
+        n_slots: int = 8,
+        max_seq_len: Optional[int] = None,
+        min_bucket: int = 64,
+        cache_dtype=jnp.bfloat16,
+    ) -> None:
+        self.cfg = cfg
+        self.params = params
+        self.n_slots = n_slots
+        self.max_seq_len = min(max_seq_len or cfg.max_seq_len, cfg.max_seq_len)
+        self.min_bucket = min_bucket
+        self._log = get_logger("engine.batcher")
+
+        self.cache = KVCache.create(
+            cfg.n_layers, n_slots, self.max_seq_len, cfg.n_kv_heads, cfg.head_dim,
+            dtype=cache_dtype,
+        )
+        self.sampling = SamplingState.create(n_slots)
+        self._slots: List[Optional[_Slot]] = [None] * n_slots
+        self._pending: "queue.Queue[GenRequest]" = queue.Queue()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        self._insert = jax.jit(write_prompt, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------ #
+    # Lifecycle
+    # ------------------------------------------------------------------ #
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="pilottai-device-loop", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        # Fail any stranded requests.
+        while True:
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            if not req.future.done():
+                req.future.set_exception(RuntimeError("engine stopped"))
+        for slot in self._slots:
+            if slot and not slot.request.future.done():
+                slot.request.future.set_exception(RuntimeError("engine stopped"))
+
+    def warmup(self, prompt_len: int = 64) -> None:
+        """Compile the decode step and one prefill bucket up front."""
+        ids = list(range(2, 2 + prompt_len))
+        req = GenRequest(prompt_ids=ids, max_new_tokens=2)
+        self.submit(req)
+        req.future.result(timeout=600)
+
+    # ------------------------------------------------------------------ #
+    # Submission (any thread)
+    # ------------------------------------------------------------------ #
+
+    def submit(self, request: GenRequest) -> Future:
+        # Leave room for at least one generated token; clamp the keep window
+        # so it can never be <= 0 (a negative-zero slice would keep the whole
+        # oversized prompt and crash the prefill copy).
+        keep = self.max_seq_len - 1 - request.max_new_tokens
+        keep = min(max(keep, 1), self.max_seq_len - 2)
+        if len(request.prompt_ids) > keep:
+            request.prompt_ids = request.prompt_ids[-keep:]
+        self._pending.put(request)
+        self._wake.set()
+        return request.future
+
+    # ------------------------------------------------------------------ #
+    # Device loop (device thread only)
+    # ------------------------------------------------------------------ #
+
+    def _bucket(self, n: int) -> int:
+        b = self.min_bucket
+        while b < n:
+            b *= 2
+        return min(b, self.max_seq_len)
+
+    def _admit(self) -> None:
+        for idx in range(self.n_slots):
+            if self._slots[idx] is not None:
+                continue
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                return
+            if req.cancelled or req.future.cancelled():
+                continue
+            try:
+                self._prefill_into(idx, req)
+            except Exception as exc:  # noqa: BLE001 - fail this request only
+                self._log.error("prefill failed: %s", exc, exc_info=True)
+                self._slots[idx] = None
+                if not req.future.done():
+                    req.future.set_exception(exc)
+
+    def _prefill_into(self, idx: int, req: GenRequest) -> None:
+        ids = req.prompt_ids
+        T = self._bucket(len(ids))
+        tokens = np.zeros((1, T), np.int32)
+        tokens[0, : len(ids)] = ids
+        positions = np.arange(T, dtype=np.int32)[None]
+        with global_metrics.timer("engine.prefill_latency"):
+            logits, ks, vs = forward_prefill(
+                self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray([len(ids)], jnp.int32),
+            )
+        self.cache = self._insert(
+            self.cache, jnp.int32(idx), ks[:, 0], vs[:, 0], jnp.int32(len(ids))
+        )
+        self.sampling = update_slot(
+            self.sampling, idx, req.temperature, req.top_k, req.top_p, req.seed
+        )
+        # First generated token comes from the last prompt logit.
+        first = self._sample_one(np.asarray(logits[0, len(ids) - 1]), req)
+        slot = _Slot(request=req, prompt_len=len(ids))
+        slot.generated.append(first)
+        self._slots[idx] = slot
+        global_metrics.inc("engine.admitted")
+        if self._finished(slot):
+            self._complete(idx)
+
+    @staticmethod
+    def _sample_one(logits: np.ndarray, req: GenRequest) -> int:
+        """Host-side sampling for the first token (it comes straight out of
+        prefill); must honor the same temperature/top_k/top_p contract as
+        the device sampler used for all subsequent tokens."""
+        if req.temperature <= 0.0:
+            return int(np.argmax(logits))
+        rng = np.random.default_rng(req.seed)
+        scaled = logits.astype(np.float64) / max(req.temperature, 1e-6)
+        if req.top_k > 0:
+            kth = np.partition(scaled, -req.top_k)[-req.top_k]
+            scaled = np.where(scaled >= kth, scaled, -np.inf)
+        if req.top_p < 1.0:
+            order = np.argsort(scaled)[::-1]
+            probs_sorted = np.exp(scaled[order] - np.nanmax(scaled))
+            probs_sorted /= probs_sorted.sum()
+            cum = np.cumsum(probs_sorted)
+            keep_sorted = (cum - probs_sorted) < req.top_p  # exclusive mass
+            drop = order[~keep_sorted]
+            scaled[drop] = -np.inf
+        probs = np.exp(scaled - scaled.max())
+        probs /= probs.sum()
+        return int(rng.choice(len(probs), p=probs))
+
+    def _finished(self, slot: _Slot) -> bool:
+        req = slot.request
+        if req.cancelled or req.future.cancelled():
+            return True
+        last = slot.generated[-1]
+        if last == req.eos_id or last in req.stop_ids:
+            return True
+        if len(slot.generated) >= req.max_new_tokens:
+            return True
+        if slot.prompt_len + len(slot.generated) >= self.max_seq_len - 1:
+            return True
+        return False
+
+    def _complete(self, idx: int) -> None:
+        slot = self._slots[idx]
+        assert slot is not None
+        self._slots[idx] = None
+        self.cache = self.cache._replace(lengths=self.cache.lengths.at[idx].set(0))
+        req = slot.request
+        out = slot.generated
+        if out and (out[-1] == req.eos_id or out[-1] in req.stop_ids):
+            out = out[:-1]
+        latency = time.perf_counter() - req.submitted_at
+        global_metrics.observe("engine.request_e2e_latency", latency)
+        global_metrics.inc("engine.completed")
+        global_metrics.inc("engine.generated_tokens", len(out))
+        if not req.future.done():
+            req.future.set_result(out)
+
+    def _active_any(self) -> bool:
+        return any(s is not None for s in self._slots)
+
+    def _decode_step(self) -> None:
+        tokens = np.zeros((self.n_slots,), np.int32)
+        active = np.zeros((self.n_slots,), bool)
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                tokens[i] = slot.generated[-1]
+                active[i] = True
+        with global_metrics.timer("engine.decode_step_latency"):
+            logits, self.cache = forward_decode(
+                self.params, self.cfg, jnp.asarray(tokens), self.cache,
+                jnp.asarray(active),
+            )
+            next_tokens, self.sampling = sample_tokens(logits, self.sampling)
+            host_tokens = np.asarray(next_tokens)  # one small D2H per step
+        global_metrics.inc("engine.decode_steps")
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            slot.generated.append(int(host_tokens[i]))
+            if self._finished(slot):
+                self._complete(i)
+
+    def _run(self) -> None:
+        self._log.info("device loop starting (slots=%d, max_seq=%d)",
+                       self.n_slots, self.max_seq_len)
+        while not self._stop.is_set():
+            self._admit()
+            if not self._active_any():
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            try:
+                self._decode_step()
+            except Exception as exc:  # noqa: BLE001 - device loop boundary
+                self._log.error("decode step failed: %s", exc, exc_info=True)
+                for i, slot in enumerate(self._slots):
+                    if slot is not None and not slot.request.future.done():
+                        slot.request.future.set_exception(exc)
+                        self._slots[i] = None
+        self._log.info("device loop stopped")
+
+    # ------------------------------------------------------------------ #
+
+    def get_metrics(self) -> Dict[str, Any]:
+        return {
+            "slots_total": self.n_slots,
+            "slots_active": sum(s is not None for s in self._slots),
+            "pending": self._pending.qsize(),
+            "decode_steps": global_metrics.get("engine.decode_steps"),
+            "completed": global_metrics.get("engine.completed"),
+        }

@@ -1,0 +1,207 @@
+"""LLMHandler: the facade every agent and the orchestrator call.
+
+Reference parity: ``pilott/engine/llm.py`` — ``generate_response(messages,
+tools)`` (:38) with a sliding-window max_rpm limiter (:68-89), a concurrency
+semaphore (:36), retry-with-backoff (:57-66); plain-string ``apredict``
+(:181-199) used by the orchestrator's manager path; ``apredict_messages``
+with functions (:201-218). Providers are in-tree backends instead of
+litellm remote calls.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from pilottai_tpu.core.config import LLMConfig
+from pilottai_tpu.engine.base import LLMBackend
+from pilottai_tpu.engine.types import (
+    ChatMessage,
+    GenerationParams,
+    LLMResponse,
+    ToolSpec,
+)
+from pilottai_tpu.utils.logging import get_logger
+from pilottai_tpu.utils.metrics import global_metrics
+from pilottai_tpu.utils.tracing import global_tracer
+
+_BACKEND_REGISTRY: Dict[str, Callable[[LLMConfig], LLMBackend]] = {}
+
+
+def register_backend(name: str, factory: Callable[[LLMConfig], LLMBackend]) -> None:
+    """Register a provider factory under ``config.provider`` name."""
+    _BACKEND_REGISTRY[name] = factory
+
+
+def create_backend(config: LLMConfig) -> LLMBackend:
+    """Instantiate the backend for ``config.provider``.
+
+    ``mock`` is registered eagerly; ``tpu``/``cpu`` import the JAX engine
+    lazily so control-plane users never pay the jax import.
+    """
+    provider = config.provider
+    if provider not in _BACKEND_REGISTRY:
+        if provider in ("tpu", "cpu"):
+            from pilottai_tpu.engine.native import register_native_backends
+
+            register_native_backends()
+        else:
+            raise ValueError(f"unknown LLM provider {provider!r}")
+    return _BACKEND_REGISTRY[provider](config)
+
+
+def _register_mock(config: LLMConfig) -> LLMBackend:
+    from pilottai_tpu.engine.mock import MockBackend
+
+    return MockBackend(model_name=config.model_name)
+
+
+register_backend("mock", _register_mock)
+
+
+class RateLimiter:
+    """Sliding-window requests-per-minute limiter (reference
+    ``engine/llm.py:68-89``), lock-protected and non-blocking for peers."""
+
+    def __init__(self, max_rpm: int, window: float = 60.0) -> None:
+        self.max_rpm = max_rpm
+        self.window = window
+        self._stamps: deque = deque()
+        self._lock = asyncio.Lock()
+
+    async def acquire(self) -> None:
+        while True:
+            async with self._lock:
+                now = time.monotonic()
+                while self._stamps and now - self._stamps[0] > self.window:
+                    self._stamps.popleft()
+                if len(self._stamps) < self.max_rpm:
+                    self._stamps.append(now)
+                    return
+                wait = self.window - (now - self._stamps[0]) + 0.01
+            await asyncio.sleep(wait)
+
+
+class LLMHandler:
+    """Provider-agnostic inference facade with throttling and retries."""
+
+    def __init__(
+        self,
+        config: Optional[LLMConfig | Dict[str, Any]] = None,
+        backend: Optional[LLMBackend] = None,
+    ) -> None:
+        if isinstance(config, dict):
+            config = LLMConfig(**config)
+        self.config = config or LLMConfig()
+        self.backend = backend or create_backend(self.config)
+        self._semaphore = asyncio.Semaphore(self.config.max_concurrent_requests)
+        self._limiter = (
+            RateLimiter(self.config.max_rpm) if self.config.max_rpm else None
+        )
+        self._log = get_logger("engine.handler")
+        self._started = False
+
+    # ------------------------------------------------------------------ #
+
+    async def start(self) -> None:
+        if not self._started:
+            await self.backend.start()
+            self._started = True
+
+    async def stop(self) -> None:
+        if self._started:
+            await self.backend.stop()
+            self._started = False
+
+    # ------------------------------------------------------------------ #
+
+    async def generate_response(
+        self,
+        messages: Sequence[ChatMessage | Dict[str, Any] | str],
+        tools: Optional[Sequence[ToolSpec | Dict[str, Any]]] = None,
+        params: Optional[GenerationParams] = None,
+    ) -> LLMResponse:
+        """Chat completion with retry/backoff (reference ``llm.py:38-66``)."""
+        msgs = [ChatMessage.coerce(m) for m in messages]
+        specs = [
+            t if isinstance(t, ToolSpec) else ToolSpec(**t) for t in (tools or [])
+        ]
+        if params is None:
+            s = self.config.sampling
+            params = GenerationParams(
+                max_new_tokens=s.max_new_tokens,
+                temperature=s.temperature,
+                top_k=s.top_k,
+                top_p=s.top_p,
+                seed=s.seed,
+                json_mode=s.json_mode,
+            )
+
+        last_error: Optional[Exception] = None
+        for attempt in range(self.config.retries + 1):
+            try:
+                if self._limiter:
+                    await self._limiter.acquire()
+                async with self._semaphore:
+                    with global_tracer.span(
+                        "engine.generate", model=self.config.model_name
+                    ):
+                        start = time.perf_counter()
+                        response = await asyncio.wait_for(
+                            self.backend.generate(msgs, specs or None, params),
+                            timeout=self.config.timeout,
+                        )
+                latency = time.perf_counter() - start
+                global_metrics.observe("engine.request_latency", latency)
+                global_metrics.inc("engine.requests")
+                global_metrics.inc(
+                    "engine.prompt_tokens", response.usage.prompt_tokens
+                )
+                global_metrics.inc(
+                    "engine.completion_tokens", response.usage.completion_tokens
+                )
+                return response
+            except Exception as exc:  # noqa: BLE001 - retry boundary
+                last_error = exc
+                global_metrics.inc("engine.errors")
+                if attempt < self.config.retries:
+                    delay = self.config.retry_delay * (attempt + 1)
+                    self._log.warning(
+                        "generate attempt %d failed (%s); retrying in %.1fs",
+                        attempt + 1,
+                        exc,
+                        delay,
+                    )
+                    await asyncio.sleep(delay)
+        raise RuntimeError(
+            f"LLM generation failed after {self.config.retries + 1} attempts"
+        ) from last_error
+
+    async def apredict(self, prompt: str, **kwargs: Any) -> str:
+        """Plain string-in/string-out (reference ``llm.py:181-199``)."""
+        response = await self.generate_response(
+            [ChatMessage(role="user", content=prompt)], **kwargs
+        )
+        return response.content
+
+    async def apredict_messages(
+        self,
+        messages: Sequence[ChatMessage | Dict[str, Any]],
+        functions: Optional[Sequence[ToolSpec | Dict[str, Any]]] = None,
+        **kwargs: Any,
+    ) -> LLMResponse:
+        """Messages + function-calling form (reference ``llm.py:201-218``)."""
+        return await self.generate_response(messages, tools=functions, **kwargs)
+
+    # ------------------------------------------------------------------ #
+
+    def get_metrics(self) -> Dict[str, Any]:
+        return {
+            "model": self.config.model_name,
+            "provider": self.config.provider,
+            "backend": self.backend.get_metrics(),
+            "requests": global_metrics.get("engine.requests"),
+            "errors": global_metrics.get("engine.errors"),
+        }

@@ -1,0 +1,192 @@
+"""Deterministic mock backend speaking the framework's JSON prompt protocol.
+
+SURVEY.md §4: "the TPU build should make the fake LLM backend a first-class
+test fixture (a provider=\"mock\" engine — also BASELINE.json config #1)".
+The reference has no fake backend at all, which is why its agent reasoning
+loop is untested.
+
+The mock recognizes which rules.yaml template produced a prompt (by the JSON
+contract fields the template demands) and returns a well-formed response, so
+the full orchestrator → agent → engine loop runs without a model. Scripted
+overrides allow tests to force specific behaviors.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from pilottai_tpu.engine.base import LLMBackend
+from pilottai_tpu.engine.types import (
+    ChatMessage,
+    GenerationParams,
+    LLMResponse,
+    ToolCall,
+    ToolSpec,
+    Usage,
+)
+
+Responder = Callable[[str], Optional[Dict[str, Any]]]
+
+
+class MockBackend(LLMBackend):
+    """Protocol-aware deterministic backend.
+
+    Args:
+        script: optional list of raw response strings consumed in order
+            (takes precedence over protocol detection).
+        responders: optional list of callables ``prompt -> dict | None``
+            tried before the built-in protocol detection.
+        latency: artificial per-call latency in seconds (for scheduler and
+            load-balancer tests).
+        steps_to_complete: how many ``step_planning`` rounds an agent takes
+            before the mock declares ``task_complete``.
+        fail_pattern: prompts matching this regex raise RuntimeError (for
+            fault-tolerance tests).
+    """
+
+    name = "mock"
+
+    def __init__(
+        self,
+        script: Optional[List[str]] = None,
+        responders: Optional[List[Responder]] = None,
+        latency: float = 0.0,
+        steps_to_complete: int = 1,
+        fail_pattern: Optional[str] = None,
+        model_name: str = "mock-1",
+    ) -> None:
+        self._script = list(script or [])
+        self._responders = list(responders or [])
+        self.latency = latency
+        self.steps_to_complete = steps_to_complete
+        self._fail_re = re.compile(fail_pattern) if fail_pattern else None
+        self.model_name = model_name
+        self.calls: List[str] = []  # full prompt log for assertions
+        self._step_counts: Dict[str, int] = {}
+        self._lock = asyncio.Lock()
+
+    # ------------------------------------------------------------------ #
+
+    async def generate(
+        self,
+        messages: Sequence[ChatMessage],
+        tools: Optional[Sequence[ToolSpec]] = None,
+        params: Optional[GenerationParams] = None,
+    ) -> LLMResponse:
+        start = time.perf_counter()
+        prompt = "\n".join(m.content for m in messages)
+        async with self._lock:
+            self.calls.append(prompt)
+            if self._fail_re and self._fail_re.search(prompt):
+                raise RuntimeError(f"mock backend failure injected for: {self._fail_re.pattern}")
+            if self._script:
+                content = self._script.pop(0)
+            else:
+                payload = self._respond(prompt, tools)
+                content = json.dumps(payload) if isinstance(payload, dict) else str(payload)
+        if self.latency:
+            await asyncio.sleep(self.latency)
+        tool_calls = self._maybe_tool_calls(content)
+        return LLMResponse(
+            content=content,
+            tool_calls=tool_calls,
+            model=self.model_name,
+            usage=Usage(
+                prompt_tokens=len(prompt) // 4, completion_tokens=len(content) // 4
+            ),
+            latency=time.perf_counter() - start,
+        )
+
+    @staticmethod
+    def _maybe_tool_calls(content: str) -> List[ToolCall]:
+        try:
+            data = json.loads(content)
+        except (json.JSONDecodeError, TypeError):
+            return []
+        if isinstance(data, dict) and data.get("tool_call"):
+            tc = data["tool_call"]
+            return [ToolCall(id="tc-0", name=tc.get("name", ""), arguments=tc.get("arguments", {}))]
+        return []
+
+    # ------------------------------------------------------------------ #
+    # Protocol detection — keyed on the JSON contract fields each
+    # rules.yaml template demands (pilottai_tpu/prompts/rules.yaml).
+    # ------------------------------------------------------------------ #
+
+    def _respond(self, prompt: str, tools: Optional[Sequence[ToolSpec]]) -> Dict[str, Any]:
+        for responder in self._responders:
+            out = responder(prompt)
+            if out is not None:
+                return out
+
+        if '"requires_decomposition"' in prompt:
+            return {
+                "requires_decomposition": False,
+                "complexity": 2,
+                "estimated_resources": {"agents": 1, "llm_calls": 4},
+                "reasoning": "simple task",
+            }
+        if '"subtasks"' in prompt:
+            return {
+                "subtasks": [
+                    {"description": "extract the content", "type": "extract",
+                     "priority": "normal", "depends_on": []},
+                    {"description": "analyze the content", "type": "analyze",
+                     "priority": "normal", "depends_on": [0]},
+                    {"description": "summarize the findings", "type": "summarize",
+                     "priority": "normal", "depends_on": [1]},
+                ]
+            }
+        if '"selected_tools"' in prompt:
+            names = [t.name for t in tools] if tools else []
+            listed = re.findall(r"^\s*([a-zA-Z0-9_\-]+):", prompt, re.MULTILINE)
+            return {"selected_tools": names or listed[:1], "reasoning": "best fit"}
+        if '"task_complete"' in prompt:
+            key = self._task_key(prompt)
+            count = self._step_counts.get(key, 0) + 1
+            self._step_counts[key] = count
+            if count >= self.steps_to_complete:
+                return {
+                    "task_complete": True,
+                    "action": "respond",
+                    "arguments": {},
+                    "output": f"completed after {count} step(s)",
+                    "reasoning": "work finished",
+                }
+            return {
+                "task_complete": False,
+                "action": "respond",
+                "arguments": {},
+                "output": f"intermediate result {count}",
+                "reasoning": "more work needed",
+            }
+        if '"quality"' in prompt and '"requires_retry"' in prompt:
+            return {"quality": 0.9, "requires_retry": False, "feedback": ""}
+        if '"success"' in prompt and '"quality"' in prompt:
+            return {"success": True, "quality": 0.9, "issues": [], "suggestions": []}
+        if '"understanding"' in prompt:
+            return {
+                "understanding": "task understood",
+                "approach": "direct execution",
+                "estimated_steps": 1,
+                "risks": [],
+            }
+        if '"agent_id"' in prompt:
+            ids = re.findall(r"^\s*([a-zA-Z0-9\-]{4,}):", prompt, re.MULTILINE)
+            return {"agent_id": ids[0] if ids else "", "reasoning": "least loaded"}
+        if '"strategy"' in prompt:
+            return {"strategy": "parallel", "max_parallel": 4, "reasoning": "independent tasks"}
+        # Free-form generation fallback.
+        return {"output": f"mock response to: {prompt[-120:]}"}
+
+    @staticmethod
+    def _task_key(prompt: str) -> str:
+        m = re.search(r"Task ID: ([a-f0-9\-]+)", prompt)
+        return m.group(1) if m else "default"
+
+    def get_metrics(self) -> Dict[str, Any]:
+        return {"backend": self.name, "calls": len(self.calls)}

@@ -1,0 +1,194 @@
+"""The in-tree JAX engine backend: ``provider="tpu"`` / ``provider="cpu"``.
+
+This is the component that replaces the reference's remote-API path
+(``pilott/engine/llm.py:59`` → litellm → HTTPS): weights live on local
+devices, sharded over a ``jax.sharding.Mesh``; generations run through the
+continuous batcher's device thread; asyncio callers await futures bridged
+from that thread. Zero external API calls.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from pilottai_tpu.core.config import LLMConfig
+from pilottai_tpu.engine.base import LLMBackend, render_chat
+from pilottai_tpu.engine.batcher import ContinuousBatcher, GenRequest
+from pilottai_tpu.engine.tokenizer import ByteTokenizer, load_tokenizer
+from pilottai_tpu.engine.types import (
+    ChatMessage,
+    GenerationParams,
+    LLMResponse,
+    ToolSpec,
+    Usage,
+)
+from pilottai_tpu.models.common import init_params, param_logical_axes
+from pilottai_tpu.models.registry import get_model_config
+from pilottai_tpu.parallel.mesh import MeshConfig, best_mesh_config, create_mesh
+from pilottai_tpu.parallel.sharding import shard_params
+from pilottai_tpu.utils.logging import get_logger
+
+
+class NativeEngine(LLMBackend):
+    """JAX/XLA serving engine with continuous batching."""
+
+    def __init__(self, config: LLMConfig, platform: Optional[str] = None) -> None:
+        self.config = config
+        self.platform = platform  # None = default backend; "cpu" = host jax
+        self.name = platform or "tpu"
+        self._log = get_logger(f"engine.{self.name}")
+        self.batcher: Optional[ContinuousBatcher] = None
+        self.tokenizer = load_tokenizer(config.tokenizer_path)
+        self.model_cfg = get_model_config(config.model_name)
+        # No checkpoint + byte tokenizer → shrink the vocab to the byte
+        # tokenizer's so randomly-initialized serving is cheap and coherent.
+        if (
+            config.checkpoint_path is None
+            and isinstance(self.tokenizer, ByteTokenizer)
+            and self.model_cfg.vocab_size != self.tokenizer.vocab_size
+        ):
+            self.model_cfg = self.model_cfg.replace(
+                vocab_size=self.tokenizer.vocab_size, tie_embeddings=True
+            )
+        dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+        self.model_cfg = self.model_cfg.replace(dtype=dtype)
+        self.mesh = None
+        self._start_lock = asyncio.Lock()
+
+    # ------------------------------------------------------------------ #
+
+    async def start(self) -> None:
+        # Lock closes the check-then-act race: concurrent first generate()
+        # calls must not both run the multi-second init and leak a second
+        # device thread.
+        async with self._start_lock:
+            if self.batcher is not None:
+                return
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, self._start_blocking)
+
+    def _start_blocking(self) -> None:
+        t0 = time.perf_counter()
+        devices = (
+            jax.local_devices(backend="cpu") if self.platform == "cpu" else jax.devices()
+        )
+        mesh_cfg = (
+            MeshConfig.from_dict(self.config.mesh_shape)
+            if self.config.mesh_shape
+            else best_mesh_config(len(devices))
+        )
+        self.mesh = create_mesh(mesh_cfg, devices)
+        self._log.info(
+            "loading %s (%.2fB params) on mesh %s",
+            self.model_cfg.name,
+            self.model_cfg.param_count() / 1e9,
+            dict(mesh_cfg.shape),
+        )
+        if self.config.checkpoint_path:
+            from pilottai_tpu.models.loader import load_hf_checkpoint
+
+            params = load_hf_checkpoint(
+                self.model_cfg, self.config.checkpoint_path, mesh=self.mesh,
+                dtype=self.model_cfg.dtype,
+            )
+        else:
+            params = init_params(
+                self.model_cfg, jax.random.PRNGKey(self.config.seed)
+            )
+            params = shard_params(
+                params, param_logical_axes(self.model_cfg), self.mesh
+            )
+        max_seq = self.config.engine_max_seq or min(self.model_cfg.max_seq_len, 2048)
+        # Placement flows from the params' NamedShardings; jit propagates
+        # them through the cache and activations, no mesh context needed.
+        self.batcher = ContinuousBatcher(
+            self.model_cfg,
+            params,
+            n_slots=self.config.engine_slots,
+            max_seq_len=max_seq,
+            cache_dtype=self.model_cfg.dtype,
+        )
+        self.batcher.start()
+        self.batcher.warmup()
+        self._log.info("engine up in %.1fs", time.perf_counter() - t0)
+
+    async def stop(self) -> None:
+        if self.batcher is not None:
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, self.batcher.stop)
+            self.batcher = None
+
+    # ------------------------------------------------------------------ #
+
+    async def generate(
+        self,
+        messages: Sequence[ChatMessage],
+        tools: Optional[Sequence[ToolSpec]] = None,
+        params: Optional[GenerationParams] = None,
+    ) -> LLMResponse:
+        if self.batcher is None:
+            await self.start()
+        assert self.batcher is not None
+        params = params or GenerationParams()
+        start = time.perf_counter()
+
+        prompt = render_chat(messages)
+        if tools:
+            tool_desc = "\n".join(f"- {t.name}: {t.description}" for t in tools)
+            prompt = f"Available tools:\n{tool_desc}\n\n{prompt}"
+        prompt_ids = self.tokenizer.encode(prompt)
+
+        request = GenRequest(
+            prompt_ids=prompt_ids,
+            max_new_tokens=params.max_new_tokens,
+            temperature=params.temperature,
+            top_k=params.top_k,
+            top_p=params.top_p,
+            seed=params.seed if params.seed is not None else 0,
+            eos_id=self.tokenizer.eos_id,
+        )
+        future = self.batcher.submit(request)
+        try:
+            token_ids = await _to_asyncio_future(future)
+        except asyncio.CancelledError:
+            # Caller timed out / cancelled: tell the device loop to free the
+            # slot instead of decoding dead work to max_new_tokens.
+            request.cancelled = True
+            raise
+        text = self.tokenizer.decode(token_ids)
+        for stop in params.stop:
+            pos = text.find(stop)
+            if pos >= 0:
+                text = text[:pos]
+        return LLMResponse(
+            content=text,
+            model=self.model_cfg.name,
+            usage=Usage(
+                prompt_tokens=len(prompt_ids), completion_tokens=len(token_ids)
+            ),
+            latency=time.perf_counter() - start,
+            finish_reason="stop" if len(token_ids) < params.max_new_tokens else "length",
+        )
+
+    def get_metrics(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"backend": self.name, "model": self.model_cfg.name}
+        if self.batcher is not None:
+            out.update(self.batcher.get_metrics())
+        return out
+
+
+def _to_asyncio_future(fut) -> "asyncio.Future":
+    """Bridge a concurrent.futures.Future without blocking the loop."""
+    return asyncio.wrap_future(fut) if not isinstance(fut, asyncio.Future) else fut
+
+
+def register_native_backends() -> None:
+    from pilottai_tpu.engine.handler import register_backend
+
+    register_backend("tpu", lambda cfg: NativeEngine(cfg, platform=None))
+    register_backend("cpu", lambda cfg: NativeEngine(cfg, platform="cpu"))

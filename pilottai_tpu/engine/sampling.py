@@ -1,0 +1,101 @@
+"""Device-side token sampling: greedy, temperature, top-k, top-p.
+
+Runs inside the jitted decode step (no host round-trip per token).
+Per-slot temperature lets one batched decode serve requests with different
+sampling settings — agent workloads mix deterministic JSON steps
+(temperature 0) with creative generation in the same batch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SamplingState(NamedTuple):
+    """Per-slot sampling parameters living on device."""
+
+    temperature: jax.Array  # [B] fp32; 0 => greedy
+    top_k: jax.Array        # [B] int32; 0 => disabled
+    top_p: jax.Array        # [B] fp32; 1.0 => disabled
+    key: jax.Array          # [B, 2] uint32 per-slot PRNG keys
+
+    @classmethod
+    def create(cls, n_slots: int, seed: int = 0) -> "SamplingState":
+        keys = jax.random.split(jax.random.PRNGKey(seed), n_slots)
+        return cls(
+            temperature=jnp.zeros((n_slots,), jnp.float32),
+            top_k=jnp.zeros((n_slots,), jnp.int32),
+            top_p=jnp.ones((n_slots,), jnp.float32),
+            key=keys,
+        )
+
+
+def _mask_top_k(logits: jax.Array, k: jax.Array) -> jax.Array:
+    """Per-row top-k mask with traced k (0 disables). [B, V]."""
+    V = logits.shape[-1]
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]  # desc
+    idx = jnp.clip(k - 1, 0, V - 1)
+    kth = jnp.take_along_axis(sorted_logits, idx[:, None], axis=-1)
+    keep = (logits >= kth) | (k[:, None] <= 0)
+    return jnp.where(keep, logits, -jnp.inf)
+
+
+def _mask_top_p(logits: jax.Array, p: jax.Array) -> jax.Array:
+    """Nucleus mask with traced p (1.0 disables). [B, V]."""
+    sort_idx = jnp.argsort(logits, axis=-1)[:, ::-1]
+    sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Keep tokens whose cumulative mass (exclusive) is below p.
+    keep_sorted = (cum - probs) < p[:, None]
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(logits.shape[0])[:, None], sort_idx
+    ].set(keep_sorted)
+    return jnp.where(keep | (p[:, None] >= 1.0), logits, -jnp.inf)
+
+
+@partial(jax.jit, donate_argnames=("state",))
+def sample_tokens(
+    logits: jax.Array,  # [B, V] fp32
+    state: SamplingState,
+) -> tuple[jax.Array, SamplingState]:
+    """Sample one token per slot; greedy where temperature == 0."""
+    B = logits.shape[0]
+    greedy = jnp.argmax(logits, axis=-1)
+
+    temp = jnp.maximum(state.temperature, 1e-6)[:, None]
+    scaled = logits / temp
+    scaled = _mask_top_k(scaled, state.top_k)
+    scaled = _mask_top_p(scaled, state.top_p)
+
+    def sample_row(key, row):
+        return jax.random.categorical(key, row)
+
+    new_keys = jax.vmap(lambda k: jax.random.split(k, 2))(state.key)
+    step_keys, carry_keys = new_keys[:, 0], new_keys[:, 1]
+    sampled = jax.vmap(sample_row)(step_keys, scaled)
+
+    tokens = jnp.where(state.temperature <= 0.0, greedy, sampled)
+    del B
+    return tokens.astype(jnp.int32), state._replace(key=carry_keys)
+
+
+def update_slot(
+    state: SamplingState,
+    slot: int | jax.Array,
+    temperature: float,
+    top_k: int,
+    top_p: float,
+    seed: int,
+) -> SamplingState:
+    """Host-side admission: install one request's sampling params."""
+    return SamplingState(
+        temperature=state.temperature.at[slot].set(temperature),
+        top_k=state.top_k.at[slot].set(top_k),
+        top_p=state.top_p.at[slot].set(top_p),
+        key=state.key.at[slot].set(jax.random.PRNGKey(seed)[None][0]),
+    )

@@ -1,0 +1,90 @@
+"""Tokenizers for the in-tree engine.
+
+Two implementations:
+
+* ``ByteTokenizer`` — dependency-free byte-level tokenizer (tokens 0-255 are
+  raw bytes, specials above). Default for tests, randomly-initialized
+  models and the benchmark; needs no downloaded vocab files (this image has
+  zero network egress).
+* ``HFTokenizer`` — wraps a *locally available* Hugging Face tokenizer for
+  real checkpoints (gated on files existing; never downloads).
+
+The reference has no tokenizer at all (tokenization happened inside remote
+APIs, ``pilott/engine/llm.py``); this is new TPU-native surface.
+"""
+
+from __future__ import annotations
+
+import abc
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+
+class Tokenizer(abc.ABC):
+    pad_id: int
+    bos_id: int
+    eos_id: int
+    vocab_size: int
+
+    @abc.abstractmethod
+    def encode(self, text: str, add_bos: bool = True) -> List[int]: ...
+
+    @abc.abstractmethod
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+
+class ByteTokenizer(Tokenizer):
+    """Byte-level tokenizer: ids 0..255 are raw bytes; specials follow.
+
+    vocab_size is padded to a multiple of 128 (lane width) so the embedding
+    and logits matmuls tile cleanly onto the MXU.
+    """
+
+    BYTE_VOCAB = 256
+
+    def __init__(self, n_extra_specials: int = 0) -> None:
+        self.pad_id = self.BYTE_VOCAB + 0
+        self.bos_id = self.BYTE_VOCAB + 1
+        self.eos_id = self.BYTE_VOCAB + 2
+        base = self.BYTE_VOCAB + 3 + n_extra_specials
+        self.vocab_size = ((base + 127) // 128) * 128
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = list(text.encode("utf-8", errors="replace"))
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < self.BYTE_VOCAB)
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer(Tokenizer):
+    """Local Hugging Face tokenizer wrapper (no downloads)."""
+
+    def __init__(self, path: str | Path) -> None:
+        path = Path(path)
+        if not path.exists():
+            raise FileNotFoundError(
+                f"tokenizer path {path} does not exist (no network egress; "
+                "tokenizer files must be local)"
+            )
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(str(path), local_files_only=True)
+        self.vocab_size = len(self._tok)
+        self.pad_id = self._tok.pad_token_id or 0
+        self.bos_id = self._tok.bos_token_id or 1
+        self.eos_id = self._tok.eos_token_id or 2
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = self._tok.encode(text, add_special_tokens=False)
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+
+def load_tokenizer(path: Optional[str] = None) -> Tokenizer:
+    if path:
+        return HFTokenizer(path)
+    return ByteTokenizer()

@@ -1,0 +1,92 @@
+"""Engine wire types: messages, tool specs, generation parameters, responses.
+
+Reference parity: the dict shapes flowing through
+``pilott/engine/llm.py:91-120`` (OpenAI-style messages/tools in, normalized
+{content, role, tool_calls, model, usage} out) — typed here instead of
+ad-hoc dicts.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Literal, Optional
+
+from pydantic import BaseModel, Field
+
+Role = Literal["system", "user", "assistant", "tool"]
+
+
+class ChatMessage(BaseModel):
+    role: Role = "user"
+    content: str = ""
+    name: Optional[str] = None
+    tool_call_id: Optional[str] = None
+
+    @classmethod
+    def coerce(cls, value: Any) -> "ChatMessage":
+        if isinstance(value, ChatMessage):
+            return value
+        if isinstance(value, dict):
+            return cls(**value)
+        return cls(role="user", content=str(value))
+
+
+class ToolSpec(BaseModel):
+    """Function-calling tool description (reference ``llm.py:91-104``)."""
+
+    name: str
+    description: str = ""
+    parameters: Dict[str, Any] = Field(default_factory=dict)  # JSON schema
+
+    def to_openai(self) -> Dict[str, Any]:
+        return {
+            "type": "function",
+            "function": {
+                "name": self.name,
+                "description": self.description,
+                "parameters": self.parameters or {"type": "object", "properties": {}},
+            },
+        }
+
+
+class ToolCall(BaseModel):
+    id: str = ""
+    name: str
+    arguments: Dict[str, Any] = Field(default_factory=dict)
+
+
+class GenerationParams(BaseModel):
+    """Per-request decode parameters (overrides the engine defaults)."""
+
+    max_new_tokens: int = 256
+    temperature: float = 0.7
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: Optional[int] = None
+    stop: List[str] = Field(default_factory=list)
+    json_mode: bool = False
+
+
+class Usage(BaseModel):
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+
+    @property
+    def total_tokens(self) -> int:
+        return self.prompt_tokens + self.completion_tokens
+
+
+class LLMResponse(BaseModel):
+    """Normalized engine response (reference ``llm.py:106-120``)."""
+
+    content: str = ""
+    role: Role = "assistant"
+    tool_calls: List[ToolCall] = Field(default_factory=list)
+    model: str = ""
+    usage: Usage = Field(default_factory=Usage)
+    finish_reason: str = "stop"
+    latency: float = 0.0
+    created_at: float = Field(default_factory=time.time)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return self.model_dump()

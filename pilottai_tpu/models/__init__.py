@@ -1,0 +1,22 @@
+"""Model zoo: Llama-3 and Gemma families in pure-functional JAX.
+
+New TPU-native surface (the reference delegates all inference to remote
+APIs, ``pilott/engine/llm.py:59``). Params are plain pytrees with stacked
+layers (``lax.scan`` over depth → O(1) compile in layer count); sharding is
+declared once via logical axes (``pilottai_tpu/parallel/sharding.py``).
+"""
+
+from pilottai_tpu.models.common import ModelConfig, init_params, param_logical_axes
+from pilottai_tpu.models.registry import get_model_config, list_models, register_model
+from pilottai_tpu.models.transformer import forward_decode, forward_prefill
+
+__all__ = [
+    "ModelConfig",
+    "init_params",
+    "param_logical_axes",
+    "forward_prefill",
+    "forward_decode",
+    "get_model_config",
+    "list_models",
+    "register_model",
+]

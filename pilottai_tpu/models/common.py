@@ -1,0 +1,193 @@
+"""Model configuration, parameter init and sharding declarations.
+
+One ``ModelConfig`` covers the Llama and Gemma families; family-specific
+behaviors (activation, embed scaling, RMSNorm offset, logit soft-caps,
+alternating sliding windows, post-norms) are explicit fields rather than
+subclasses, so the single ``transformer.py`` forward stays scan-friendly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str = "tiny-test"
+    family: str = "llama"  # "llama" | "gemma" | "gemma2"
+    vocab_size: int = 512
+    hidden_size: int = 256
+    n_layers: int = 4
+    n_heads: int = 4
+    n_kv_heads: int = 2
+    head_dim: int = 64
+    intermediate_size: int = 512
+    max_seq_len: int = 2048
+    rope_theta: float = 500_000.0
+    rms_eps: float = 1e-5
+    tie_embeddings: bool = True
+
+    # Family behaviors
+    act: str = "silu"              # "silu" (llama) | "gelu_tanh" (gemma)
+    scale_embed: bool = False      # gemma: x *= sqrt(hidden)
+    rms_offset: bool = False       # gemma: scale = (1 + w)
+    post_norms: bool = False       # gemma2: post-attn / post-mlp norms
+    logit_softcap: float = 0.0     # gemma2: 30.0
+    attn_softcap: float = 0.0      # gemma2: 50.0
+    sliding_window: int = 0        # gemma2: 4096 on alternating layers
+    sliding_pattern: int = 0       # every Nth layer is global (gemma2: 2)
+    query_scale: Optional[float] = None  # default head_dim**-0.5
+
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+    def window_sizes(self) -> np.ndarray:
+        """Per-layer sliding-window sizes; 0 = global attention."""
+        if self.sliding_window <= 0 or self.sliding_pattern <= 0:
+            return np.zeros((self.n_layers,), dtype=np.int32)
+        out = np.full((self.n_layers,), self.sliding_window, dtype=np.int32)
+        out[self.sliding_pattern - 1 :: self.sliding_pattern] = 0
+        return out
+
+    def replace(self, **kwargs: Any) -> "ModelConfig":
+        return dataclasses.replace(self, **kwargs)
+
+    def param_count(self) -> int:
+        E, F, V, L = self.hidden_size, self.intermediate_size, self.vocab_size, self.n_layers
+        per_layer = (
+            E * self.q_dim + 2 * E * self.kv_dim + self.q_dim * E  # attn
+            + 2 * E * F + F * E                                     # mlp
+            + 2 * E + (2 * E if self.post_norms else 0)             # norms
+        )
+        head = 0 if self.tie_embeddings else E * V
+        return V * E + L * per_layer + E + head
+
+
+def init_params(
+    cfg: ModelConfig, key: jax.Array, dtype: Optional[Any] = None
+) -> Dict[str, Any]:
+    """Random-init a parameter pytree with stacked layers.
+
+    Layer params carry a leading [L] axis so the forward pass can
+    ``lax.scan`` over depth — compile time stays O(1) in n_layers, which
+    matters on TPU where the first jit is the slow step.
+    """
+    dtype = dtype or cfg.dtype
+    E, F, V, L = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.n_layers
+    keys = jax.random.split(key, 8)
+
+    def normal(k, shape, fan_in):
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * fan_in**-0.5).astype(dtype)
+
+    layers: Dict[str, Any] = {
+        "ln1": {"scale": jnp.zeros((L, E), dtype) if cfg.rms_offset else jnp.ones((L, E), dtype)},
+        "ln2": {"scale": jnp.zeros((L, E), dtype) if cfg.rms_offset else jnp.ones((L, E), dtype)},
+        "attn": {
+            "wq": normal(keys[0], (L, E, cfg.q_dim), E),
+            "wk": normal(keys[1], (L, E, cfg.kv_dim), E),
+            "wv": normal(keys[2], (L, E, cfg.kv_dim), E),
+            "wo": normal(keys[3], (L, cfg.q_dim, E), cfg.q_dim),
+        },
+        "mlp": {
+            "wg": normal(keys[4], (L, E, F), E),
+            "wu": normal(keys[5], (L, E, F), E),
+            "wd": normal(keys[6], (L, F, E), F),
+        },
+    }
+    if cfg.post_norms:
+        zero_or_one = jnp.zeros if cfg.rms_offset else jnp.ones
+        layers["ln1_post"] = {"scale": zero_or_one((L, E), dtype)}
+        layers["ln2_post"] = {"scale": zero_or_one((L, E), dtype)}
+
+    params: Dict[str, Any] = {
+        "embed": normal(keys[7], (V, E), 1.0),
+        "layers": layers,
+        "final_norm": {
+            "scale": jnp.zeros((E,), dtype) if cfg.rms_offset else jnp.ones((E,), dtype)
+        },
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = normal(jax.random.fold_in(keys[7], 1), (E, V), E)
+    return params
+
+
+def param_logical_axes(cfg: ModelConfig) -> Dict[str, Any]:
+    """Parallel pytree of logical-axis tuples for ``shard_params``.
+
+    Layer leaves have a leading "layers" axis (never sharded). TP shards
+    heads/mlp/vocab over the ``model`` mesh axis; FSDP shards the embed
+    axis; see ``parallel/sharding.DEFAULT_RULES``.
+    """
+    layers: Dict[str, Any] = {
+        "ln1": {"scale": ("layers", None)},
+        "ln2": {"scale": ("layers", None)},
+        "attn": {
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+        },
+        "mlp": {
+            "wg": ("layers", "embed", "mlp"),
+            "wu": ("layers", "embed", "mlp"),
+            "wd": ("layers", "mlp", "embed"),
+        },
+    }
+    if cfg.post_norms:
+        layers["ln1_post"] = {"scale": ("layers", None)}
+        layers["ln2_post"] = {"scale": ("layers", None)}
+    axes: Dict[str, Any] = {
+        "embed": ("vocab", "embed"),
+        "layers": layers,
+        "final_norm": {"scale": (None,)},
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float, offset: bool) -> jax.Array:
+    """RMSNorm in fp32 statistics (Gemma adds 1 to the learned scale)."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    normed = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    s = scale.astype(jnp.float32)
+    if offset:
+        s = s + 1.0
+    return (normed * s).astype(dtype)
+
+
+def rope_tables(
+    positions: jax.Array, head_dim: int, theta: float
+) -> Tuple[jax.Array, jax.Array]:
+    """sin/cos tables for rotate-half RoPE. positions [B, T] →
+    sin/cos [B, T, head_dim/2] in fp32."""
+    half = head_dim // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [B, T, half]
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
+    """Rotate-half RoPE: x [B, T, N, H], sin/cos [B, T, H/2]."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    sin = sin[:, :, None, :].astype(jnp.float32)
+    cos = cos[:, :, None, :].astype(jnp.float32)
+    x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out = jnp.concatenate(
+        [x1f * cos - x2f * sin, x2f * cos + x1f * sin], axis=-1
+    )
+    return out.astype(x.dtype)

@@ -1,0 +1,61 @@
+"""Llama-3 family configurations.
+
+Architectures per the public Llama-3 papers/configs: SwiGLU MLP, GQA,
+RoPE theta 500k, RMSNorm, untied lm_head on 8B+. The ``*-byte`` variants
+pair the architecture with the in-tree byte tokenizer (512-vocab) for
+checkpoint-free serving and benchmarking — same compute graph per token,
+so steps/sec numbers transfer.
+"""
+
+from pilottai_tpu.models.common import ModelConfig
+
+LLAMA3_8B = ModelConfig(
+    name="llama3-8b",
+    family="llama",
+    vocab_size=128_256,
+    hidden_size=4096,
+    n_layers=32,
+    n_heads=32,
+    n_kv_heads=8,
+    head_dim=128,
+    intermediate_size=14336,
+    max_seq_len=8192,
+    rope_theta=500_000.0,
+    rms_eps=1e-5,
+    tie_embeddings=False,
+)
+
+LLAMA3_1B = ModelConfig(
+    name="llama3-1b",
+    family="llama",
+    vocab_size=128_256,
+    hidden_size=2048,
+    n_layers=16,
+    n_heads=32,
+    n_kv_heads=8,
+    head_dim=64,
+    intermediate_size=8192,
+    max_seq_len=8192,
+    rope_theta=500_000.0,
+    rms_eps=1e-5,
+    tie_embeddings=True,
+)
+
+# Byte-vocab variants: identical trunk, 512-token byte vocab — runnable with
+# random init (no checkpoint, no downloads) for benches and smoke tests.
+LLAMA3_8B_BYTE = LLAMA3_8B.replace(name="llama3-8b-byte", vocab_size=512, tie_embeddings=True)
+LLAMA3_1B_BYTE = LLAMA3_1B.replace(name="llama3-1b-byte", vocab_size=512)
+
+# Small configs for tests / CI (CPU-jax).
+LLAMA_TINY = ModelConfig(
+    name="llama-tiny",
+    family="llama",
+    vocab_size=512,
+    hidden_size=128,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    head_dim=32,
+    intermediate_size=256,
+    max_seq_len=512,
+)

@@ -1,0 +1,142 @@
+"""Checkpoint loading: HF safetensors → stacked-layer pytree, plus
+orbax-style native save/restore.
+
+Weights are loaded layer-by-layer on host then device_put with their
+sharding (so an 8B model never needs 2x host RAM), and stacked along the
+leading layer axis to match the scan layout. No downloads — paths must be
+local (zero-egress environment).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pilottai_tpu.models.common import ModelConfig, param_logical_axes
+from pilottai_tpu.parallel.sharding import named_sharding
+
+# HF parameter name templates per family (same for llama/gemma trunks).
+_HF_LAYER_MAP = {
+    ("ln1", "scale"): "model.layers.{i}.input_layernorm.weight",
+    ("ln2", "scale"): "model.layers.{i}.post_attention_layernorm.weight",
+    ("ln1_post", "scale"): "model.layers.{i}.post_attention_layernorm.weight",  # gemma2 naming handled below
+    ("ln2_post", "scale"): "model.layers.{i}.post_feedforward_layernorm.weight",
+    ("attn", "wq"): "model.layers.{i}.self_attn.q_proj.weight",
+    ("attn", "wk"): "model.layers.{i}.self_attn.k_proj.weight",
+    ("attn", "wv"): "model.layers.{i}.self_attn.v_proj.weight",
+    ("attn", "wo"): "model.layers.{i}.self_attn.o_proj.weight",
+    ("mlp", "wg"): "model.layers.{i}.mlp.gate_proj.weight",
+    ("mlp", "wu"): "model.layers.{i}.mlp.up_proj.weight",
+    ("mlp", "wd"): "model.layers.{i}.mlp.down_proj.weight",
+}
+
+_GEMMA2_OVERRIDES = {
+    ("ln1_post", "scale"): "model.layers.{i}.post_attention_layernorm.weight",
+    ("ln2", "scale"): "model.layers.{i}.pre_feedforward_layernorm.weight",
+    ("ln2_post", "scale"): "model.layers.{i}.post_feedforward_layernorm.weight",
+}
+
+
+def _open_safetensors(path: Path):
+    """Index all *.safetensors shards under ``path`` → {tensor_name: (file, reader)}."""
+    from safetensors import safe_open  # ships with transformers
+
+    index: Dict[str, Path] = {}
+    index_file = path / "model.safetensors.index.json"
+    if index_file.exists():
+        weight_map = json.loads(index_file.read_text())["weight_map"]
+        for name, fname in weight_map.items():
+            index[name] = path / fname
+    else:
+        for f in sorted(path.glob("*.safetensors")):
+            with safe_open(str(f), framework="np") as reader:
+                for name in reader.keys():
+                    index[name] = f
+    return index
+
+
+def load_hf_checkpoint(
+    cfg: ModelConfig,
+    path: str | Path,
+    mesh: Optional[Any] = None,
+    dtype=jnp.bfloat16,
+) -> Dict[str, Any]:
+    """Load a HF-layout safetensors checkpoint into the stacked pytree.
+
+    HF linear weights are [out, in]; ours are [in, out] → transpose.
+    """
+    from safetensors import safe_open
+
+    path = Path(path)
+    index = _open_safetensors(path)
+    axes = param_logical_axes(cfg)
+
+    _readers: Dict[Path, Any] = {}
+
+    def read(name: str) -> np.ndarray:
+        f = index[name]
+        if f not in _readers:
+            _readers[f] = safe_open(str(f), framework="np")
+        return _readers[f].get_tensor(name)
+
+    def place(arr: np.ndarray, logical) -> jax.Array:
+        arr = jnp.asarray(arr, dtype=dtype)
+        if mesh is not None:
+            return jax.device_put(arr, named_sharding(mesh, logical))
+        return arr
+
+    layer_map = dict(_HF_LAYER_MAP)
+    if cfg.family == "gemma2":
+        layer_map.update(_GEMMA2_OVERRIDES)
+
+    # Stack per-layer tensors along the leading axis.
+    layers: Dict[str, Dict[str, Any]] = {}
+    for (group, leaf), template in layer_map.items():
+        if group not in axes["layers"]:
+            continue
+        stack = []
+        for i in range(cfg.n_layers):
+            t = read(template.format(i=i))
+            if leaf.startswith("w"):
+                t = t.T  # HF [out,in] -> [in,out]
+            stack.append(np.asarray(t))
+        layers.setdefault(group, {})[leaf] = place(
+            np.stack(stack), axes["layers"][group][leaf]
+        )
+
+    params: Dict[str, Any] = {
+        "embed": place(read("model.embed_tokens.weight"), axes["embed"]),
+        "layers": layers,
+        "final_norm": {
+            "scale": place(read("model.norm.weight"), axes["final_norm"]["scale"])
+        },
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = place(read("lm_head.weight").T, axes["lm_head"])
+    for reader in _readers.values():
+        del reader
+    return params
+
+
+# ------------------------- native checkpointing ------------------------- #
+
+def save_params(params: Dict[str, Any], path: str | Path) -> None:
+    """Orbax save (durable model checkpoint; reference has no checkpointing
+    at all, SURVEY.md §5.4)."""
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    ckpt = ocp.PyTreeCheckpointer()
+    ckpt.save(path, params, force=True)
+
+
+def restore_params(path: str | Path, target: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    import orbax.checkpoint as ocp
+
+    ckpt = ocp.PyTreeCheckpointer()
+    return ckpt.restore(Path(path).absolute(), item=target)

@@ -1,0 +1,201 @@
+"""Shared transformer forward: prefill and single-token decode.
+
+Both paths ``lax.scan`` over stacked layer params (static shapes, O(1)
+compile in depth) and express GQA/RoPE/soft-caps per ``ModelConfig``.
+Activation shardings are declared with logical axes; under a mesh, XLA
+inserts the TP all-reduces over ICI on its own.
+
+No reference counterpart — this replaces the remote API call at
+``pilott/engine/llm.py:59`` with on-device compute.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pilottai_tpu.models.common import (
+    ModelConfig,
+    apply_rope,
+    rms_norm,
+    rope_tables,
+)
+from pilottai_tpu.ops.attention import dot_product_attention, sliding_window_row_mask
+from pilottai_tpu.ops.kvcache import KVCache, append_token
+from pilottai_tpu.parallel.sharding import with_logical_constraint
+
+
+def _activation(cfg: ModelConfig, x: jax.Array) -> jax.Array:
+    if cfg.act == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
+
+
+def _mlp(cfg: ModelConfig, p: Dict[str, Any], x: jax.Array) -> jax.Array:
+    gate = _activation(cfg, x @ p["wg"])
+    up = x @ p["wu"]
+    return (gate * up) @ p["wd"]
+
+
+def _qkv(
+    cfg: ModelConfig,
+    p: Dict[str, Any],
+    x: jax.Array,
+    sin: jax.Array,
+    cos: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    B, T, _ = x.shape
+    q = (x @ p["wq"]).reshape(B, T, cfg.n_heads, cfg.head_dim)
+    k = (x @ p["wk"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    v = (x @ p["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    q = apply_rope(q, sin, cos)
+    k = apply_rope(k, sin, cos)
+    return q, k, v
+
+
+def _attn_out(cfg: ModelConfig, p: Dict[str, Any], attn: jax.Array) -> jax.Array:
+    B, T = attn.shape[:2]
+    return attn.reshape(B, T, cfg.q_dim) @ p["wo"]
+
+
+def _embed(cfg: ModelConfig, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
+    x = params["embed"][tokens]
+    if cfg.scale_embed:
+        x = x * jnp.asarray(cfg.hidden_size**0.5, x.dtype)
+    return x
+
+
+def _unembed(cfg: ModelConfig, params: Dict[str, Any], x: jax.Array) -> jax.Array:
+    head = params["lm_head"] if "lm_head" in params else params["embed"].T
+    logits = jnp.einsum(
+        "...e,ev->...v", x, head, preferred_element_type=jnp.float32
+    )
+    if cfg.logit_softcap > 0.0:
+        logits = jnp.tanh(logits / cfg.logit_softcap) * cfg.logit_softcap
+    return logits
+
+
+# --------------------------------------------------------------------- #
+# Prefill
+# --------------------------------------------------------------------- #
+
+@partial(jax.jit, static_argnames=("cfg",))
+def forward_prefill(
+    params: Dict[str, Any],
+    cfg: ModelConfig,
+    tokens: jax.Array,      # [B, T] (right-padded)
+    positions: jax.Array,   # [B, T] absolute positions (pad slots arbitrary)
+    valid: jax.Array,       # [B] true prompt lengths
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Full-prompt forward. Returns (logits [B, T, V] fp32, k, v) where
+    k/v are [L, B, T, K, H] ready to insert into a KVCache."""
+    x = _embed(cfg, params, tokens)
+    x = with_logical_constraint(x, ("batch", "seq", None))
+    sin, cos = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+    windows = jnp.asarray(cfg.window_sizes())
+    qscale = cfg.query_scale if cfg.query_scale is not None else cfg.head_dim**-0.5
+
+    # Causal mask within the prompt, from the *absolute* positions argument
+    # (not arange), restricted to valid tokens — so prefill at a nonzero
+    # offset masks consistently with its RoPE.
+    T = tokens.shape[1]
+    jpos = positions[:, None, :]          # [B, 1, T] key positions
+    ipos = positions[:, :, None]          # [B, T, 1] query positions
+    base_mask = (jpos <= ipos) & (
+        jnp.arange(T)[None, None, :] < valid[:, None, None]
+    )
+
+    def layer_fn(carry, scanned):
+        x = carry
+        lp, window = scanned
+        win_mask = jnp.where(window > 0, (ipos - jpos) < jnp.maximum(window, 1), True)
+        mask = base_mask & win_mask
+        h = rms_norm(x, lp["ln1"]["scale"], cfg.rms_eps, cfg.rms_offset)
+        q, k, v = _qkv(cfg, lp["attn"], h, sin, cos)
+        attn = dot_product_attention(
+            q, k, v, mask=mask, scale=qscale, logit_softcap=cfg.attn_softcap
+        )
+        out = _attn_out(cfg, lp["attn"], attn)
+        if cfg.post_norms:
+            out = rms_norm(out, lp["ln1_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
+        x = x + out
+        h = rms_norm(x, lp["ln2"]["scale"], cfg.rms_eps, cfg.rms_offset)
+        out = _mlp(cfg, lp["mlp"], h)
+        if cfg.post_norms:
+            out = rms_norm(out, lp["ln2_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
+        x = x + out
+        x = with_logical_constraint(x, ("batch", "seq", None))
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(
+        layer_fn, x, (params["layers"], windows)
+    )
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps, cfg.rms_offset)
+    logits = _unembed(cfg, params, x)
+    return logits, ks, vs
+
+
+# --------------------------------------------------------------------- #
+# Decode
+# --------------------------------------------------------------------- #
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def forward_decode(
+    params: Dict[str, Any],
+    cfg: ModelConfig,
+    tokens: jax.Array,     # [B] current token per slot
+    cache: KVCache,        # donated; positions written at cache.lengths
+    active: jax.Array,     # [B] bool — which slots hold live sequences
+) -> Tuple[jax.Array, KVCache]:
+    """One decode step for every slot. Returns (logits [B, V] fp32, cache).
+
+    Inactive slots still flow through the matmuls (static shapes — one
+    compilation serves the whole serving lifetime) but their cache writes
+    are routed out-of-bounds (dropped by XLA scatter semantics) and their
+    lengths stay frozen, so a freed slot is bit-identical until readmission.
+    """
+    B = tokens.shape[0]
+    S_total = cache.max_len
+    # Write index == current length; inactive slots write at S (dropped).
+    positions = jnp.where(active, cache.lengths, S_total)
+    x = _embed(cfg, params, tokens[:, None])  # [B, 1, E]
+    sin, cos = rope_tables(positions[:, None], cfg.head_dim, cfg.rope_theta)
+    windows = jnp.asarray(cfg.window_sizes())
+    qscale = cfg.query_scale if cfg.query_scale is not None else cfg.head_dim**-0.5
+    S = cache.max_len
+
+    def layer_fn(carry, scanned):
+        x = carry
+        lp, layer_k, layer_v, window = scanned
+        h = rms_norm(x, lp["ln1"]["scale"], cfg.rms_eps, cfg.rms_offset)
+        q, k_new, v_new = _qkv(cfg, lp["attn"], h, sin, cos)
+        layer_k, layer_v = append_token(layer_k, layer_v, k_new, v_new, positions)
+        mask = sliding_window_row_mask(positions[:, None], S, window)
+        mask &= jnp.arange(S)[None, None, :] <= positions[:, None, None]
+        attn = dot_product_attention(
+            q, layer_k, layer_v, mask=mask, scale=qscale,
+            logit_softcap=cfg.attn_softcap,
+        )
+        out = _attn_out(cfg, lp["attn"], attn)
+        if cfg.post_norms:
+            out = rms_norm(out, lp["ln1_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
+        x = x + out
+        h = rms_norm(x, lp["ln2"]["scale"], cfg.rms_eps, cfg.rms_offset)
+        out = _mlp(cfg, lp["mlp"], h)
+        if cfg.post_norms:
+            out = rms_norm(out, lp["ln2_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
+        x = x + out
+        return x, (layer_k, layer_v)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_fn, x, (params["layers"], cache.k, cache.v, windows)
+    )
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps, cfg.rms_offset)
+    logits = _unembed(cfg, params, x)[:, 0]  # [B, V]
+    new_lengths = jnp.where(active, cache.lengths + 1, cache.lengths)
+    new_cache = KVCache(k=new_k, v=new_v, lengths=new_lengths)
+    del B
+    return logits, new_cache

@@ -1,0 +1,10 @@
+"""Device ops: attention (XLA + Pallas), KV caches, sampling primitives.
+
+New TPU-native surface — the reference computes nothing on-device
+(SURVEY.md §2: "no tensor computation").
+"""
+
+from pilottai_tpu.ops.attention import dot_product_attention
+from pilottai_tpu.ops.kvcache import KVCache
+
+__all__ = ["dot_product_attention", "KVCache"]

@@ -1,0 +1,103 @@
+"""Slot-based KV cache for continuous batching.
+
+Shapes are static (jit-stable): ``k``/``v`` are [L, B, S, K, H] where B is
+the number of serving *slots* and S the max context. Each slot holds one
+in-flight sequence; ``lengths[b]`` is how many cache entries are valid.
+Admission/eviction happen on the host between device steps (the batcher);
+the device only ever sees full, fixed-shape arrays — no dynamic shapes, no
+recompiles.
+
+New TPU-native surface (the reference has no KV anything); the paged
+variant for long ragged contexts lives in ``pilottai_tpu/ops/pallas``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class KVCache(NamedTuple):
+    k: jax.Array        # [L, B, S, K, H]
+    v: jax.Array        # [L, B, S, K, H]
+    lengths: jax.Array  # [B] int32 — valid entries per slot
+
+    @property
+    def n_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def n_slots(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+    @classmethod
+    def create(
+        cls,
+        n_layers: int,
+        n_slots: int,
+        max_len: int,
+        n_kv_heads: int,
+        head_dim: int,
+        dtype=jnp.bfloat16,
+    ) -> "KVCache":
+        shape = (n_layers, n_slots, max_len, n_kv_heads, head_dim)
+        return cls(
+            k=jnp.zeros(shape, dtype=dtype),
+            v=jnp.zeros(shape, dtype=dtype),
+            lengths=jnp.zeros((n_slots,), dtype=jnp.int32),
+        )
+
+
+def write_prompt(
+    cache: KVCache,
+    slot: jax.Array,      # scalar int32
+    k_new: jax.Array,     # [L, T, K, H] — prompt K for every layer
+    v_new: jax.Array,     # [L, T, K, H]
+    length: jax.Array,    # scalar int32 — true (unpadded) prompt length
+) -> KVCache:
+    """Insert a freshly prefilled prompt into ``slot`` (host-driven admission).
+
+    T may be padded; entries beyond ``length`` are zeros and masked out at
+    attention time via ``lengths``.
+    """
+    T = k_new.shape[1]
+    k = jax.lax.dynamic_update_slice(
+        cache.k, k_new[:, None], (0, slot, 0, 0, 0)
+    )
+    v = jax.lax.dynamic_update_slice(
+        cache.v, v_new[:, None], (0, slot, 0, 0, 0)
+    )
+    del T
+    lengths = cache.lengths.at[slot].set(length)
+    return KVCache(k=k, v=v, lengths=lengths)
+
+
+def append_token(
+    layer_k: jax.Array,   # [B, S, K, H] one layer's cache
+    layer_v: jax.Array,
+    k_new: jax.Array,     # [B, 1, K, H]
+    v_new: jax.Array,
+    positions: jax.Array,  # [B] int32 — write index per slot (= current length)
+) -> Tuple[jax.Array, jax.Array]:
+    """Scatter one decode step's K/V into each slot at its own position.
+
+    Uses one-hot matmul-free scatter via ``at[...]`` with batched indices —
+    lowers to an efficient dynamic-update on TPU.
+    """
+    B = layer_k.shape[0]
+    batch_idx = jnp.arange(B)
+    k = layer_k.at[batch_idx, positions].set(k_new[:, 0])
+    v = layer_v.at[batch_idx, positions].set(v_new[:, 0])
+    return k, v
+
+
+def free_slot(cache: KVCache, slot: jax.Array) -> KVCache:
+    """Mark a slot empty (host calls when a sequence finishes). The stale
+    K/V bytes stay — masked out by lengths — so no device writes needed."""
+    return cache._replace(lengths=cache.lengths.at[slot].set(0))

@@ -1,0 +1,23 @@
+"""Parallelism: device meshes, sharding rules, collectives, ring attention.
+
+ABSENT in the reference (SURVEY.md §2.13-2.14 — its only concurrency is
+asyncio). This package is new TPU-native surface: SPMD over
+``jax.sharding.Mesh`` with XLA collectives riding ICI, scaling the in-tree
+engine the way the reference's remote-API path never could.
+"""
+
+from pilottai_tpu.parallel.mesh import MeshConfig, best_mesh_config, create_mesh
+from pilottai_tpu.parallel.sharding import (
+    logical_to_spec,
+    shard_params,
+    with_logical_constraint,
+)
+
+__all__ = [
+    "MeshConfig",
+    "create_mesh",
+    "best_mesh_config",
+    "logical_to_spec",
+    "shard_params",
+    "with_logical_constraint",
+]

@@ -1,0 +1,120 @@
+"""Logical-axis sharding rules: annotate once, let XLA insert collectives.
+
+Arrays carry *logical* axis names; one rules table maps logical axes to
+mesh axes. This is the scaling-book recipe (pick a mesh, annotate
+shardings, let XLA do the rest) — no reference counterpart (SURVEY §2.13).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical axis -> mesh axis (or tuple of mesh axes, or None = replicated)
+DEFAULT_RULES: Dict[str, Any] = {
+    "batch": ("data", "fsdp"),
+    "seq": "seq",           # activation sequence axis (context parallel)
+    "embed": "fsdp",        # weight embed axis sharded over fsdp
+    "heads": "model",       # attention heads: tensor parallel
+    "kv_heads": "model",
+    "head_dim": None,
+    "mlp": "model",         # ffn hidden: tensor parallel
+    "vocab": "model",       # embedding/logits vocab axis
+    "layers": None,         # stacked-layer leading axis: never sharded
+    "expert": "model",      # MoE experts (expert parallel rides the model axis
+                            # by default; override with a dedicated axis)
+}
+
+
+def logical_to_spec(
+    logical_axes: Sequence[Optional[str]],
+    rules: Optional[Dict[str, Any]] = None,
+) -> P:
+    """Map ('batch', 'seq', 'embed') -> PartitionSpec via the rules table."""
+    rules = {**DEFAULT_RULES, **(rules or {})}
+    entries = []
+    for axis in logical_axes:
+        if axis is None:
+            entries.append(None)
+        else:
+            entries.append(rules.get(axis))
+    return P(*entries)
+
+
+def named_sharding(
+    mesh: Mesh,
+    logical_axes: Sequence[Optional[str]],
+    rules: Optional[Dict[str, Any]] = None,
+) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_spec(logical_axes, rules))
+
+
+def shard_params(
+    params: Any,
+    logical_tree: Any,
+    mesh: Mesh,
+    rules: Optional[Dict[str, Any]] = None,
+) -> Any:
+    """Device-put a param pytree according to a parallel pytree of logical
+    axis tuples (``None`` leaf = replicated)."""
+
+    def place(axes, leaf):
+        sharding = (
+            NamedSharding(mesh, P())
+            if axes is None
+            else named_sharding(mesh, axes, rules)
+        )
+        return jax.device_put(leaf, sharding)
+
+    # Map over logical_tree FIRST so bare-None leaves ("replicated") are
+    # honored — with params first, a None in the second tree would be
+    # treated as an empty subtree and raise a structure mismatch.
+    return jax.tree.map(
+        place,
+        logical_tree,
+        params,
+        is_leaf=lambda x: x is None
+        or (isinstance(x, tuple) and all(a is None or isinstance(a, str) for a in x)),
+    )
+
+
+def with_logical_constraint(
+    x: jax.Array,
+    logical_axes: Sequence[Optional[str]],
+    mesh: Optional[Mesh] = None,
+    rules: Optional[Dict[str, Any]] = None,
+) -> jax.Array:
+    """``lax.with_sharding_constraint`` by logical axes; no-op outside jit
+    mesh contexts so model code runs unchanged on one device."""
+    try:
+        mesh = mesh or _current_mesh()
+        if mesh is None or mesh.empty:
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, named_sharding(mesh, logical_axes, rules)
+        )
+    except (ValueError, RuntimeError):
+        return x
+
+
+def _current_mesh() -> Optional[Mesh]:
+    try:
+        from jax._src.mesh import thread_resources
+
+        mesh = thread_resources.env.physical_mesh
+        return None if mesh.empty else mesh
+    except Exception:
+        return None
+
+
+def spec_tree_for(logical_tree: Any, rules: Optional[Dict[str, Any]] = None) -> Any:
+    """Parallel pytree of PartitionSpecs (for pjit in/out shardings)."""
+    return jax.tree.map(
+        lambda axes: P() if axes is None else logical_to_spec(axes, rules),
+        logical_tree,
+        is_leaf=lambda x: x is None or (isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x
+        )),
+    )

@@ -9,12 +9,21 @@ module-level os.environ writes here.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the environment may pin JAX_PLATFORMS to a real
+# accelerator platform, and tests must be hermetic (and must not hang if
+# the accelerator tunnel is unavailable).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# Belt and braces: the env var alone can be overridden by site-injected
+# accelerator plugins; the config flag is authoritative.
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402

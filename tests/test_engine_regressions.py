@@ -1,0 +1,135 @@
+"""Regression tests for code-review findings in the engine layer."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pilottai_tpu.core.config import LLMConfig
+from pilottai_tpu.engine.batcher import ContinuousBatcher, GenRequest
+from pilottai_tpu.engine.handler import LLMHandler
+from pilottai_tpu.engine.types import ChatMessage, GenerationParams
+from pilottai_tpu.models.common import init_params
+from pilottai_tpu.models.registry import get_model_config
+from pilottai_tpu.models.transformer import forward_prefill
+from pilottai_tpu.parallel.mesh import MeshConfig, create_mesh
+from pilottai_tpu.parallel.sharding import shard_params
+
+
+def _tiny_batcher(max_seq=64, n_slots=2):
+    cfg = get_model_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return ContinuousBatcher(cfg, params, n_slots=n_slots, max_seq_len=max_seq,
+                             cache_dtype=jnp.float32), cfg
+
+
+def test_submit_truncation_never_noop():
+    # max_new_tokens >= max_seq_len - 1 used to produce a -0 slice that kept
+    # the whole oversized prompt and crashed the device thread.
+    batcher, _ = _tiny_batcher(max_seq=64)
+    req = GenRequest(prompt_ids=list(range(3, 203)), max_new_tokens=63)
+    batcher.submit(req)
+    assert len(req.prompt_ids) <= 62
+    req2 = GenRequest(prompt_ids=list(range(3, 203)), max_new_tokens=1000)
+    batcher.submit(req2)
+    assert 1 <= len(req2.prompt_ids) <= 62
+
+
+def test_prefill_failure_fails_future_not_thread():
+    batcher, cfg = _tiny_batcher()
+    # Token id far out of vocab range makes the embedding gather produce
+    # garbage but not crash; instead force failure via a poisoned request
+    # whose prompt is empty (bucket math still works) and monkeypatched
+    # prefill raising.
+    def boom(*a, **k):
+        raise RuntimeError("prefill exploded")
+
+    batcher._prefill_into = boom  # type: ignore[assignment]
+    batcher.start()
+    try:
+        req = GenRequest(prompt_ids=[1, 2, 3], max_new_tokens=4)
+        fut = batcher.submit(req)
+        with pytest.raises(RuntimeError, match="prefill exploded"):
+            fut.result(timeout=10)
+        # Thread must survive and process the next (also failing) request.
+        req2 = GenRequest(prompt_ids=[1], max_new_tokens=2)
+        fut2 = batcher.submit(req2)
+        with pytest.raises(RuntimeError):
+            fut2.result(timeout=10)
+        assert batcher._thread.is_alive()
+    finally:
+        batcher.stop()
+
+
+def test_cancelled_request_frees_slot():
+    batcher, _ = _tiny_batcher(max_seq=64, n_slots=1)
+    batcher.start()
+    try:
+        long_req = GenRequest(prompt_ids=[1, 2, 3], max_new_tokens=60)
+        batcher.submit(long_req)
+        import time
+        time.sleep(0.2)
+        long_req.cancelled = True
+        # The single slot must free up for the next request.
+        short = GenRequest(prompt_ids=[4, 5], max_new_tokens=2)
+        fut = batcher.submit(short)
+        out = fut.result(timeout=60)
+        assert isinstance(out, list)
+    finally:
+        batcher.stop()
+
+
+def test_first_token_sampling_honors_top_p():
+    logits = np.asarray([4.0, 2.0, 0.0, -1.0], np.float32)  # p0 ~ 0.87
+    req = GenRequest(prompt_ids=[1], temperature=1.0, top_p=0.5, seed=0)
+    picks = {
+        ContinuousBatcher._sample_one(logits, req) for req.seed in range(30)
+    }
+    assert picks == {0}
+
+
+@pytest.mark.asyncio
+async def test_concurrent_start_single_batcher():
+    from pilottai_tpu.engine.native import NativeEngine
+
+    engine = NativeEngine(
+        LLMConfig(model_name="llama-tiny", provider="cpu", engine_max_seq=128),
+        platform="cpu",
+    )
+    try:
+        await asyncio.gather(engine.start(), engine.start(), engine.start())
+        assert engine.batcher is not None
+        threads = [
+            t for t in __import__("threading").enumerate()
+            if t.name == "pilottai-device-loop"
+        ]
+        assert len(threads) == 1
+    finally:
+        await engine.stop()
+
+
+def test_prefill_mask_uses_absolute_positions():
+    # Prefill at a nonzero offset: token i may only attend j with pos_j <=
+    # pos_i. With the old arange-based mask this is indistinguishable; with
+    # *decreasing* positions the two disagree.
+    cfg = get_model_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    tokens = jnp.asarray([[5, 6, 7, 8]])
+    inc = jnp.asarray([[0, 1, 2, 3]])
+    dec = jnp.asarray([[3, 2, 1, 0]])
+    valid = jnp.asarray([4])
+    logits_inc, _, _ = forward_prefill(params, cfg, tokens, inc, valid)
+    logits_dec, _, _ = forward_prefill(params, cfg, tokens, dec, valid)
+    # Row 0 under decreasing positions attends everything (pos 3 is max);
+    # under increasing positions it attends only itself → logits differ.
+    assert not np.allclose(np.asarray(logits_inc[0, 0]), np.asarray(logits_dec[0, 0]))
+
+
+def test_shard_params_accepts_bare_none_leaf():
+    mesh = create_mesh(MeshConfig(data=2, model=4))
+    params = {"w": jnp.ones((8, 8)), "b": jnp.ones((8,))}
+    logical = {"w": ("embed", "mlp"), "b": None}  # bare None = replicated
+    placed = shard_params(params, logical, mesh)
+    assert placed["b"].sharding.is_fully_replicated

@@ -1,0 +1,111 @@
+"""Model correctness: prefill/decode agreement, family behaviors, sharding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pilottai_tpu.models.common import init_params, param_logical_axes
+from pilottai_tpu.models.gemma import GEMMA_TINY
+from pilottai_tpu.models.llama import LLAMA_TINY
+from pilottai_tpu.models.registry import get_model_config, list_models
+from pilottai_tpu.models.transformer import forward_decode, forward_prefill
+from pilottai_tpu.ops.kvcache import KVCache, write_prompt
+from pilottai_tpu.engine.sampling import SamplingState, sample_tokens, update_slot
+
+
+def _prefill_then_decode_logits(cfg, tokens_list):
+    """Reference check: full prefill over [t0..tn] must agree with
+    prefill([t0..tk]) + decode steps for the rest."""
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    T = len(tokens_list)
+    tokens = jnp.asarray(tokens_list)[None, :]
+    positions = jnp.arange(T)[None, :]
+    valid = jnp.asarray([T])
+
+    full_logits, _, _ = forward_prefill(params, cfg, tokens, positions, valid)
+
+    # Now: prefill the first half, decode the second half token by token.
+    half = T // 2
+    p_tokens = jnp.zeros((1, T), jnp.int32).at[0, :half].set(tokens[0, :half])
+    p_logits, ks, vs = forward_prefill(
+        params, cfg, p_tokens, positions, jnp.asarray([half])
+    )
+    cache = KVCache.create(cfg.n_layers, 2, T, cfg.n_kv_heads, cfg.head_dim,
+                           dtype=jnp.float32)
+    cache = write_prompt(cache, jnp.int32(0), ks[:, 0], vs[:, 0], jnp.int32(half))
+
+    active = jnp.asarray([True, False])
+    decode_logits = []
+    for i in range(half, T):
+        step_tokens = jnp.asarray([tokens_list[i], 0], jnp.int32)
+        logits, cache = forward_decode(params, cfg, step_tokens, cache, active)
+        decode_logits.append(logits[0])
+    return full_logits[0], decode_logits, half
+
+
+@pytest.mark.parametrize("cfg_name", ["llama-tiny", "gemma-tiny"])
+def test_decode_matches_prefill(cfg_name):
+    cfg = get_model_config(cfg_name)
+    tokens = list(np.random.RandomState(0).randint(0, cfg.vocab_size, size=8))
+    full, decoded, half = _prefill_then_decode_logits(cfg, tokens)
+    for i, step_logits in enumerate(decoded):
+        np.testing.assert_allclose(
+            np.asarray(full[half + i]), np.asarray(step_logits),
+            rtol=2e-4, atol=2e-4,
+        )
+
+
+def test_param_count_matches_tree():
+    for name in ("llama-tiny", "gemma-tiny"):
+        cfg = get_model_config(name)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        total = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+        assert total == cfg.param_count(), name
+
+
+def test_logical_axes_tree_matches_params():
+    cfg = LLAMA_TINY
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    axes = param_logical_axes(cfg)
+    p_struct = jax.tree.structure(params)
+    a_struct = jax.tree.structure(axes, is_leaf=lambda x: isinstance(x, tuple))
+    assert p_struct == a_struct
+
+
+def test_gemma_softcap_bounds_logits():
+    cfg = GEMMA_TINY
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    tokens = jnp.ones((1, 4), jnp.int32)
+    logits, _, _ = forward_prefill(
+        params, cfg, tokens, jnp.arange(4)[None], jnp.asarray([4])
+    )
+    assert float(jnp.max(jnp.abs(logits))) <= cfg.logit_softcap + 1e-3
+
+
+def test_registry_lists_flagship_models():
+    names = list_models()
+    assert "llama3-8b" in names and "gemma-2b" in names
+    cfg = get_model_config("llama3-8b")
+    assert cfg.param_count() > 7_000_000_000
+
+
+def test_sampling_greedy_and_temperature():
+    logits = jnp.asarray([[0.0, 5.0, 1.0], [3.0, 0.0, 0.0]], jnp.float32)
+    state = SamplingState.create(2, seed=0)
+    tokens, state = sample_tokens(logits, state)
+    assert tokens.tolist() == [1, 0]  # temperature 0 -> greedy
+    # High temperature + top_k=1 still forces the argmax.
+    state = update_slot(state, 0, temperature=2.0, top_k=1, top_p=1.0, seed=7)
+    tokens2, _ = sample_tokens(logits, state)
+    assert int(tokens2[0]) == 1
+
+
+def test_sampling_top_p_restricts_support():
+    # One dominant token (prob ~0.88): top_p=0.5 must always pick it.
+    logits = jnp.tile(jnp.asarray([[4.0, 2.0, 0.0, -1.0]]), (1, 1))
+    state = SamplingState.create(1, seed=1)
+    state = update_slot(state, 0, temperature=1.0, top_k=0, top_p=0.5, seed=3)
+    for _ in range(20):
+        tok, state = sample_tokens(logits, state)
+        assert int(tok[0]) == 0
