@@ -40,6 +40,13 @@ __version__ = "0.1.0"
 # land so the advertised API never points at missing modules.
 _LAZY = {
     "Memory": ("pilottai_tpu.core.memory", "Memory"),
+    "Serve": ("pilottai_tpu.serve", "Serve"),
+    "BaseAgent": ("pilottai_tpu.core.agent", "BaseAgent"),
+    "AgentFactory": ("pilottai_tpu.core.factory", "AgentFactory"),
+    "TaskRouter": ("pilottai_tpu.core.router", "TaskRouter"),
+    "Tool": ("pilottai_tpu.tools.tool", "Tool"),
+    "ToolRegistry": ("pilottai_tpu.tools.tool", "ToolRegistry"),
+    "LLMHandler": ("pilottai_tpu.engine.handler", "LLMHandler"),
 }
 
 

@@ -1,0 +1,553 @@
+"""BaseAgent: the LLM-driven agent runtime.
+
+Reference parity: ``pilott/core/agent.py`` (627 LoC) — reasoning loop
+``execute_task`` → validate deps → analyze (LLM) → select tools (LLM) →
+sorted tool-lock acquisition → bounded plan/act step loop (LLM per step) →
+evaluate (LLM) (``:131-371``); health/metrics/suitability surface
+(``:217-229,535-575``); manager hooks (``:592-628``); system prompt from
+role/goal/backstory (``:373-387``).
+
+Deliberate fixes over the reference:
+  * parent/child hierarchy is REAL — ``child_agents``/``add_child_agent``
+    are implied everywhere in the reference and defined nowhere
+    (SURVEY.md §2.12-b);
+  * ``send_heartbeat`` exists (called but undefined at
+    ``orchestration/scaling.py:232``, §2.12-h);
+  * one tolerant JSON parser for all LLM responses (the reference's agent
+    used strict ``json.loads``, §3.4);
+  * load/utilization come from queue depth and engine metrics, not a
+    blocking ``psutil.cpu_percent(interval=1)`` (§2.12-h).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from pilottai_tpu.core.config import AgentConfig
+from pilottai_tpu.core.status import AgentStatus
+from pilottai_tpu.core.task import Task, TaskResult, TaskStatus
+from pilottai_tpu.prompts.manager import PromptManager
+from pilottai_tpu.tools.tool import Tool, ToolRegistry
+from pilottai_tpu.utils.json_utils import coerce_bool, extract_json
+from pilottai_tpu.utils.logging import get_logger
+from pilottai_tpu.utils.metrics import global_metrics
+from pilottai_tpu.utils.tracing import global_tracer
+
+StepCallback = Callable[[str, Dict[str, Any]], Any]
+
+
+class BaseAgent:
+    """An autonomous agent executing tasks through an LLM reasoning loop."""
+
+    def __init__(
+        self,
+        config: Optional[AgentConfig] = None,
+        llm: Optional[Any] = None,  # LLMHandler
+        tools: Optional[ToolRegistry | List[Tool]] = None,
+        memory: Optional[Any] = None,          # EnhancedMemory (optional)
+        knowledge: Optional[Any] = None,       # KnowledgeManager (optional)
+        agent_id: Optional[str] = None,
+        prompt_manager: Optional[PromptManager] = None,
+        step_callback: Optional[StepCallback] = None,
+        dependency_resolver: Optional[Callable[[str], Optional[Task]]] = None,
+    ) -> None:
+        self.config = config or AgentConfig()
+        if llm is None:
+            raise ValueError(
+                "BaseAgent requires an llm handle (LLMHandler); use "
+                "LLMConfig(provider='mock') for tests"
+            )  # reference enforces the same at core/agent.py:77
+        self.llm = llm
+        self.id = agent_id or str(uuid.uuid4())
+        self.role = self.config.role
+        self.status = AgentStatus.CREATED
+        self.tools = (
+            tools if isinstance(tools, ToolRegistry) else ToolRegistry(tools or [])
+        )
+        self.memory = memory
+        self.knowledge = knowledge
+        self.prompts = prompt_manager or PromptManager("agent")
+        self.step_callback = step_callback
+        self.dependency_resolver = dependency_resolver
+
+        # Hierarchy (fix for SURVEY §2.12-b).
+        self.parent: Optional["BaseAgent"] = None
+        self.child_agents: Dict[str, "BaseAgent"] = {}
+
+        # Queues / history / metrics.
+        self.task_queue: "asyncio.Queue[Task]" = asyncio.Queue(
+            maxsize=self.config.max_queue_size
+        )
+        self._queued_tasks: Dict[str, Task] = {}
+        self.current_tasks: Dict[str, Task] = {}
+        self.conversation_history: deque = deque(maxlen=100)
+        self.task_history: deque = deque(maxlen=1000)
+        self.task_metrics: Dict[str, int] = {
+            "completed": 0, "failed": 0, "retried": 0,
+        }
+        self._execution_locks: Dict[str, asyncio.Lock] = {}
+        self._total_exec_time = 0.0
+        self._last_heartbeat = time.time()
+        self._error_count = 0
+        self._worker_task: Optional[asyncio.Task] = None
+        self._log = get_logger("agent", agent_id=self.id[:8], role=self.role)
+
+    # ------------------------------------------------------------------ #
+    # Hierarchy (reference: implied at scaling.py:149, load_balancer.py:223,
+    # delegation/task_delegator.py:311 — never implemented there)
+    # ------------------------------------------------------------------ #
+
+    def add_child_agent(self, agent: "BaseAgent") -> None:
+        if len(self.child_agents) >= self.config.max_child_agents:
+            raise RuntimeError(
+                f"agent {self.id[:8]} at max_child_agents="
+                f"{self.config.max_child_agents}"
+            )
+        if agent.id in self.child_agents:
+            raise ValueError(f"agent {agent.id} is already a child")
+        if agent is self or self._is_ancestor(agent):
+            raise ValueError("hierarchy cycles are not allowed")
+        agent.parent = self
+        self.child_agents[agent.id] = agent
+
+    def remove_child_agent(self, agent_id: str) -> Optional["BaseAgent"]:
+        agent = self.child_agents.pop(agent_id, None)
+        if agent is not None:
+            agent.parent = None
+        return agent
+
+    def _is_ancestor(self, candidate: "BaseAgent") -> bool:
+        node = self.parent
+        while node is not None:
+            if node is candidate:
+                return True
+            node = node.parent
+        return False
+
+    def descendants(self) -> List["BaseAgent"]:
+        out: List[BaseAgent] = []
+        stack = list(self.child_agents.values())
+        while stack:
+            node = stack.pop()
+            out.append(node)
+            stack.extend(node.child_agents.values())
+        return out
+
+    # ------------------------------------------------------------------ #
+    # Lifecycle (reference ``core/agent.py:435-444,577-590``)
+    # ------------------------------------------------------------------ #
+
+    async def start(self) -> None:
+        if self.status.is_available:
+            return
+        self.status = AgentStatus.STARTING
+        if self.llm is not None and hasattr(self.llm, "start"):
+            await self.llm.start()
+        self.status = AgentStatus.IDLE
+        self._last_heartbeat = time.time()
+        self._log.info("agent started")
+
+    async def stop(self) -> None:
+        self.status = AgentStatus.STOPPING
+        if self._worker_task is not None:
+            self._worker_task.cancel()
+            self._worker_task = None
+        self.status = AgentStatus.STOPPED
+        self._log.info("agent stopped")
+
+    async def reset(self) -> None:
+        """Drop queued work and error state; keep history (reference ``:577``)."""
+        while not self.task_queue.empty():
+            try:
+                task = self.task_queue.get_nowait()
+                task.mark_cancelled()
+            except asyncio.QueueEmpty:
+                break
+        self._queued_tasks.clear()
+        self.current_tasks.clear()
+        self._error_count = 0
+        self.status = AgentStatus.IDLE
+        self._last_heartbeat = time.time()
+
+    async def pause(self) -> None:
+        self.status = AgentStatus.PAUSED
+
+    async def resume(self) -> None:
+        if self.status == AgentStatus.PAUSED:
+            self.status = AgentStatus.IDLE
+
+    def send_heartbeat(self) -> float:
+        """Liveness signal for FaultTolerance (defined here; the reference
+        calls it but never defines it — SURVEY §2.12-h)."""
+        self._last_heartbeat = time.time()
+        return self._last_heartbeat
+
+    # ------------------------------------------------------------------ #
+    # Queue surface (used by router / balancer / scaler)
+    # ------------------------------------------------------------------ #
+
+    async def add_task(self, task: Task) -> None:
+        if self.status == AgentStatus.STOPPED:
+            raise RuntimeError(f"agent {self.id[:8]} is stopped")
+        task.mark_queued()
+        task.agent_id = self.id
+        self._queued_tasks[task.id] = task
+        await self.task_queue.put(task)
+
+    def remove_task(self, task_id: str) -> Optional[Task]:
+        """Detach a queued (not yet running) task — used for rebalancing."""
+        task = self._queued_tasks.pop(task_id, None)
+        if task is None:
+            return None
+        task.status = TaskStatus.PENDING
+        task.agent_id = None
+        # The queue itself still holds the object; the worker skips tasks
+        # no longer present in _queued_tasks.
+        return task
+
+    def queued_tasks(self) -> List[Task]:
+        return list(self._queued_tasks.values())
+
+    async def run_queue_worker(self) -> None:
+        """Drain the agent's own queue (hierarchical/manager workloads)."""
+        while self.status not in (AgentStatus.STOPPED, AgentStatus.STOPPING):
+            try:
+                task = await asyncio.wait_for(self.task_queue.get(), timeout=0.5)
+            except asyncio.TimeoutError:
+                continue
+            if task.id not in self._queued_tasks:
+                continue  # was rebalanced away
+            self._queued_tasks.pop(task.id, None)
+            await self.execute_task(task)
+
+    def start_queue_worker(self) -> None:
+        if self._worker_task is None or self._worker_task.done():
+            self._worker_task = asyncio.create_task(self.run_queue_worker())
+
+    # ------------------------------------------------------------------ #
+    # Execution (reference ``core/agent.py:131-371``; call stack §3.4)
+    # ------------------------------------------------------------------ #
+
+    async def execute_task(self, task: Task) -> TaskResult:
+        """Run one task through the full reasoning loop, with per-task lock
+        and overall timeout."""
+        lock = self._execution_locks.setdefault(task.id, asyncio.Lock())
+        start = time.perf_counter()
+        async with lock:
+            self.send_heartbeat()
+            self.status = AgentStatus.BUSY
+            self.current_tasks[task.id] = task
+            task.mark_started(agent_id=self.id)
+            try:
+                with global_tracer.span("agent.execute_task", task_id=task.id):
+                    result = await asyncio.wait_for(
+                        self._execute_task_internal(task),
+                        timeout=min(task.timeout, self.config.task_timeout),
+                    )
+            except asyncio.TimeoutError:
+                result = TaskResult(
+                    success=False,
+                    error=f"task timed out after {task.timeout}s",
+                    execution_time=time.perf_counter() - start,
+                )
+            except Exception as exc:  # noqa: BLE001 - task boundary
+                self._error_count += 1
+                self._log.error("task %s failed: %s", task.id[:8], exc)
+                result = TaskResult(
+                    success=False,
+                    error=str(exc),
+                    execution_time=time.perf_counter() - start,
+                )
+            finally:
+                self.current_tasks.pop(task.id, None)
+                self._execution_locks.pop(task.id, None)
+                if not self.current_tasks:
+                    self.status = AgentStatus.IDLE
+                self.send_heartbeat()
+
+        result.execution_time = time.perf_counter() - start
+        self._record_result(task, result)
+        return result
+
+    def _record_result(self, task: Task, result: TaskResult) -> None:
+        if result.success:
+            task.mark_completed(result)
+            self.task_metrics["completed"] += 1
+        else:
+            task.mark_failed(result.error or "unknown error", result)
+            self.task_metrics["failed"] += 1
+        self._total_exec_time += result.execution_time
+        self.task_history.append(
+            {
+                "task_id": task.id,
+                "type": task.type,
+                "success": result.success,
+                "execution_time": result.execution_time,
+                "ts": time.time(),
+            }
+        )
+        global_metrics.inc("agent.steps")
+        global_metrics.observe("agent.step_latency", result.execution_time)
+
+    async def _execute_task_internal(self, task: Task) -> TaskResult:
+        self._validate_task(task)
+        analysis = await self._analyze_task(task)
+        selected = await self._select_tools(task)
+        # Sorted lock acquisition avoids deadlock when two agents share
+        # tools (reference ``core/agent.py:181-185``). Acquisition happens
+        # INSIDE the try so a CancelledError mid-acquisition (task timeout)
+        # releases exactly the locks already held.
+        locks = [t.lock for t in sorted(selected, key=lambda t: t.name)]
+        acquired: List[asyncio.Lock] = []
+        try:
+            for lock in locks:
+                await lock.acquire()
+                acquired.append(lock)
+            output, steps = await self._execute_steps(task, analysis, selected)
+        finally:
+            for lock in reversed(acquired):
+                lock.release()
+        evaluation = await self._evaluate_result(task, output)
+        success = coerce_bool(evaluation.get("success", True))
+        return TaskResult(
+            success=success,
+            output=output,
+            error=None if success else "; ".join(
+                str(i) for i in evaluation.get("issues", [])
+            ) or "evaluation failed",
+            metadata={
+                "analysis": analysis,
+                "evaluation": evaluation,
+                "steps": steps,
+                "tools_used": [t.name for t in selected],
+            },
+        )
+
+    def _validate_task(self, task: Task) -> None:
+        """Dependencies must be COMPLETED (reference ``:231-246``)."""
+        if not task.description:
+            raise ValueError("task has no description")
+        for dep_id in task.dependencies:
+            dep = (
+                self.dependency_resolver(dep_id)
+                if self.dependency_resolver
+                else None
+            )
+            if dep is None:
+                # Unresolvable = already evicted by retention (completed long
+                # ago) or tracked elsewhere; consistent with the
+                # orchestrator's _deps_state, which skips missing deps.
+                continue
+            if dep.status != TaskStatus.COMPLETED:
+                raise ValueError(
+                    f"dependency {dep_id} is {dep.status.value}, not completed"
+                )
+
+    # ----------------------- LLM steps -------------------------------- #
+
+    def system_prompt(self) -> str:
+        return self.prompts.format_prompt(
+            "system.base",
+            role=self.config.role,
+            goal=self.config.goal,
+            backstory=self.config.backstory or "none",
+        )
+
+    async def _ask(self, prompt: str, tools: Optional[List[Dict[str, Any]]] = None) -> Dict[str, Any]:
+        response = await self.llm.generate_response(
+            [
+                {"role": "system", "content": self.system_prompt()},
+                {"role": "user", "content": prompt},
+            ],
+            tools=tools,
+        )
+        self.conversation_history.append(
+            {"prompt_tail": prompt[-200:], "response": response.content[:500]}
+        )
+        return extract_json(response.content) or {}
+
+    async def _analyze_task(self, task: Task) -> Dict[str, Any]:
+        prompt = self.prompts.format_prompt("task_analysis", task=task.to_prompt())
+        return await self._ask(prompt)
+
+    async def _select_tools(self, task: Task) -> List[Tool]:
+        candidates = (
+            self.tools.subset(task.tools) if task.tools
+            else self.tools.subset(self.tools.names())
+        )
+        if not candidates:
+            return []
+        prompt = self.prompts.format_prompt(
+            "tool_selection",
+            task=task.to_prompt(),
+            tools="\n".join(f"{t.name}: {t.description}" for t in candidates),
+        )
+        data = await self._ask(prompt, tools=[t.to_spec() for t in candidates])
+        names = data.get("selected_tools", [])
+        chosen = [t for t in candidates if t.name in names]
+        return chosen
+
+    async def _execute_steps(
+        self, task: Task, analysis: Dict[str, Any], tools: List[Tool]
+    ) -> tuple:
+        """Bounded plan/act loop (reference ``:270-349``)."""
+        history: List[Dict[str, Any]] = []
+        output: Any = None
+        tool_map = {t.name: t for t in tools}
+        for iteration in range(self.config.max_iterations):
+            prompt = self.prompts.format_prompt(
+                "step_planning",
+                task=task.to_prompt(),
+                history="\n".join(
+                    f"step {i}: {h['action']} -> {str(h['result'])[:200]}"
+                    for i, h in enumerate(history)
+                ) or "none yet",
+            )
+            plan = await self._ask(prompt)
+            action = plan.get("action", "respond")
+            complete = coerce_bool(plan.get("task_complete", False))
+            if complete:
+                output = plan.get("output", output)
+                history.append({"action": "complete", "result": output})
+                break
+            if action in tool_map:
+                try:
+                    result = await tool_map[action].execute(
+                        plan.get("arguments", {}) or {}
+                    )
+                except Exception as exc:  # noqa: BLE001 - step boundary
+                    result = f"tool error: {exc}"
+                history.append({"action": action, "result": result})
+                output = result
+            else:
+                output = plan.get("output", "")
+                history.append({"action": "respond", "result": output})
+            if self.step_callback:
+                maybe = self.step_callback(
+                    task.id, {"iteration": iteration, "action": action}
+                )
+                if asyncio.iscoroutine(maybe):
+                    await maybe
+        return output, history
+
+    async def _evaluate_result(self, task: Task, output: Any) -> Dict[str, Any]:
+        prompt = self.prompts.format_prompt(
+            "result_evaluation", task=task.to_prompt(), result=str(output)[:2000]
+        )
+        return await self._ask(prompt)
+
+    # ------------------------------------------------------------------ #
+    # Ops surface (reference ``:217-229,535-575``)
+    # ------------------------------------------------------------------ #
+
+    @property
+    def queue_utilization(self) -> float:
+        return (
+            (self.task_queue.qsize() + len(self.current_tasks))
+            / max(self.config.max_queue_size, 1)
+        )
+
+    @property
+    def load(self) -> float:
+        """0-1 composite load from queue depth and in-flight tasks (no
+        blocking host probes — reference bug §2.12-h)."""
+        inflight = len(self.current_tasks) / max(self.config.max_concurrent_tasks, 1)
+        return min(1.0, 0.6 * self.queue_utilization + 0.4 * min(inflight, 1.0))
+
+    @property
+    def success_rate(self) -> float:
+        total = self.task_metrics["completed"] + self.task_metrics["failed"]
+        return self.task_metrics["completed"] / total if total else 1.0
+
+    def get_health(self) -> Dict[str, Any]:
+        return {
+            "agent_id": self.id,
+            "status": self.status.value,
+            "error_count": self._error_count,
+            "last_heartbeat": self._last_heartbeat,
+            "queue_utilization": self.queue_utilization,
+            "current_tasks": len(self.current_tasks),
+        }
+
+    def get_metrics(self) -> Dict[str, Any]:
+        total = self.task_metrics["completed"] + self.task_metrics["failed"]
+        return {
+            "agent_id": self.id,
+            "role": self.role,
+            "status": self.status.value,
+            "queue_size": self.task_queue.qsize(),
+            "queue_utilization": self.queue_utilization,
+            "load": self.load,
+            "total_tasks": total,
+            "completed_tasks": self.task_metrics["completed"],
+            "failed_tasks": self.task_metrics["failed"],
+            "success_rate": self.success_rate,
+            "avg_execution_time": self._total_exec_time / total if total else 0.0,
+            "error_count": self._error_count,
+            "children": len(self.child_agents),
+        }
+
+    def evaluate_task_suitability(self, task: Task) -> float:
+        """0-1 score: base 0.7 + specialization bonus − load penalty
+        (reference ``core/agent.py:549-575``)."""
+        if not self.status.is_available:
+            return 0.0
+        score = 0.7
+        if task.type in self.config.specializations:
+            score += 0.2
+        caps = set(self.config.required_capabilities)
+        needed = set(task.required_capabilities)
+        if needed:
+            if not needed.issubset(caps | set(self.tools.names())):
+                return 0.1
+            score += 0.1
+        score -= 0.3 * self.load
+        return max(0.0, min(1.0, score))
+
+    # ------------------------------------------------------------------ #
+    # Manager hooks (reference ``core/agent.py:592-628``)
+    # ------------------------------------------------------------------ #
+
+    async def determine_strategy(self, tasks: List[Task], state: Dict[str, Any]) -> Dict[str, Any]:
+        pm = PromptManager("orchestrator")
+        prompt = pm.format_prompt(
+            "execution_strategy",
+            tasks="\n".join(t.to_prompt() for t in tasks[:10]),
+            state=str(state),
+        )
+        data = extract_json(
+            (await self.llm.generate_response([{"role": "user", "content": prompt}])).content
+        ) or {}
+        return {
+            "strategy": data.get("strategy", "parallel"),
+            "max_parallel": int(data.get("max_parallel", 4) or 4),
+        }
+
+    async def select_agent(self, task: Task, candidates: List["BaseAgent"]) -> Optional["BaseAgent"]:
+        pool = candidates or list(self.child_agents.values())
+        if not pool:
+            return None
+        pm = PromptManager("orchestrator")
+        prompt = pm.format_prompt(
+            "agent_selection",
+            task=task.to_prompt(),
+            agents="\n".join(
+                f"{a.id}: {a.role}, load={a.load:.2f}, success={a.success_rate:.2f}"
+                for a in pool
+            ),
+        )
+        data = extract_json(
+            (await self.llm.generate_response([{"role": "user", "content": prompt}])).content
+        ) or {}
+        chosen = data.get("agent_id", "")
+        for agent in pool:
+            if agent.id == chosen:
+                return agent
+        return max(pool, key=lambda a: a.evaluate_task_suitability(task))
+
+    def __repr__(self) -> str:
+        return f"<BaseAgent {self.id[:8]} role={self.role} status={self.status.value}>"

@@ -237,7 +237,7 @@ class ServeConfig(BaseModel):
 class RouterConfig(BaseModel):
     """Task router configuration (reference: ``pilott/core/router.py:15-20``)."""
 
-    load_check_interval: float = Field(default=5.0, gt=0)  # score cache TTL
+    load_check_interval: float = Field(default=5.0, ge=0)  # score cache TTL (0 = no caching)
     load_threshold: float = Field(default=0.8, ge=0.0, le=1.0)
     route_timeout: float = Field(default=30.0, gt=0)
     route_attempts: int = Field(default=3, ge=1)

@@ -1,0 +1,660 @@
+"""Serve: the top-level orchestrator.
+
+Reference parity: ``pilott/pilott.py`` (697 LoC) — task intake with
+LLM analysis (``:184-221,569-601``), LLM decomposition into dependent
+subtasks (``:203,427-458``), bounded-concurrency processor loop
+(``:272-303``), agent selection → execution → LLM evaluation → retry
+(``:305-331,488-551``), queue overflow eviction (``:249-270``), cleanup/
+retention loop (``:358-367``), metrics (``:397-407``), callbacks (``:668``).
+
+Fixes over the reference (SURVEY §2.12-a): ONE coherent API supporting both
+constructor-injected agents and dynamic ``add_agent`` + ``execute_task``;
+priorities compare numerically; subtask dependency scheduling is real
+(BLOCKED tasks wait for their deps, failed deps cascade); side services
+(balancer/scaler/fault-tolerance) attach to the same lifecycle instead of
+floating unwired (§3.1).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import itertools
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from pilottai_tpu.core.agent import BaseAgent
+from pilottai_tpu.core.config import AgentConfig, LLMConfig, ServeConfig
+from pilottai_tpu.core.memory import Memory
+from pilottai_tpu.core.router import TaskRouter
+from pilottai_tpu.core.task import Task, TaskPriority, TaskResult, TaskStatus
+from pilottai_tpu.prompts.manager import PromptManager
+from pilottai_tpu.utils.json_utils import coerce_bool, extract_json
+from pilottai_tpu.utils.logging import get_logger
+from pilottai_tpu.utils.metrics import global_metrics
+from pilottai_tpu.utils.tracing import global_tracer
+
+TaskCallback = Callable[[Task, TaskResult], Any]
+
+
+class PriorityTaskQueue:
+    """Bounded max-priority queue with lowest-priority eviction.
+
+    The reference peeked ``asyncio.Queue``'s private ``_queue`` and compared
+    string priorities lexicographically to evict (``pilott.py:249-270``,
+    §2.12-h); this is the intended behavior done properly.
+    """
+
+    def __init__(self, maxsize: int) -> None:
+        self.maxsize = maxsize
+        self._heap: List[tuple] = []  # (-priority, seq, task)
+        self._ids: Dict[str, Task] = {}
+        self._seq = itertools.count()
+        self._not_empty = asyncio.Condition()
+
+    def __len__(self) -> int:
+        return len(self._ids)
+
+    async def put(self, task: Task) -> Optional[Task]:
+        """Insert; returns an evicted lower-priority task when full, or
+        raises if ``task`` itself is the lowest priority."""
+        evicted: Optional[Task] = None
+        async with self._not_empty:
+            if len(self._ids) >= self.maxsize:
+                worst = min(
+                    (t for t in self._ids.values()), key=lambda t: t.priority
+                )
+                if worst.priority >= task.priority:
+                    raise asyncio.QueueFull(
+                        f"queue full and task priority {task.priority.name} "
+                        "does not outrank queued work"
+                    )
+                self._ids.pop(worst.id)
+                worst.mark_cancelled()
+                evicted = worst
+            self._ids[task.id] = task
+            heapq.heappush(self._heap, (-int(task.priority), next(self._seq), task))
+            task.mark_queued()
+            self._not_empty.notify()
+        return evicted
+
+    async def get(self, timeout: Optional[float] = None) -> Optional[Task]:
+        async with self._not_empty:
+            if not self._ids:
+                try:
+                    await asyncio.wait_for(self._not_empty.wait(), timeout=timeout)
+                except asyncio.TimeoutError:
+                    return None
+            while self._heap:
+                _, _, task = heapq.heappop(self._heap)
+                if task.id in self._ids:  # skip tombstones (evicted/removed)
+                    self._ids.pop(task.id)
+                    return task
+            return None
+
+    def remove(self, task_id: str) -> Optional[Task]:
+        return self._ids.pop(task_id, None)
+
+    def snapshot(self) -> List[Task]:
+        return list(self._ids.values())
+
+
+class Serve:
+    """Hierarchical multi-agent orchestrator (the package's front door)."""
+
+    def __init__(
+        self,
+        name: str = "pilott-tpu",
+        agents: Optional[List[BaseAgent]] = None,
+        config: Optional[ServeConfig | Dict[str, Any]] = None,
+        manager_llm: Optional[Any] = None,       # LLMHandler for manager path
+        llm_config: Optional[LLMConfig] = None,  # or build one from config
+        manager_agent: Optional[BaseAgent] = None,
+        task_callback: Optional[TaskCallback] = None,
+    ) -> None:
+        if isinstance(config, dict):
+            config = ServeConfig(**config)
+        self.config = config or ServeConfig(name=name)
+        self.name = name or self.config.name
+        self.agents: Dict[str, BaseAgent] = {}
+        for agent in agents or []:
+            self.agents[agent.id] = agent
+        self.manager_agent = manager_agent
+        if manager_llm is None and llm_config is not None:
+            from pilottai_tpu.engine.handler import LLMHandler
+
+            manager_llm = LLMHandler(llm_config)
+        self.manager_llm = manager_llm
+        self.task_callback = task_callback
+
+        self.router = TaskRouter()
+        self.memory = Memory()
+        self.prompts = PromptManager("orchestrator")
+
+        self.task_queue = PriorityTaskQueue(self.config.max_queue_size)
+        self.all_tasks: Dict[str, Task] = {}
+        self.running_tasks: Dict[str, Task] = {}
+        self.completed_tasks: Dict[str, Task] = {}
+        self.failed_tasks: Dict[str, Task] = {}
+        self._blocked: Dict[str, Task] = {}
+        self._waiters: Dict[str, asyncio.Future] = {}
+        self._parent_children: Dict[str, List[str]] = {}
+
+        self.metrics: Dict[str, float] = {
+            "tasks_received": 0, "tasks_completed": 0, "tasks_failed": 0,
+            "tasks_retried": 0, "tasks_evicted": 0, "subtasks_created": 0,
+        }
+        self._running = False
+        self._bg_tasks: List[asyncio.Task] = []
+        # Strong refs for fire-and-forget tasks: the loop only keeps weak
+        # refs, so un-referenced tasks can be garbage-collected mid-run.
+        self._inflight: set = set()
+        self._exec_semaphore = asyncio.Semaphore(self.config.max_concurrent_tasks)
+        self._log = get_logger("serve", serve_name=self.name)
+
+        # Integrated side services (attached in start() when enabled).
+        self.load_balancer = None
+        self.dynamic_scaling = None
+        self.fault_tolerance = None
+
+    # ------------------------------------------------------------------ #
+    # Agent management (both API styles, fixing §2.12-a)
+    # ------------------------------------------------------------------ #
+
+    def add_agent(self, agent: BaseAgent) -> None:
+        if agent.id in self.agents:
+            raise ValueError(f"agent {agent.id} already added")
+        if agent.dependency_resolver is None:
+            agent.dependency_resolver = self.get_task
+        self.agents[agent.id] = agent
+        self.router.invalidate()
+
+    async def remove_agent(self, agent_id: str) -> Optional[BaseAgent]:
+        agent = self.agents.pop(agent_id, None)
+        if agent is not None:
+            await agent.stop()
+            self.router.invalidate(agent_id)
+        return agent
+
+    async def create_agent(
+        self, agent_type: str = "worker", config: Optional[AgentConfig] = None,
+        **kwargs: Any,
+    ) -> BaseAgent:
+        """Factory hook used by DynamicScaling (reference ``scaling`` calls
+        ``orchestrator.create_agent``, §2.12-b)."""
+        from pilottai_tpu.core.factory import AgentFactory
+
+        if "llm" not in kwargs and self.manager_llm is not None:
+            kwargs["llm"] = self.manager_llm
+        kwargs.setdefault("dependency_resolver", self.get_task)
+        agent = await AgentFactory.create_agent(agent_type, config, **kwargs)
+        self.add_agent(agent)
+        return agent
+
+    def agent_list(self) -> List[BaseAgent]:
+        return list(self.agents.values())
+
+    # ------------------------------------------------------------------ #
+    # Lifecycle (reference ``pilott.py:122-182``)
+    # ------------------------------------------------------------------ #
+
+    async def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        if self.manager_llm is not None:
+            await self.manager_llm.start()
+        for agent in self.agents.values():
+            agent.dependency_resolver = agent.dependency_resolver or self.get_task
+            await agent.start()
+        self._bg_tasks = [
+            asyncio.create_task(self._process_tasks(), name="serve-processor"),
+            asyncio.create_task(self._cleanup_loop(), name="serve-cleanup"),
+        ]
+        await self._start_services()
+        self._log.info("serve started with %d agents", len(self.agents))
+
+    async def _start_services(self) -> None:
+        if self.config.load_balancing_enabled:
+            from pilottai_tpu.orchestration.load_balancer import LoadBalancer
+
+            self.load_balancer = LoadBalancer(self)
+            await self.load_balancer.start()
+        if self.config.dynamic_scaling_enabled:
+            from pilottai_tpu.orchestration.scaling import DynamicScaling
+
+            self.dynamic_scaling = DynamicScaling(self)
+            await self.dynamic_scaling.start()
+        if self.config.fault_tolerance_enabled:
+            from pilottai_tpu.orchestration.fault_tolerance import FaultTolerance
+
+            self.fault_tolerance = FaultTolerance(self)
+            await self.fault_tolerance.start()
+
+    async def stop(self) -> None:
+        if not self._running:
+            return
+        self._running = False
+        for service in (self.load_balancer, self.dynamic_scaling, self.fault_tolerance):
+            if service is not None:
+                await service.stop()
+        for bg in self._bg_tasks:
+            bg.cancel()
+        await asyncio.gather(*self._bg_tasks, return_exceptions=True)
+        self._bg_tasks = []
+        for agent in self.agents.values():
+            await agent.stop()
+        if self.manager_llm is not None:
+            await self.manager_llm.stop()
+        self._log.info("serve stopped")
+
+    # ------------------------------------------------------------------ #
+    # Task intake (reference ``pilott.py:184-270``; stack §3.2)
+    # ------------------------------------------------------------------ #
+
+    def _coerce_task(self, task: Task | Dict[str, Any] | str) -> Task:
+        if isinstance(task, Task):
+            return task
+        if isinstance(task, str):
+            return Task(description=task)
+        data = dict(task)
+        if "description" not in data:
+            data["description"] = data.pop("task", None) or str(data)
+        known = set(Task.model_fields)
+        payload = {k: v for k, v in data.items() if k not in known}
+        kwargs = {k: v for k, v in data.items() if k in known}
+        if payload:
+            kwargs.setdefault("payload", {}).update(payload)
+        return Task(**kwargs)
+
+    async def add_task(self, task: Task | Dict[str, Any] | str) -> Task:
+        """Analyze, maybe decompose, and queue. Returns the (parent) Task."""
+        task = self._coerce_task(task)
+        self.all_tasks[task.id] = task
+        self.metrics["tasks_received"] += 1
+        self._waiters.setdefault(task.id, asyncio.get_running_loop().create_future())
+
+        analysis = await self._analyze_task(task)
+        if (
+            self.config.decomposition_enabled
+            and coerce_bool(analysis.get("requires_decomposition", False))
+        ):
+            await self._handle_complex_task(task, analysis)
+        else:
+            await self._queue_task(task)
+        return task
+
+    async def _queue_task(self, task: Task) -> None:
+        try:
+            evicted = await self.task_queue.put(task)
+        except asyncio.QueueFull:
+            task.mark_failed("queue full")
+            self._finalize(task, TaskResult(success=False, error="queue full"))
+            return
+        if evicted is not None:
+            self.metrics["tasks_evicted"] += 1
+            self._finalize(
+                evicted,
+                TaskResult(success=False, error="evicted by higher-priority task"),
+            )
+
+    def _spawn(self, coro) -> asyncio.Task:
+        """create_task with a strong reference until completion."""
+        t = asyncio.ensure_future(coro)
+        self._inflight.add(t)
+        t.add_done_callback(self._inflight.discard)
+        return t
+
+    async def _analyze_task(self, task: Task) -> Dict[str, Any]:
+        """Manager-LLM analysis (reference ``:569-601``); graceful default
+        when no manager LLM is configured. Skipped entirely when
+        decomposition is disabled — the analysis' only consumer is the
+        decomposition gate, so the LLM round-trip would be wasted."""
+        if self.manager_llm is None or not self.config.decomposition_enabled:
+            return {"requires_decomposition": False, "complexity": task.complexity}
+        prompt = self.prompts.format_prompt("task_analysis", task=task.to_prompt())
+        try:
+            content = await self.manager_llm.apredict(prompt)
+            data = extract_json(content) or {}
+        except Exception as exc:  # noqa: BLE001 - analysis is advisory
+            self._log.warning("task analysis failed: %s", exc)
+            return {"requires_decomposition": False, "complexity": task.complexity}
+        complexity = data.get("complexity", task.complexity)
+        if isinstance(complexity, (int, float)) and 1 <= complexity <= 10:
+            task.complexity = int(complexity)
+        return data
+
+    async def _handle_complex_task(self, task: Task, analysis: Dict[str, Any]) -> None:
+        """LLM decomposition into dependent subtasks (reference ``:427-458``)."""
+        prompt = self.prompts.format_prompt("task_decomposition", task=task.to_prompt())
+        try:
+            content = await self.manager_llm.apredict(prompt)
+            data = extract_json(content) or {}
+            raw_subtasks = data.get("subtasks") or []
+        except Exception as exc:  # noqa: BLE001 - fall back to simple path
+            self._log.warning("decomposition failed (%s); queueing as simple", exc)
+            raw_subtasks = []
+        if not raw_subtasks:
+            await self._queue_task(task)
+            return
+
+        subtasks: List[Task] = []
+        for spec in raw_subtasks:
+            sub = Task(
+                description=spec.get("description", task.description),
+                type=spec.get("type", task.type),
+                priority=TaskPriority.coerce(spec.get("priority", task.priority)),
+                parent_task_id=task.id,
+                payload=task.payload,
+                timeout=task.timeout,
+            )
+            deps = spec.get("depends_on", []) or []
+            sub.dependencies = [
+                subtasks[i].id for i in deps if isinstance(i, int) and i < len(subtasks)
+            ]
+            subtasks.append(sub)
+        task.subtasks = [s.id for s in subtasks]
+        self._parent_children[task.id] = [s.id for s in subtasks]
+        task.status = TaskStatus.BLOCKED
+        self.metrics["subtasks_created"] += len(subtasks)
+        for sub in subtasks:
+            self.all_tasks[sub.id] = sub
+            self._waiters.setdefault(
+                sub.id, asyncio.get_running_loop().create_future()
+            )
+            await self._queue_task(sub)
+
+    # ------------------------------------------------------------------ #
+    # Execution API (reference §2.12-a: exposed by README/tests but absent
+    # on the real class; first-class here)
+    # ------------------------------------------------------------------ #
+
+    async def execute_task(
+        self, task: Task | Dict[str, Any] | str, timeout: Optional[float] = None
+    ) -> TaskResult:
+        """Submit and wait for the final result."""
+        task = await self.add_task(task)
+        return await self.wait_for(task.id, timeout=timeout)
+
+    async def execute(
+        self, tasks: List[Task | Dict[str, Any] | str]
+    ) -> List[TaskResult]:
+        submitted = [await self.add_task(t) for t in tasks]
+        return list(
+            await asyncio.gather(*[self.wait_for(t.id) for t in submitted])
+        )
+
+    async def wait_for(self, task_id: str, timeout: Optional[float] = None) -> TaskResult:
+        future = self._waiters.setdefault(
+            task_id, asyncio.get_running_loop().create_future()
+        )
+        return await asyncio.wait_for(
+            asyncio.shield(future), timeout=timeout or self.config.task_timeout * 4
+        )
+
+    def get_task(self, task_id: str) -> Optional[Task]:
+        return self.all_tasks.get(task_id)
+
+    def get_result(self, task_id: str) -> Optional[TaskResult]:
+        task = self.all_tasks.get(task_id)
+        return task.result if task else None
+
+    # ------------------------------------------------------------------ #
+    # Processor loop (reference ``:272-356``; stack §3.3)
+    # ------------------------------------------------------------------ #
+
+    async def _process_tasks(self) -> None:
+        while self._running:
+            try:
+                task = await self.task_queue.get(timeout=0.2)
+                if task is None:
+                    continue
+                if task.status == TaskStatus.CANCELLED:
+                    continue
+                ready, failed_dep = self._deps_state(task)
+                if failed_dep is not None:
+                    self._finalize(
+                        task,
+                        TaskResult(
+                            success=False,
+                            error=f"dependency {failed_dep} failed",
+                        ),
+                    )
+                    continue
+                if not ready:
+                    task.status = TaskStatus.BLOCKED
+                    self._blocked[task.id] = task
+                    continue
+                self._spawn(self._execute_with_limit(task))
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - keep the loop alive
+                self._log.error("processor loop error: %s", exc, exc_info=True)
+                await asyncio.sleep(0.1)
+
+    def _deps_state(self, task: Task) -> tuple:
+        """(all_completed, first_failed_dep_or_None)."""
+        for dep_id in task.dependencies:
+            dep = self.all_tasks.get(dep_id)
+            if dep is None:
+                continue
+            if dep.status in (TaskStatus.FAILED, TaskStatus.CANCELLED):
+                return False, dep_id
+            if dep.status != TaskStatus.COMPLETED:
+                return False, None
+        return True, None
+
+    async def _execute_with_limit(self, task: Task) -> None:
+        async with self._exec_semaphore:
+            try:
+                await asyncio.wait_for(
+                    self._execute_task(task), timeout=self.config.task_timeout
+                )
+            except asyncio.TimeoutError:
+                self._finalize(
+                    task,
+                    TaskResult(
+                        success=False,
+                        error=f"orchestrator timeout after {self.config.task_timeout}s",
+                    ),
+                )
+            except Exception as exc:  # noqa: BLE001 - task boundary
+                self._log.error("execution error for %s: %s", task.id[:8], exc)
+                self._finalize(task, TaskResult(success=False, error=str(exc)))
+
+    async def _execute_task(self, task: Task) -> None:
+        with global_tracer.span("serve.execute_task", task_id=task.id):
+            agent = await self._select_agent(task)
+            if agent is None:
+                self._finalize(
+                    task, TaskResult(success=False, error="no available agent")
+                )
+                return
+            self.running_tasks[task.id] = task
+            try:
+                result = await agent.execute_task(task)
+                result = await self._maybe_retry(task, result)
+            finally:
+                self.running_tasks.pop(task.id, None)
+            self._finalize(task, result)
+
+    async def _select_agent(self, task: Task) -> Optional[BaseAgent]:
+        """Manager hook first, router second (reference ``:488-504``)."""
+        candidates = self.agent_list()
+        if self.manager_agent is not None:
+            chosen = await self.manager_agent.select_agent(task, candidates)
+            if chosen is not None:
+                return chosen
+        return await self.router.route_task(task, candidates)
+
+    async def _maybe_retry(self, task: Task, result: TaskResult) -> TaskResult:
+        """LLM evaluation + bounded retry (reference ``:506-551``)."""
+        needs_retry = not result.success
+        if (
+            result.success
+            and self.config.evaluation_enabled
+            and self.manager_llm is not None
+        ):
+            try:
+                prompt = self.prompts.format_prompt(
+                    "result_evaluation",
+                    task=task.to_prompt(),
+                    agent_id=task.agent_id or "unknown",
+                    result=str(result.output)[:2000],
+                )
+                evaluation = extract_json(await self.manager_llm.apredict(prompt)) or {}
+                needs_retry = coerce_bool(evaluation.get("requires_retry", False))
+                result.metadata["orchestrator_evaluation"] = evaluation
+            except Exception as exc:  # noqa: BLE001 - evaluation is advisory
+                self._log.warning("result evaluation failed: %s", exc)
+        retries = 0
+        while needs_retry and retries < self.config.max_retry_attempts:
+            if not task.prepare_retry():
+                break
+            retries += 1
+            self.metrics["tasks_retried"] += 1
+            agent = await self._select_agent(task)
+            if agent is None:
+                break
+            task.mark_started(agent_id=agent.id)
+            result = await agent.execute_task(task)
+            needs_retry = not result.success
+        return result
+
+    # ------------------------------------------------------------------ #
+    # Completion plumbing
+    # ------------------------------------------------------------------ #
+
+    def _finalize(self, task: Task, result: TaskResult) -> None:
+        if result.success:
+            if task.status != TaskStatus.COMPLETED:
+                task.mark_completed(result)
+            self.completed_tasks[task.id] = task
+            self.metrics["tasks_completed"] += 1
+        else:
+            if task.status not in (TaskStatus.FAILED, TaskStatus.CANCELLED):
+                task.mark_failed(result.error or "failed", result)
+            self.failed_tasks[task.id] = task
+            self.metrics["tasks_failed"] += 1
+
+        waiter = self._waiters.get(task.id)
+        if waiter is not None and not waiter.done():
+            waiter.set_result(result)
+
+        self._spawn(self._post_completion(task, result))
+
+    async def _post_completion(self, task: Task, result: TaskResult) -> None:
+        # Memory record (reference ``:653-666``).
+        try:
+            await self.memory.store(
+                {
+                    "task_id": task.id,
+                    "type": task.type,
+                    "success": result.success,
+                    "agent_id": task.agent_id,
+                    "execution_time": result.execution_time,
+                },
+                tags={"task_execution", task.type},
+            )
+        except Exception:  # noqa: BLE001 - memory is best-effort
+            pass
+        # Callback (reference ``:668-676``).
+        if self.task_callback is not None:
+            try:
+                maybe = self.task_callback(task, result)
+                if asyncio.iscoroutine(maybe):
+                    await maybe
+            except Exception as exc:  # noqa: BLE001
+                self._log.warning("task callback failed: %s", exc)
+        # Unblock dependents.
+        self._requeue_unblocked()
+        # Parent aggregation.
+        if task.parent_task_id:
+            await self._check_parent(task.parent_task_id)
+
+    def _requeue_unblocked(self) -> None:
+        for tid in list(self._blocked):
+            task = self._blocked[tid]
+            ready, failed_dep = self._deps_state(task)
+            if failed_dep is not None:
+                del self._blocked[tid]
+                self._finalize(
+                    task,
+                    TaskResult(success=False, error=f"dependency {failed_dep} failed"),
+                )
+            elif ready:
+                del self._blocked[tid]
+                task.status = TaskStatus.PENDING
+                self._spawn(self._queue_task(task))
+
+    async def _check_parent(self, parent_id: str) -> None:
+        children_ids = self._parent_children.get(parent_id)
+        parent = self.all_tasks.get(parent_id)
+        if not children_ids or parent is None or parent.status.is_terminal:
+            return
+        children = [self.all_tasks[c] for c in children_ids if c in self.all_tasks]
+        if any(t.status in (TaskStatus.FAILED, TaskStatus.CANCELLED) for t in children):
+            failed = [t.id for t in children if t.status == TaskStatus.FAILED]
+            self._finalize(
+                parent,
+                TaskResult(success=False, error=f"subtasks failed: {failed}"),
+            )
+            return
+        if all(t.status == TaskStatus.COMPLETED for t in children):
+            outputs = [
+                t.result.output if t.result else None for t in children
+            ]
+            self._finalize(
+                parent,
+                TaskResult(
+                    success=True,
+                    output=outputs,
+                    metadata={"subtask_ids": children_ids},
+                ),
+            )
+
+    # ------------------------------------------------------------------ #
+    # Cleanup / retention (reference ``:358-367``)
+    # ------------------------------------------------------------------ #
+
+    async def _cleanup_loop(self) -> None:
+        while self._running:
+            await asyncio.sleep(self.config.cleanup_interval)
+            self.cleanup_once()
+
+    def cleanup_once(self) -> int:
+        cutoff = time.time() - self.config.task_retention
+        dropped = 0
+        for store in (self.completed_tasks, self.failed_tasks):
+            for tid in list(store):
+                task = store[tid]
+                if task.completed_at is not None and task.completed_at < cutoff:
+                    del store[tid]
+                    self.all_tasks.pop(tid, None)
+                    self._waiters.pop(tid, None)
+                    self._parent_children.pop(tid, None)
+                    dropped += 1
+        return dropped
+
+    # ------------------------------------------------------------------ #
+
+    def get_metrics(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "running": self._running,
+            "agents": len(self.agents),
+            "queued": len(self.task_queue),
+            "blocked": len(self._blocked),
+            "running_tasks": len(self.running_tasks),
+            **{k: v for k, v in self.metrics.items()},
+            "agent_metrics": {
+                aid[:8]: a.get_metrics() for aid, a in self.agents.items()
+            },
+            "engine": (
+                self.manager_llm.get_metrics() if self.manager_llm is not None else None
+            ),
+            "steps_per_sec": global_metrics.rate("agent.steps"),
+        }
+
+    def __repr__(self) -> str:
+        return f"<Serve {self.name} agents={len(self.agents)} running={self._running}>"

@@ -1,0 +1,24 @@
+"""Tool system: user-supplied callables with timeout/retry/concurrency
+control and metrics.
+
+Reference parity: ``pilott/tools/`` (``tools/__init__.py:1-8`` exports
+Tool + the error hierarchy).
+"""
+
+from pilottai_tpu.tools.errors import (
+    ToolError,
+    ToolPermissionError,
+    ToolTimeoutError,
+    ToolValidationError,
+)
+from pilottai_tpu.tools.tool import Tool, ToolMetrics, ToolRegistry
+
+__all__ = [
+    "Tool",
+    "ToolMetrics",
+    "ToolRegistry",
+    "ToolError",
+    "ToolTimeoutError",
+    "ToolPermissionError",
+    "ToolValidationError",
+]

@@ -1,0 +1,23 @@
+"""Tool error hierarchy (reference: ``pilott/tools/tool.py:203-217``)."""
+
+from __future__ import annotations
+
+
+class ToolError(Exception):
+    """Base error for tool execution failures."""
+
+    def __init__(self, message: str, tool_name: str = "") -> None:
+        super().__init__(message)
+        self.tool_name = tool_name
+
+
+class ToolTimeoutError(ToolError):
+    """Tool exceeded its execution timeout."""
+
+
+class ToolPermissionError(ToolError):
+    """Caller lacks a permission the tool requires."""
+
+
+class ToolValidationError(ToolError):
+    """Arguments failed the tool's parameter validation."""

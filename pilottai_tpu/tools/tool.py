@@ -1,0 +1,269 @@
+"""Tool: a guarded wrapper around a user-supplied callable.
+
+Reference parity: ``pilott/tools/tool.py`` — timeout=30s, retries with
+backoff, cooldown, ``max_concurrent`` semaphore, enable/disable, execution
+dedupe, per-error-type metrics (``:15-48,65-146,174-201``). Sync callables
+run via ``asyncio.to_thread`` so they never block the event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from pilottai_tpu.tools.errors import (
+    ToolError,
+    ToolPermissionError,
+    ToolTimeoutError,
+    ToolValidationError,
+)
+from pilottai_tpu.utils.logging import get_logger
+from pilottai_tpu.utils.metrics import global_metrics
+
+
+@dataclass
+class ToolMetrics:
+    """Rollup of executions (reference ``tool.py:15-23,126-146``)."""
+
+    calls: int = 0
+    successes: int = 0
+    failures: int = 0
+    total_time: float = 0.0
+    errors_by_type: Dict[str, int] = field(default_factory=dict)
+    last_used: Optional[float] = None
+
+    @property
+    def success_rate(self) -> float:
+        return self.successes / self.calls if self.calls else 1.0
+
+    @property
+    def avg_time(self) -> float:
+        return self.total_time / self.calls if self.calls else 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "calls": self.calls,
+            "successes": self.successes,
+            "failures": self.failures,
+            "success_rate": self.success_rate,
+            "avg_time": self.avg_time,
+            "errors_by_type": dict(self.errors_by_type),
+        }
+
+
+class Tool:
+    """An executable capability an agent may invoke during its step loop."""
+
+    def __init__(
+        self,
+        name: str,
+        function: Callable[..., Any],
+        description: str = "",
+        parameters: Optional[Dict[str, Any]] = None,  # JSON schema
+        required_permissions: Optional[Set[str]] = None,
+        required_capabilities: Optional[Set[str]] = None,
+        timeout: float = 30.0,
+        retries: int = 3,
+        retry_delay: float = 1.0,
+        cooldown: float = 0.0,
+        max_concurrent: int = 4,
+    ) -> None:
+        self.name = name
+        self.function = function
+        self.description = description
+        self.parameters = parameters or {}
+        self.required_permissions = set(required_permissions or ())
+        self.required_capabilities = set(required_capabilities or ())
+        self.timeout = timeout
+        self.retries = retries
+        self.retry_delay = retry_delay
+        self.cooldown = cooldown
+        self.enabled = True
+        self.metrics = ToolMetrics()
+        self._semaphore = asyncio.Semaphore(max_concurrent)
+        self._last_finished = 0.0
+        self._seen_executions: Set[str] = set()
+        self._log = get_logger("tools", tool=name)
+        # Per-tool lock used by agents for sorted-order acquisition
+        # (deadlock-free multi-tool steps, reference ``core/agent.py:181-185``).
+        self.lock = asyncio.Lock()
+
+    # ------------------------------------------------------------------ #
+
+    def _check_ready(self, permissions: Set[str]) -> None:
+        if not self.enabled:
+            raise ToolError(f"tool {self.name!r} is disabled", self.name)
+        if self.cooldown > 0 and time.monotonic() - self._last_finished < self.cooldown:
+            raise ToolError(f"tool {self.name!r} is cooling down", self.name)
+        missing = self.required_permissions - permissions
+        if missing:
+            raise ToolPermissionError(
+                f"tool {self.name!r} requires permissions {sorted(missing)}",
+                self.name,
+            )
+
+    def _validate_args(self, arguments: Dict[str, Any]) -> None:
+        """Shallow JSON-schema check: required keys + primitive types."""
+        schema = self.parameters
+        if not schema:
+            return
+        required = schema.get("required", [])
+        missing = [k for k in required if k not in arguments]
+        if missing:
+            raise ToolValidationError(
+                f"tool {self.name!r} missing required arguments {missing}",
+                self.name,
+            )
+        props = schema.get("properties", {})
+        type_map = {
+            "string": str,
+            "number": (int, float),
+            "integer": int,
+            "boolean": bool,
+            "array": list,
+            "object": dict,
+        }
+        for key, value in arguments.items():
+            spec = props.get(key)
+            if not spec or "type" not in spec:
+                continue
+            expected = type_map.get(spec["type"])
+            if expected and not isinstance(value, expected):
+                raise ToolValidationError(
+                    f"tool {self.name!r} argument {key!r} should be "
+                    f"{spec['type']}, got {type(value).__name__}",
+                    self.name,
+                )
+
+    async def _call(self, arguments: Dict[str, Any]) -> Any:
+        if inspect.iscoroutinefunction(self.function):
+            return await self.function(**arguments)
+        return await asyncio.to_thread(self.function, **arguments)
+
+    async def execute(
+        self,
+        arguments: Optional[Dict[str, Any]] = None,
+        permissions: Optional[Set[str]] = None,
+        execution_id: Optional[str] = None,
+    ) -> Any:
+        """Run the tool with dedupe, retry, timeout and concurrency cap."""
+        arguments = arguments or {}
+        execution_id = execution_id or str(uuid.uuid4())
+        if execution_id in self._seen_executions:
+            raise ToolError(
+                f"duplicate execution id {execution_id!r} for tool {self.name!r}",
+                self.name,
+            )
+        self._seen_executions.add(execution_id)
+        if len(self._seen_executions) > 10000:
+            self._seen_executions = set(list(self._seen_executions)[-5000:])
+
+        self._check_ready(permissions or set())
+        self._validate_args(arguments)
+
+        start = time.perf_counter()
+        last_error: Optional[Exception] = None
+        try:
+            async with self._semaphore:
+                for attempt in range(self.retries + 1):
+                    try:
+                        result = await asyncio.wait_for(
+                            self._call(arguments), timeout=self.timeout
+                        )
+                        self._record(True, start)
+                        return result
+                    except asyncio.TimeoutError:
+                        last_error = ToolTimeoutError(
+                            f"tool {self.name!r} timed out after {self.timeout}s",
+                            self.name,
+                        )
+                    except (ToolValidationError, ToolPermissionError):
+                        raise  # non-retryable
+                    except Exception as exc:  # noqa: BLE001 - retry boundary
+                        last_error = exc
+                    if attempt < self.retries:
+                        await asyncio.sleep(self.retry_delay * (attempt + 1))
+            self._record(False, start, last_error)
+            raise last_error if last_error else ToolError("unknown failure", self.name)
+        except (ToolValidationError, ToolPermissionError):
+            self._record(False, start, last_error)
+            raise
+        finally:
+            self._last_finished = time.monotonic()
+
+    def _record(self, success: bool, start: float, error: Optional[Exception] = None) -> None:
+        elapsed = time.perf_counter() - start
+        self.metrics.calls += 1
+        self.metrics.total_time += elapsed
+        self.metrics.last_used = time.time()
+        global_metrics.observe(f"tool.{self.name}.latency", elapsed)
+        if success:
+            self.metrics.successes += 1
+        else:
+            self.metrics.failures += 1
+            if error is not None:
+                key = type(error).__name__
+                self.metrics.errors_by_type[key] = (
+                    self.metrics.errors_by_type.get(key, 0) + 1
+                )
+
+    # ------------------------------------------------------------------ #
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    @property
+    def success_rate(self) -> float:
+        return self.metrics.success_rate
+
+    def to_spec(self) -> Dict[str, Any]:
+        """ToolSpec-compatible dict for the engine's function calling."""
+        return {
+            "name": self.name,
+            "description": self.description,
+            "parameters": self.parameters,
+        }
+
+    def get_metrics(self) -> Dict[str, Any]:
+        return {"name": self.name, "enabled": self.enabled, **self.metrics.to_dict()}
+
+
+class ToolRegistry:
+    """Named tool collection shared by agents."""
+
+    def __init__(self, tools: Optional[List[Tool]] = None) -> None:
+        self._tools: Dict[str, Tool] = {}
+        for tool in tools or []:
+            self.register(tool)
+
+    def register(self, tool: Tool) -> None:
+        if tool.name in self._tools:
+            raise ValueError(f"tool {tool.name!r} already registered")
+        self._tools[tool.name] = tool
+
+    def get(self, name: str) -> Tool:
+        if name not in self._tools:
+            raise KeyError(f"unknown tool {name!r}; available: {sorted(self._tools)}")
+        return self._tools[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._tools
+
+    def names(self) -> List[str]:
+        return sorted(self._tools)
+
+    def subset(self, names: List[str]) -> List[Tool]:
+        return [self._tools[n] for n in names if n in self._tools]
+
+    def describe(self) -> str:
+        return "\n".join(
+            f"{t.name}: {t.description or 'no description'}"
+            for t in self._tools.values()
+        )

@@ -1,0 +1,101 @@
+"""Tolerant JSON extraction from LLM output.
+
+The structured-JSON prompt protocol (prompts/rules.yaml) makes JSON the
+wire format between model and runtime. Models wrap JSON in prose and
+``` fences; the reference's orchestrator had a tolerant parser
+(``pilott/pilott.py:603-639``) while its agent used strict ``json.loads``
+(``core/agent.py:397-402``) and a broken recursive regex (``(?R)``,
+SURVEY.md §2.12-h). Here one tolerant parser serves every call site, with a
+real brace-scanner instead of regex recursion.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, List, Optional
+
+_FENCE_RE = re.compile(r"```(?:json)?\s*(.*?)```", re.DOTALL)
+
+
+def _balanced_spans(text: str) -> List[str]:
+    """All top-level {...} spans, found by brace scanning (string-aware)."""
+    spans: List[str] = []
+    depth = 0
+    start = -1
+    in_string = False
+    escape = False
+    for i, ch in enumerate(text):
+        if in_string:
+            if escape:
+                escape = False
+            elif ch == "\\":
+                escape = True
+            elif ch == '"':
+                in_string = False
+            continue
+        if ch == '"':
+            if depth > 0:
+                in_string = True
+            continue
+        if ch == "{":
+            if depth == 0:
+                start = i
+            depth += 1
+        elif ch == "}":
+            if depth > 0:
+                depth -= 1
+                if depth == 0 and start >= 0:
+                    spans.append(text[start : i + 1])
+                    start = -1
+    return spans
+
+
+def extract_json(text: str) -> Optional[Dict[str, Any]]:
+    """Best-effort: parse ``text`` as a JSON object.
+
+    Order: whole text → fenced blocks → balanced brace spans (longest
+    first). Returns None when nothing parses.
+    """
+    if not text:
+        return None
+    candidates: List[str] = [text.strip()]
+    candidates += [m.strip() for m in _FENCE_RE.findall(text)]
+    candidates += sorted(_balanced_spans(text), key=len, reverse=True)
+    for candidate in candidates:
+        try:
+            obj = json.loads(candidate)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict):
+            return obj
+    return None
+
+
+def require_fields(
+    obj: Optional[Dict[str, Any]],
+    fields: Dict[str, type | tuple],
+    context: str = "LLM response",
+) -> Dict[str, Any]:
+    """Validate presence and types of protocol fields (reference validates
+    orchestrator analysis fields at ``pilott/pilott.py:584-597``)."""
+    if obj is None:
+        raise ValueError(f"{context}: no JSON object found")
+    missing = [f for f in fields if f not in obj]
+    if missing:
+        raise ValueError(f"{context}: missing fields {missing}")
+    for name, expected in fields.items():
+        if not isinstance(obj[name], expected):
+            raise ValueError(
+                f"{context}: field {name!r} has type "
+                f"{type(obj[name]).__name__}, expected {expected}"
+            )
+    return obj
+
+
+def coerce_bool(value: Any) -> bool:
+    if isinstance(value, bool):
+        return value
+    if isinstance(value, str):
+        return value.strip().lower() in ("true", "yes", "1")
+    return bool(value)

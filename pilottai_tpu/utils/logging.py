@@ -113,12 +113,24 @@ def setup_logging(config: Optional[LogConfig] = None) -> None:
     _configured = True
 
 
+# Logger.makeRecord rejects ANY extra key already present on LogRecord, so
+# derive the reserved set from a real record rather than hand-listing.
+_RESERVED_KEYS = set(logging.makeLogRecord({}).__dict__) | {"message", "asctime"}
+
+
 def get_logger(component: str, **context: Any) -> logging.LoggerAdapter:
-    """Component logger carrying structured context (agent_id, task_id...)."""
+    """Component logger carrying structured context (agent_id, task_id...).
+
+    Context keys colliding with LogRecord internals are prefixed rather
+    than raising KeyError at log time.
+    """
     if not _configured:
         setup_logging()
     logger = logging.getLogger(f"{_ROOT_NAME}.{component}")
-    return logging.LoggerAdapter(logger, {"component": component, **context})
+    safe = {
+        (f"ctx_{k}" if k in _RESERVED_KEYS else k): v for k, v in context.items()
+    }
+    return logging.LoggerAdapter(logger, {"component": component, **safe})
 
 
 class LogContext:

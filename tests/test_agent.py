@@ -1,0 +1,166 @@
+"""BaseAgent tests: reasoning loop on the mock engine, hierarchy, tools,
+health/suitability surface."""
+
+import asyncio
+
+import pytest
+
+from pilottai_tpu.core.agent import BaseAgent
+from pilottai_tpu.core.config import AgentConfig, LLMConfig
+from pilottai_tpu.core.status import AgentStatus
+from pilottai_tpu.core.task import Task, TaskStatus
+from pilottai_tpu.engine.handler import LLMHandler
+from pilottai_tpu.engine.mock import MockBackend
+from pilottai_tpu.tools.tool import Tool
+
+
+def make_agent(**kwargs):
+    backend = kwargs.pop("backend", None) or MockBackend()
+    handler = LLMHandler(LLMConfig(provider="mock"), backend=backend)
+    cfg = kwargs.pop("config", None) or AgentConfig(role="worker")
+    return BaseAgent(config=cfg, llm=handler, **kwargs), backend
+
+
+def test_agent_requires_llm():
+    with pytest.raises(ValueError, match="requires an llm"):
+        BaseAgent(config=AgentConfig())
+
+
+@pytest.mark.asyncio
+async def test_agent_executes_simple_task():
+    agent, backend = make_agent()
+    await agent.start()
+    assert agent.status == AgentStatus.IDLE
+    result = await agent.execute_task(Task(description="compute something"))
+    assert result.success
+    assert "completed" in str(result.output)
+    assert agent.task_metrics["completed"] == 1
+    assert agent.status == AgentStatus.IDLE
+    # Full protocol ran: analysis, step planning, evaluation.
+    joined = "\n".join(backend.calls)
+    assert '"understanding"' in joined and '"task_complete"' in joined
+
+
+@pytest.mark.asyncio
+async def test_agent_runs_tool_step():
+    calls = []
+
+    def adder(a=0, b=0):
+        calls.append((a, b))
+        return a + b
+
+    tool = Tool(name="adder", function=adder, description="adds numbers")
+
+    def responder(prompt):
+        if '"task_complete"' in prompt:
+            if not calls:
+                return {"task_complete": False, "action": "adder",
+                        "arguments": {"a": 2, "b": 3}, "output": "", "reasoning": ""}
+            return {"task_complete": True, "action": "respond", "arguments": {},
+                    "output": f"sum={calls[-1]}", "reasoning": ""}
+        return None
+
+    backend = MockBackend(responders=[responder])
+    agent, _ = make_agent(backend=backend, tools=[tool])
+    await agent.start()
+    result = await agent.execute_task(Task(description="add 2 and 3", tools=["adder"]))
+    assert result.success
+    assert calls == [(2, 3)]
+    assert "adder" in result.metadata["tools_used"]
+
+
+@pytest.mark.asyncio
+async def test_agent_step_loop_bounded_by_max_iterations():
+    backend = MockBackend(steps_to_complete=10**9)  # never completes
+    agent, _ = make_agent(
+        backend=backend, config=AgentConfig(role="worker", max_iterations=3)
+    )
+    await agent.start()
+    result = await agent.execute_task(Task(description="endless"))
+    # Loop must stop after 3 iterations, not hang.
+    assert len(result.metadata["steps"]) == 3
+
+
+@pytest.mark.asyncio
+async def test_agent_dependency_validation():
+    agent, _ = make_agent()
+    await agent.start()
+    dep = Task(description="dep")
+    registry = {dep.id: dep}
+    agent.dependency_resolver = registry.get
+    task = Task(description="main", dependencies=[dep.id])
+    result = await agent.execute_task(task)
+    assert not result.success and "not completed" in result.error
+    dep.mark_started()
+    dep.mark_completed(__import__("pilottai_tpu").TaskResult(success=True))
+    task2 = Task(description="main2", dependencies=[dep.id])
+    result2 = await agent.execute_task(task2)
+    assert result2.success
+
+
+@pytest.mark.asyncio
+async def test_agent_failure_counts_and_health():
+    backend = MockBackend(fail_pattern="poison")
+    agent, _ = make_agent(backend=backend)
+    agent.llm.config.retries = 0
+    await agent.start()
+    result = await agent.execute_task(Task(description="poison pill"))
+    assert not result.success
+    assert agent.task_metrics["failed"] == 1
+    health = agent.get_health()
+    assert health["error_count"] == 1
+    assert agent.success_rate == 0.0
+
+
+def test_hierarchy_add_remove_and_cycle_guard():
+    parent, _ = make_agent()
+    child, _ = make_agent()
+    grandchild, _ = make_agent()
+    parent.add_child_agent(child)
+    child.add_child_agent(grandchild)
+    assert child.parent is parent
+    assert {a.id for a in parent.descendants()} == {child.id, grandchild.id}
+    with pytest.raises(ValueError, match="cycle"):
+        grandchild.add_child_agent(parent)
+    with pytest.raises(ValueError, match="already a child"):
+        parent.add_child_agent(child)
+    removed = parent.remove_child_agent(child.id)
+    assert removed is child and child.parent is None
+
+
+def test_hierarchy_respects_max_children():
+    parent, _ = make_agent(config=AgentConfig(role="m", max_child_agents=1))
+    parent.add_child_agent(make_agent()[0])
+    with pytest.raises(RuntimeError, match="max_child_agents"):
+        parent.add_child_agent(make_agent()[0])
+
+
+@pytest.mark.asyncio
+async def test_suitability_scoring():
+    agent, _ = make_agent(
+        config=AgentConfig(role="w", specializations=["extract"])
+    )
+    await agent.start()
+    specialized = Task(description="x", type="extract")
+    generic = Task(description="x", type="other")
+    assert agent.evaluate_task_suitability(specialized) > \
+        agent.evaluate_task_suitability(generic)
+    missing_caps = Task(description="x", required_capabilities=["gpu_magic"])
+    assert agent.evaluate_task_suitability(missing_caps) <= 0.1
+    await agent.stop()
+    assert agent.evaluate_task_suitability(generic) == 0.0
+
+
+@pytest.mark.asyncio
+async def test_heartbeat_and_queue_surface():
+    agent, _ = make_agent()
+    await agent.start()
+    before = agent._last_heartbeat
+    await asyncio.sleep(0.01)
+    assert agent.send_heartbeat() > before
+    task = Task(description="queued work")
+    await agent.add_task(task)
+    assert task.status == TaskStatus.QUEUED
+    assert agent.queued_tasks() == [task]
+    moved = agent.remove_task(task.id)
+    assert moved is task and moved.agent_id is None

@@ -1,0 +1,122 @@
+"""AgentFactory and TaskRouter tests (reference test strategy: SURVEY §4 —
+registry validation, creation timeout, cleanup idempotence; routing by
+forced metric inputs)."""
+
+import asyncio
+
+import pytest
+
+from pilottai_tpu.core.agent import BaseAgent
+from pilottai_tpu.core.config import AgentConfig, LLMConfig, RouterConfig
+from pilottai_tpu.core.factory import AgentFactory
+from pilottai_tpu.core.router import TaskRouter
+from pilottai_tpu.core.task import Task, TaskPriority
+from pilottai_tpu.engine.handler import LLMHandler
+
+
+def mock_llm():
+    return LLMHandler(LLMConfig(provider="mock"))
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    saved = dict(AgentFactory._agent_types)
+    yield
+    AgentFactory._agent_types = saved
+    asyncio.run(AgentFactory.cleanup_all_agents())
+
+
+class SlowAgent(BaseAgent):
+    async def start(self):
+        await asyncio.sleep(60)
+
+
+def test_register_validates_class():
+    with pytest.raises(TypeError):
+        AgentFactory.register_agent_type("bad", dict)  # type: ignore[arg-type]
+    with pytest.raises(ValueError, match="already registered"):
+        AgentFactory.register_agent_type("worker", BaseAgent)
+
+
+@pytest.mark.asyncio
+async def test_create_agent_with_default_config():
+    agent = await AgentFactory.create_agent("worker", llm=mock_llm())
+    assert agent.config.role == "worker"
+    assert agent.id in AgentFactory.active_agents()
+
+
+@pytest.mark.asyncio
+async def test_create_unknown_type():
+    with pytest.raises(KeyError, match="unknown agent type"):
+        await AgentFactory.create_agent("nope", llm=mock_llm())
+
+
+@pytest.mark.asyncio
+async def test_creation_timeout():
+    AgentFactory.register_agent_type("slow", SlowAgent)
+    AgentFactory.creation_timeout = 0.1
+    try:
+        with pytest.raises(RuntimeError, match="failed to start"):
+            await AgentFactory.create_agent("slow", llm=mock_llm())
+    finally:
+        AgentFactory.creation_timeout = 30.0
+
+
+@pytest.mark.asyncio
+async def test_cleanup_idempotent():
+    agent = await AgentFactory.create_agent("worker", llm=mock_llm())
+    assert await AgentFactory.cleanup_agent(agent.id) is True
+    assert await AgentFactory.cleanup_agent(agent.id) is False
+    assert await AgentFactory.cleanup_agent("nonexistent") is False
+
+
+@pytest.mark.asyncio
+async def test_managed_agent_context():
+    async with AgentFactory.managed_agent("worker", llm=mock_llm()) as agent:
+        assert agent.id in AgentFactory.active_agents()
+    assert agent.id not in AgentFactory.active_agents()
+
+
+# ------------------------------ router --------------------------------- #
+
+@pytest.mark.asyncio
+async def test_router_prefers_specialized_idle_agent():
+    generic = BaseAgent(config=AgentConfig(role="g"), llm=mock_llm())
+    expert = BaseAgent(
+        config=AgentConfig(role="e", specializations=["extract"]), llm=mock_llm()
+    )
+    await generic.start(); await expert.start()
+    router = TaskRouter(RouterConfig(load_check_interval=0.0))
+    chosen = await router.route_task(Task(description="x", type="extract"),
+                                     [generic, expert])
+    assert chosen is expert
+
+
+@pytest.mark.asyncio
+async def test_router_skips_overloaded_agents():
+    a = BaseAgent(config=AgentConfig(role="a", max_queue_size=2), llm=mock_llm())
+    b = BaseAgent(config=AgentConfig(role="b"), llm=mock_llm())
+    await a.start(); await b.start()
+    await a.add_task(Task(description="q1"))
+    await a.add_task(Task(description="q2"))  # a is now at 100% queue
+    router = TaskRouter(RouterConfig(load_check_interval=0.0))
+    chosen = await router.route_task(Task(description="x"), [a, b])
+    assert chosen is b
+
+
+@pytest.mark.asyncio
+async def test_router_returns_none_when_no_agent():
+    router = TaskRouter(RouterConfig(route_attempts=2, retry_backoff=0.01))
+    assert await router.route_task(Task(description="x"), []) is None
+
+
+def test_static_priority_heuristic():
+    import time as _t
+    urgent = Task(
+        description="x", complexity=8,
+        dependencies=["a", "b", "c"],
+        deadline=_t.time() + 60,
+    )
+    assert TaskRouter.get_task_priority(urgent) == TaskPriority.CRITICAL
+    plain = Task(description="x")
+    assert TaskRouter.get_task_priority(plain) == TaskPriority.NORMAL

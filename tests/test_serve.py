@@ -1,0 +1,239 @@
+"""Serve orchestrator end-to-end tests on the mock provider — the
+"minimum end-to-end slice" (SURVEY §7.3, BASELINE config #1)."""
+
+import asyncio
+
+import pytest
+
+from pilottai_tpu.core.agent import BaseAgent
+from pilottai_tpu.core.config import AgentConfig, LLMConfig, ServeConfig
+from pilottai_tpu.core.task import Task, TaskPriority, TaskStatus
+from pilottai_tpu.engine.handler import LLMHandler
+from pilottai_tpu.engine.mock import MockBackend
+from pilottai_tpu.serve import PriorityTaskQueue, Serve
+
+
+def worker(backend=None, **cfg):
+    handler = LLMHandler(
+        LLMConfig(provider="mock"), backend=backend or MockBackend()
+    )
+    return BaseAgent(config=AgentConfig(role="processor", **cfg), llm=handler)
+
+
+def make_serve(n_agents=1, manager_backend=None, config=None, **kwargs):
+    agents = [worker() for _ in range(n_agents)]
+    manager = LLMHandler(
+        LLMConfig(provider="mock"), backend=manager_backend or MockBackend()
+    )
+    return Serve(
+        name="test", agents=agents, manager_llm=manager,
+        config=config or ServeConfig(max_concurrent_tasks=4, task_timeout=30),
+        **kwargs,
+    )
+
+
+@pytest.mark.asyncio
+async def test_quickstart_execute_task():
+    """The README-style Quick Start path (reference §2.12-a intent)."""
+    serve = make_serve()
+    await serve.start()
+    try:
+        result = await serve.execute_task(
+            {"type": "process_document", "description": "process the quarterly PDF"},
+            timeout=30,
+        )
+        assert result.success
+        assert serve.metrics["tasks_completed"] >= 1
+    finally:
+        await serve.stop()
+
+
+@pytest.mark.asyncio
+async def test_dynamic_add_agent_and_string_task():
+    serve = Serve(
+        name="dyn",
+        manager_llm=LLMHandler(LLMConfig(provider="mock"), backend=MockBackend()),
+    )
+    serve.add_agent(worker())
+    await serve.start()
+    try:
+        result = await serve.execute_task("just summarize this text", timeout=30)
+        assert result.success
+    finally:
+        await serve.stop()
+
+
+@pytest.mark.asyncio
+async def test_decomposition_pipeline_with_dependencies():
+    """Manager decomposes into extract→analyze→summarize with deps; parent
+    aggregates child outputs (reference stack §3.2 + config #3 shape)."""
+
+    def manager_responder(prompt):
+        if '"requires_decomposition"' in prompt:
+            return {"requires_decomposition": True, "complexity": 7,
+                    "estimated_resources": {"agents": 3, "llm_calls": 9},
+                    "reasoning": "multi-stage"}
+        return None
+
+    serve = make_serve(
+        n_agents=2, manager_backend=MockBackend(responders=[manager_responder])
+    )
+    await serve.start()
+    try:
+        result = await serve.execute_task(
+            {"type": "complex_workflow", "description": "process the document"},
+            timeout=60,
+        )
+        assert result.success
+        assert isinstance(result.output, list) and len(result.output) == 3
+        assert serve.metrics["subtasks_created"] == 3
+        # Subtask chain respected dependencies: all completed.
+        subtask_ids = result.metadata["subtask_ids"]
+        statuses = [serve.get_task(s).status for s in subtask_ids]
+        assert all(s == TaskStatus.COMPLETED for s in statuses)
+    finally:
+        await serve.stop()
+
+
+@pytest.mark.asyncio
+async def test_failed_dependency_cascades():
+    def manager_responder(prompt):
+        if '"requires_decomposition"' in prompt:
+            return {"requires_decomposition": True, "complexity": 5,
+                    "estimated_resources": {}, "reasoning": ""}
+        if '"subtasks"' in prompt:
+            return {"subtasks": [
+                {"description": "poison step", "type": "extract",
+                 "priority": "normal", "depends_on": []},
+                {"description": "dependent step", "type": "analyze",
+                 "priority": "normal", "depends_on": [0]},
+            ]}
+        return None
+
+    # Worker fails on the poison step (after agent-internal evaluation).
+    def worker_responder(prompt):
+        if '"task_complete"' in prompt and "poison step" in prompt:
+            return {"task_complete": True, "action": "respond", "arguments": {},
+                    "output": "bad output", "reasoning": ""}
+        if '"success"' in prompt and "poison step" in prompt:
+            return {"success": False, "quality": 0.1,
+                    "issues": ["garbage output"], "suggestions": []}
+        return None
+
+    agents = [worker(backend=MockBackend(responders=[worker_responder]))]
+    manager = LLMHandler(
+        LLMConfig(provider="mock"),
+        backend=MockBackend(responders=[manager_responder]),
+    )
+    serve = Serve(
+        name="cascade", agents=agents, manager_llm=manager,
+        config=ServeConfig(max_concurrent_tasks=2, task_timeout=30,
+                           max_retry_attempts=0),
+    )
+    await serve.start()
+    try:
+        result = await serve.execute_task(
+            {"type": "flow", "description": "doomed workflow"}, timeout=60
+        )
+        assert not result.success
+        assert "subtasks failed" in result.error
+    finally:
+        await serve.stop()
+
+
+@pytest.mark.asyncio
+async def test_retry_on_requires_retry():
+    eval_count = {"n": 0}
+
+    def manager_responder(prompt):
+        if '"requires_retry"' in prompt:
+            eval_count["n"] += 1
+            return {"quality": 0.3 if eval_count["n"] == 1 else 0.9,
+                    "requires_retry": eval_count["n"] == 1, "feedback": "redo"}
+        return None
+
+    serve = make_serve(
+        manager_backend=MockBackend(responders=[manager_responder])
+    )
+    await serve.start()
+    try:
+        result = await serve.execute_task("retryable work", timeout=30)
+        assert result.success
+        assert serve.metrics["tasks_retried"] == 1
+    finally:
+        await serve.stop()
+
+
+@pytest.mark.asyncio
+async def test_no_agents_fails_cleanly():
+    serve = Serve(
+        name="empty",
+        manager_llm=LLMHandler(LLMConfig(provider="mock"), backend=MockBackend()),
+        config=ServeConfig(task_timeout=5),
+    )
+    await serve.start()
+    try:
+        result = await serve.execute_task("orphan work", timeout=20)
+        assert not result.success
+        assert "no available agent" in result.error
+    finally:
+        await serve.stop()
+
+
+@pytest.mark.asyncio
+async def test_concurrent_tasks_throughput():
+    serve = make_serve(n_agents=3)
+    await serve.start()
+    try:
+        results = await serve.execute([f"task {i}" for i in range(10)])
+        assert len(results) == 10 and all(r.success for r in results)
+        metrics = serve.get_metrics()
+        assert metrics["tasks_completed"] >= 10
+        assert metrics["steps_per_sec"] > 0
+    finally:
+        await serve.stop()
+
+
+@pytest.mark.asyncio
+async def test_cleanup_retention():
+    serve = make_serve(
+        config=ServeConfig(task_retention=0.01, max_concurrent_tasks=2,
+                           task_timeout=30)
+    )
+    await serve.start()
+    try:
+        await serve.execute_task("ephemeral", timeout=30)
+        await asyncio.sleep(0.05)
+        dropped = serve.cleanup_once()
+        assert dropped >= 1
+    finally:
+        await serve.stop()
+
+
+# ----------------------- priority queue unit tests ---------------------- #
+
+@pytest.mark.asyncio
+async def test_priority_queue_orders_numerically():
+    q = PriorityTaskQueue(maxsize=10)
+    low = Task(description="low", priority=TaskPriority.LOW)
+    critical = Task(description="crit", priority=TaskPriority.CRITICAL)
+    normal = Task(description="norm", priority=TaskPriority.NORMAL)
+    for t in (low, critical, normal):
+        await q.put(t)
+    assert (await q.get()).id == critical.id
+    assert (await q.get()).id == normal.id
+    assert (await q.get()).id == low.id
+
+
+@pytest.mark.asyncio
+async def test_priority_queue_eviction():
+    q = PriorityTaskQueue(maxsize=2)
+    a = Task(description="a", priority=TaskPriority.LOW)
+    b = Task(description="b", priority=TaskPriority.NORMAL)
+    await q.put(a); await q.put(b)
+    c = Task(description="c", priority=TaskPriority.CRITICAL)
+    evicted = await q.put(c)
+    assert evicted is a and a.status == TaskStatus.CANCELLED
+    d = Task(description="d", priority=TaskPriority.LOW)
+    with pytest.raises(asyncio.QueueFull):
+        await q.put(d)
