@@ -40,6 +40,62 @@ from pilottai_tpu.utils.tracing import global_tracer
 StepCallback = Callable[[str, Dict[str, Any]], Any]
 
 
+class AgentTaskQueue:
+    """Bounded task queue supporting O(1) removal without ghost slots.
+
+    ``asyncio.Queue`` can't remove items, so a detached (rebalanced) task
+    would keep occupying a slot and distort capacity checks. Here capacity
+    counts LIVE tasks only: the deque holds ids, the dict holds the truth,
+    and consumers skip ids whose task was removed.
+    """
+
+    def __init__(self, maxsize: int) -> None:
+        self.maxsize = maxsize
+        self._order: deque = deque()
+        self._live: Dict[str, Task] = {}
+        self._event = asyncio.Event()
+
+    def qsize(self) -> int:
+        return len(self._live)
+
+    def empty(self) -> bool:
+        return not self._live
+
+    def put_nowait(self, task: Task) -> None:
+        if len(self._live) >= self.maxsize:
+            raise asyncio.QueueFull(f"agent queue at capacity {self.maxsize}")
+        self._live[task.id] = task
+        self._order.append(task.id)
+        self._event.set()
+
+    def remove(self, task_id: str) -> Optional[Task]:
+        """Detach a queued task; its id in the deque becomes a skipped ghost
+        but no longer counts toward capacity."""
+        return self._live.pop(task_id, None)
+
+    def get_nowait(self) -> Task:
+        while self._order:
+            task = self._live.pop(self._order.popleft(), None)
+            if task is not None:
+                return task
+        raise asyncio.QueueEmpty()
+
+    async def get(self, timeout: Optional[float] = None) -> Optional[Task]:
+        while True:
+            try:
+                return self.get_nowait()
+            except asyncio.QueueEmpty:
+                pass
+            self._event.clear()
+            try:
+                await asyncio.wait_for(self._event.wait(), timeout=timeout)
+            except asyncio.TimeoutError:
+                return None
+
+    def values(self) -> List[Task]:
+        return list(self._live.values())
+
+
 class BaseAgent:
     """An autonomous agent executing tasks through an LLM reasoning loop."""
 
@@ -79,10 +135,7 @@ class BaseAgent:
         self.child_agents: Dict[str, "BaseAgent"] = {}
 
         # Queues / history / metrics.
-        self.task_queue: "asyncio.Queue[Task]" = asyncio.Queue(
-            maxsize=self.config.max_queue_size
-        )
-        self._queued_tasks: Dict[str, Task] = {}
+        self.task_queue = AgentTaskQueue(self.config.max_queue_size)
         self.current_tasks: Dict[str, Task] = {}
         self.conversation_history: deque = deque(maxlen=100)
         self.task_history: deque = deque(maxlen=1000)
@@ -167,7 +220,6 @@ class BaseAgent:
                 task.mark_cancelled()
             except asyncio.QueueEmpty:
                 break
-        self._queued_tasks.clear()
         self.current_tasks.clear()
         self._error_count = 0
         self.status = AgentStatus.IDLE
@@ -191,37 +243,34 @@ class BaseAgent:
     # ------------------------------------------------------------------ #
 
     async def add_task(self, task: Task) -> None:
+        """Non-blocking enqueue: raises asyncio.QueueFull when at capacity
+        (callers — router, balancer, fault tolerance — must handle refusal,
+        never hang on a saturated agent)."""
         if self.status == AgentStatus.STOPPED:
             raise RuntimeError(f"agent {self.id[:8]} is stopped")
+        self.task_queue.put_nowait(task)
         task.mark_queued()
         task.agent_id = self.id
-        self._queued_tasks[task.id] = task
-        await self.task_queue.put(task)
 
     def remove_task(self, task_id: str) -> Optional[Task]:
-        """Detach a queued (not yet running) task — used for rebalancing."""
-        task = self._queued_tasks.pop(task_id, None)
+        """Detach a queued (not yet running) task — used for rebalancing.
+        The freed slot is immediately reusable (no ghost capacity)."""
+        task = self.task_queue.remove(task_id)
         if task is None:
             return None
         task.status = TaskStatus.PENDING
         task.agent_id = None
-        # The queue itself still holds the object; the worker skips tasks
-        # no longer present in _queued_tasks.
         return task
 
     def queued_tasks(self) -> List[Task]:
-        return list(self._queued_tasks.values())
+        return self.task_queue.values()
 
     async def run_queue_worker(self) -> None:
         """Drain the agent's own queue (hierarchical/manager workloads)."""
         while self.status not in (AgentStatus.STOPPED, AgentStatus.STOPPING):
-            try:
-                task = await asyncio.wait_for(self.task_queue.get(), timeout=0.5)
-            except asyncio.TimeoutError:
+            task = await self.task_queue.get(timeout=0.5)
+            if task is None:
                 continue
-            if task.id not in self._queued_tasks:
-                continue  # was rebalanced away
-            self._queued_tasks.pop(task.id, None)
             await self.execute_task(task)
 
     def start_queue_worker(self) -> None:

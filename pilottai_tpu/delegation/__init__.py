@@ -1,0 +1,3 @@
+from pilottai_tpu.delegation.delegator import DelegationMetrics, TaskDelegator
+
+__all__ = ["TaskDelegator", "DelegationMetrics"]

@@ -1,0 +1,198 @@
+"""TaskDelegator: manager-side delegation decisions.
+
+Reference parity: ``pilott/delegation/task_delegator.py`` (359 LoC) —
+``DelegationMetrics`` per agent (``:8-15``), ``evaluate_delegation``
+(``:41``), ``_should_delegate`` gates: queue utilization > 0.8 OR
+complexity > max_task_complexity OR missing capabilities (``:328-345``),
+``_find_best_agent`` scoring 0.4·suitability + 0.3·(1−queue) +
+0.2·success + 0.1·resources (``:92-111``), acceptance gate (``:316-326``),
+similar-task history (``:159-181``), ``record_delegation`` (``:183-219``),
+history retention cleanup (``:272-306``). One home for this logic — the
+reference's vestigial second copy in ``core/router.py:148-193`` (§2.12-f)
+has no counterpart here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from pilottai_tpu.core.agent import BaseAgent
+from pilottai_tpu.core.task import Task
+from pilottai_tpu.utils.logging import get_logger
+
+
+@dataclass
+class DelegationMetrics:
+    """Per-agent delegation outcomes (reference ``:8-15``)."""
+
+    delegations: int = 0
+    successes: int = 0
+    failures: int = 0
+    total_exec_time: float = 0.0
+    errors_by_type: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def success_rate(self) -> float:
+        total = self.successes + self.failures
+        return self.successes / total if total else 1.0
+
+    @property
+    def avg_exec_time(self) -> float:
+        done = self.successes + self.failures
+        return self.total_exec_time / done if done else 0.0
+
+
+class TaskDelegator:
+    """Decides whether and to whom a manager agent should delegate."""
+
+    def __init__(
+        self,
+        agent: BaseAgent,
+        history_retention: float = 86_400.0,   # 24h (reference ``:272-306``)
+        history_cap: int = 1000,
+        selection_timeout: float = 10.0,
+        acceptance_threshold: float = 0.8,
+    ) -> None:
+        self.agent = agent
+        self.history_retention = history_retention
+        self.history_cap = history_cap
+        self.selection_timeout = selection_timeout
+        self.acceptance_threshold = acceptance_threshold
+        self.metrics: Dict[str, DelegationMetrics] = {}
+        self._history: Dict[str, List[Dict[str, Any]]] = {}  # agent -> records
+        self._lock = asyncio.Lock()
+        self._log = get_logger("delegation", agent_id=agent.id[:8])
+
+    # ------------------------------------------------------------------ #
+    # Decision (reference ``:41-111,316-345``)
+    # ------------------------------------------------------------------ #
+
+    def _should_delegate(self, task: Task) -> Tuple[bool, str]:
+        cfg = self.agent.config
+        if not cfg.delegation_enabled:
+            return False, "delegation disabled"
+        if not self.agent.child_agents:
+            return False, "no child agents"
+        if self.agent.queue_utilization > cfg.delegation_threshold:
+            return True, "queue over threshold"
+        if task.complexity > cfg.max_task_complexity:
+            return True, "complexity over limit"
+        needed = set(task.required_capabilities)
+        own = set(cfg.required_capabilities) | set(self.agent.tools.names())
+        if needed and not needed.issubset(own):
+            return True, "missing capabilities"
+        return False, "self-execution preferred"
+
+    def _accepts(self, candidate: BaseAgent) -> bool:
+        """Acceptance gate: candidate must not itself be overloaded
+        (reference ``:316-326``)."""
+        return (
+            candidate.status.is_available
+            and candidate.queue_utilization < self.acceptance_threshold
+            and candidate.load < self.acceptance_threshold
+        )
+
+    def _historical_bonus(self, candidate: BaseAgent, task: Task) -> float:
+        """Similar-task performance bonus (reference ``:159-181``)."""
+        records = self._history.get(candidate.id, [])
+        similar = [r for r in records if r.get("task_type") == task.type]
+        if not similar:
+            return 0.0
+        rate = sum(1 for r in similar if r["success"]) / len(similar)
+        return 0.1 * (rate - 0.5) * 2  # [-0.1, +0.1]
+
+    def _score(self, candidate: BaseAgent, task: Task) -> float:
+        metrics = self.metrics.get(candidate.id, DelegationMetrics())
+        return (
+            0.4 * candidate.evaluate_task_suitability(task)
+            + 0.3 * (1.0 - candidate.queue_utilization)
+            + 0.2 * metrics.success_rate
+            + 0.1 * (1.0 - candidate.load)
+            + self._historical_bonus(candidate, task)
+        )
+
+    async def evaluate_delegation(
+        self, task: Task, candidates: Optional[List[BaseAgent]] = None
+    ) -> Tuple[Optional[BaseAgent], str]:
+        """Returns (target_agent_or_None, reason)."""
+        should, reason = self._should_delegate(task)
+        if not should:
+            return None, reason
+        pool = [
+            c for c in (candidates or list(self.agent.child_agents.values()))
+            if self._accepts(c)
+        ]
+        if not pool:
+            return None, "no accepting candidate"
+        try:
+            async with asyncio.timeout(self.selection_timeout):
+                async with self._lock:
+                    best = max(pool, key=lambda c: self._score(c, task))
+        except TimeoutError:
+            return None, "selection timed out"
+        return best, reason
+
+    # ------------------------------------------------------------------ #
+    # Bookkeeping (reference ``:183-219,272-306``)
+    # ------------------------------------------------------------------ #
+
+    async def record_delegation(
+        self,
+        agent_id: str,
+        task: Task,
+        success: bool,
+        execution_time: float = 0.0,
+        error: Optional[str] = None,
+    ) -> None:
+        async with self._lock:
+            metrics = self.metrics.setdefault(agent_id, DelegationMetrics())
+            metrics.delegations += 1
+            metrics.total_exec_time += execution_time
+            if success:
+                metrics.successes += 1
+            else:
+                metrics.failures += 1
+                if error:
+                    key = error.split(":")[0][:60]
+                    metrics.errors_by_type[key] = metrics.errors_by_type.get(key, 0) + 1
+            history = self._history.setdefault(agent_id, [])
+            history.append(
+                {
+                    "task_id": task.id,
+                    "task_type": task.type,
+                    "success": success,
+                    "execution_time": execution_time,
+                    "ts": time.time(),
+                }
+            )
+            if len(history) > self.history_cap:
+                del history[: len(history) - self.history_cap]
+
+    async def cleanup_history(self) -> int:
+        """Drop records past retention (reference hourly janitor ``:272``)."""
+        cutoff = time.time() - self.history_retention
+        removed = 0
+        async with self._lock:
+            for agent_id in list(self._history):
+                before = len(self._history[agent_id])
+                self._history[agent_id] = [
+                    r for r in self._history[agent_id] if r["ts"] >= cutoff
+                ]
+                removed += before - len(self._history[agent_id])
+                if not self._history[agent_id]:
+                    del self._history[agent_id]
+        return removed
+
+    def get_metrics(self) -> Dict[str, Any]:
+        return {
+            agent_id: {
+                "delegations": m.delegations,
+                "success_rate": m.success_rate,
+                "avg_exec_time": m.avg_exec_time,
+                "errors_by_type": dict(m.errors_by_type),
+            }
+            for agent_id, m in self.metrics.items()
+        }

@@ -1,0 +1,22 @@
+"""Knowledge layer: federated query over named sources with LRU+TTL cache.
+
+Reference parity: ``pilott/knowledge/knowledge_manager.py`` +
+``pilott/tools/knowledge.py`` — the reference ships two incompatible
+``KnowledgeSource`` classes (SURVEY §2.12-e); there is exactly one here.
+"""
+
+from pilottai_tpu.knowledge.manager import KnowledgeManager
+from pilottai_tpu.knowledge.source import (
+    CallableSource,
+    FileSource,
+    KnowledgeSource,
+    MemorySource,
+)
+
+__all__ = [
+    "KnowledgeManager",
+    "KnowledgeSource",
+    "FileSource",
+    "CallableSource",
+    "MemorySource",
+]

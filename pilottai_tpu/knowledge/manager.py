@@ -1,0 +1,193 @@
+"""KnowledgeManager: federated query over named sources with an LRU+TTL
+cache.
+
+Reference parity: ``pilott/knowledge/knowledge_manager.py`` —
+``add_source`` with connection test (``:62-77``), ``query_knowledge``:
+cache check → per-source lock → retry-with-delay-and-timeout → cache fill
+(``:79-147``), OrderedDict LRU capped at 1000 with TTL 3600s
+(``:157-197``), pattern/source invalidation (``:199-219``), hourly cleanup
+with source-health reconnect (``:221-249``), stats (``:251-267``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import fnmatch
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+from pilottai_tpu.knowledge.source import KnowledgeSource
+from pilottai_tpu.utils.logging import get_logger
+from pilottai_tpu.utils.metrics import global_metrics
+
+
+class KnowledgeManager:
+    """Queries all (or selected) sources, caching merged results."""
+
+    def __init__(
+        self,
+        cache_size: int = 1000,
+        cache_ttl: float = 3600.0,
+        cleanup_interval: float = 3600.0,
+    ) -> None:
+        self.sources: Dict[str, KnowledgeSource] = {}
+        self._source_locks: Dict[str, asyncio.Lock] = {}
+        self._cache: "OrderedDict[str, tuple]" = OrderedDict()  # key -> (ts, value)
+        self.cache_size = cache_size
+        self.cache_ttl = cache_ttl
+        self.cleanup_interval = cleanup_interval
+        self._stats = {"hits": 0, "misses": 0, "errors": 0, "queries": 0}
+        self._cleanup_task: Optional[asyncio.Task] = None
+        self._log = get_logger("knowledge")
+
+    # ------------------------------------------------------------------ #
+
+    async def start(self) -> None:
+        if self._cleanup_task is None:
+            self._cleanup_task = asyncio.create_task(self._cleanup_loop())
+
+    async def stop(self) -> None:
+        if self._cleanup_task is not None:
+            self._cleanup_task.cancel()
+            try:
+                await self._cleanup_task
+            except asyncio.CancelledError:
+                pass
+            self._cleanup_task = None
+        for source in self.sources.values():
+            await source.disconnect()
+
+    # ------------------------------------------------------------------ #
+
+    async def add_source(self, source: KnowledgeSource) -> None:
+        """Register + connection-test a source (reference ``:62-77``)."""
+        if source.name in self.sources:
+            raise ValueError(f"source {source.name!r} already added")
+        ok = await source.connect()
+        if not ok:
+            raise ConnectionError(f"source {source.name!r} failed connection test")
+        self.sources[source.name] = source
+        self._source_locks[source.name] = asyncio.Lock()
+
+    async def remove_source(self, name: str) -> None:
+        source = self.sources.pop(name, None)
+        self._source_locks.pop(name, None)
+        if source is not None:
+            await source.disconnect()
+        self.invalidate(f"*@{name}")
+
+    # ------------------------------------------------------------------ #
+
+    def _cache_get(self, key: str) -> Optional[Any]:
+        hit = self._cache.get(key)
+        if hit is None:
+            return None
+        ts, value = hit
+        if time.time() - ts > self.cache_ttl:
+            del self._cache[key]
+            return None
+        self._cache.move_to_end(key)
+        return value
+
+    def _cache_put(self, key: str, value: Any) -> None:
+        self._cache[key] = (time.time(), value)
+        self._cache.move_to_end(key)
+        while len(self._cache) > self.cache_size:
+            self._cache.popitem(last=False)
+
+    def invalidate(self, pattern: str = "*") -> int:
+        """Drop cache entries matching a glob (reference ``:199-219``)."""
+        doomed = [k for k in self._cache if fnmatch.fnmatch(k, pattern)]
+        for key in doomed:
+            del self._cache[key]
+        return len(doomed)
+
+    # ------------------------------------------------------------------ #
+
+    async def query_knowledge(
+        self,
+        query: str,
+        sources: Optional[List[str]] = None,
+        use_cache: bool = True,
+        **kwargs: Any,
+    ) -> List[Dict[str, Any]]:
+        """Query selected (default: all) sources, merging results."""
+        self._stats["queries"] += 1
+        names = sources or list(self.sources)
+        results: List[Dict[str, Any]] = []
+        for name in names:
+            if name not in self.sources:
+                raise KeyError(f"unknown source {name!r}")
+            key = f"{query}@{name}"
+            if use_cache:
+                cached = self._cache_get(key)
+                if cached is not None:
+                    self._stats["hits"] += 1
+                    results.extend(cached)
+                    continue
+            self._stats["misses"] += 1
+            rows = await self._query_source_with_retry(name, query, **kwargs)
+            if rows is not None:
+                self._cache_put(key, rows)
+                results.extend(rows)
+        global_metrics.inc("knowledge.queries")
+        return results
+
+    async def _query_source_with_retry(
+        self, name: str, query: str, **kwargs: Any
+    ) -> Optional[List[Dict[str, Any]]]:
+        """Per-source lock + retries + timeout (reference ``:120-147``)."""
+        source = self.sources[name]
+        async with self._source_locks[name]:
+            for attempt in range(source.retries + 1):
+                try:
+                    return await asyncio.wait_for(
+                        source.query(query, **kwargs), timeout=source.timeout
+                    )
+                except Exception as exc:  # noqa: BLE001 - retry boundary
+                    self._stats["errors"] += 1
+                    self._log.warning(
+                        "source %s query failed (attempt %d): %s",
+                        name, attempt + 1, exc,
+                    )
+                    if attempt < source.retries:
+                        await asyncio.sleep(source.retry_delay * (attempt + 1))
+        return None
+
+    # ------------------------------------------------------------------ #
+
+    async def _cleanup_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.cleanup_interval)
+            await self.cleanup()
+
+    async def cleanup(self) -> None:
+        """Expire stale cache entries; reconnect unhealthy sources
+        (reference ``:221-249``)."""
+        now = time.time()
+        for key in [k for k, (ts, _) in self._cache.items() if now - ts > self.cache_ttl]:
+            del self._cache[key]
+        for name, source in self.sources.items():
+            try:
+                if not await source.health_check():
+                    self._log.info("reconnecting unhealthy source %s", name)
+                    await source.connect()
+            except Exception as exc:  # noqa: BLE001
+                self._log.warning("health check failed for %s: %s", name, exc)
+
+    # ------------------------------------------------------------------ #
+
+    def get_source_stats(self) -> Dict[str, Any]:
+        return {
+            name: {"connected": s.connected, "timeout": s.timeout}
+            for name, s in self.sources.items()
+        }
+
+    def get_cache_stats(self) -> Dict[str, Any]:
+        total = self._stats["hits"] + self._stats["misses"]
+        return {
+            **self._stats,
+            "entries": len(self._cache),
+            "hit_rate": self._stats["hits"] / total if total else 0.0,
+        }

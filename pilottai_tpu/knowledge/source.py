@@ -1,0 +1,129 @@
+"""Knowledge sources: the single, coherent source protocol.
+
+Reference parity: the *intended* union of the two reference classes
+(``knowledge/knowledge_manager.py:16-26`` model with retries/timeout;
+``tools/knowledge.py:5-62`` stub with connect/query/disconnect for
+database/api/file types — all placeholder returns). Here the protocol is
+one abstract class with three real implementations: files, callables, and
+the semantic memory store (which turns EnhancedMemory into a queryable
+source backed by on-device embedding search).
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional
+
+
+class KnowledgeSource(abc.ABC):
+    """A named, connectable, queryable knowledge backend."""
+
+    def __init__(
+        self,
+        name: str,
+        retries: int = 2,
+        retry_delay: float = 0.5,
+        timeout: float = 10.0,
+    ) -> None:
+        self.name = name
+        self.retries = retries
+        self.retry_delay = retry_delay
+        self.timeout = timeout
+        self.connected = False
+
+    async def connect(self) -> bool:
+        self.connected = True
+        return True
+
+    async def disconnect(self) -> None:
+        self.connected = False
+
+    @abc.abstractmethod
+    async def query(self, query: str, **kwargs: Any) -> List[Dict[str, Any]]:
+        """Return matching records for ``query``."""
+
+    async def health_check(self) -> bool:
+        return self.connected
+
+
+class FileSource(KnowledgeSource):
+    """Searches local text/JSON/JSONL files line-by-line (case-insensitive
+    substring; the file analog of the reference's 'file' source type)."""
+
+    def __init__(self, name: str, path: str | Path, **kwargs: Any) -> None:
+        super().__init__(name, **kwargs)
+        self.path = Path(path)
+
+    async def connect(self) -> bool:
+        self.connected = self.path.exists()
+        return self.connected
+
+    async def query(self, query: str, limit: int = 10, **kwargs: Any) -> List[Dict[str, Any]]:
+        if not self.connected:
+            raise ConnectionError(f"source {self.name!r} not connected")
+        needle = query.lower()
+        out: List[Dict[str, Any]] = []
+        text = self.path.read_text(errors="replace")
+        if self.path.suffix == ".json":
+            data = json.loads(text)
+            rows = data if isinstance(data, list) else [data]
+            for row in rows:
+                if needle in json.dumps(row).lower():
+                    out.append({"source": self.name, "record": row})
+                    if len(out) >= limit:
+                        break
+        else:
+            for lineno, line in enumerate(text.splitlines(), 1):
+                if needle in line.lower():
+                    out.append(
+                        {"source": self.name, "line": lineno, "text": line.strip()}
+                    )
+                    if len(out) >= limit:
+                        break
+        return out
+
+
+class CallableSource(KnowledgeSource):
+    """Wraps a user function (sync or async) as a source — the extension
+    point the reference's 'api'/'database' stubs gestured at."""
+
+    def __init__(
+        self, name: str, fn: Callable[[str], Any], **kwargs: Any
+    ) -> None:
+        super().__init__(name, **kwargs)
+        self.fn = fn
+
+    async def query(self, query: str, **kwargs: Any) -> List[Dict[str, Any]]:
+        if not self.connected:
+            raise ConnectionError(f"source {self.name!r} not connected")
+        import asyncio
+        import inspect
+
+        if inspect.iscoroutinefunction(self.fn):
+            result = await self.fn(query, **kwargs)
+        else:
+            result = await asyncio.to_thread(self.fn, query, **kwargs)
+        if isinstance(result, list):
+            return [
+                r if isinstance(r, dict) else {"source": self.name, "record": r}
+                for r in result
+            ]
+        return [{"source": self.name, "record": result}]
+
+
+class MemorySource(KnowledgeSource):
+    """EnhancedMemory as a knowledge source: queries run through the
+    on-device embedding search (ties the knowledge layer to BASELINE
+    config #2's encoder path)."""
+
+    def __init__(self, name: str, memory: Any, **kwargs: Any) -> None:
+        super().__init__(name, **kwargs)
+        self.memory = memory
+
+    async def query(self, query: str, limit: int = 5, **kwargs: Any) -> List[Dict[str, Any]]:
+        if not self.connected:
+            raise ConnectionError(f"source {self.name!r} not connected")
+        hits = await self.memory.semantic_search(query, limit=limit)
+        return [{"source": self.name, **hit} for hit in hits]

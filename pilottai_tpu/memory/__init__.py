@@ -1,0 +1,14 @@
+"""Semantic memory: an embedding-indexed store with on-device search.
+
+Reference parity: ``pilott/memory/enhanced_memory.py`` — but
+``semantic_search`` there is a naive case-insensitive substring match
+(``:93-131``, SURVEY §2.8). Here search runs on an embedding matrix on
+device: a jit-batched encoder (Gemma-2B when a checkpoint is available, a
+byte-level encoder otherwise) embeds entries, and top-k cosine similarity
+is one matmul on the accelerator (BASELINE.json config #2).
+"""
+
+from pilottai_tpu.memory.embedder import Embedder
+from pilottai_tpu.memory.semantic import EnhancedMemory, MemoryItem
+
+__all__ = ["Embedder", "EnhancedMemory", "MemoryItem"]

@@ -1,0 +1,108 @@
+"""Jit-batched text embedding encoder.
+
+The encoder is a transformer trunk from the model zoo run as a bidirectional
+feature extractor: prefill the text, mean-pool valid hidden states, L2
+normalize. With a Gemma-2B checkpoint this is the BASELINE config #2
+"Gemma-2B encoder" path; without one, a small randomly-initialized trunk
+over byte tokens still yields a usable locality-sensitive signature (random
+features over overlapping byte n-grams), keeping tests and CPU CI hermetic.
+
+Batched + jitted: one compile per length bucket; embeddings come back
+L2-normalized so similarity is a single dot product on device.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pilottai_tpu.engine.tokenizer import ByteTokenizer, Tokenizer, load_tokenizer
+from pilottai_tpu.models.common import ModelConfig, init_params
+from pilottai_tpu.models.registry import get_model_config
+from pilottai_tpu.utils.metrics import global_metrics
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _encode_batch(
+    params, cfg: ModelConfig, tokens: jax.Array, valid: jax.Array
+) -> jax.Array:
+    """[B, T] tokens -> [B, E] L2-normalized mean-pooled features."""
+    from pilottai_tpu.models.transformer import forward_prefill
+
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+    # Feature source: the last layer's VALUE projections ([L,B,T,K,H] from
+    # prefill) — contextualized token features one matmul short of the
+    # logits, reused verbatim from the serving path so the encoder shares
+    # its compile cache with the engine.
+    _, _, vs = forward_prefill(params, cfg, tokens, positions, valid)
+    feats = vs[-1].reshape(B, T, -1).astype(jnp.float32)
+    mask = (jnp.arange(T)[None, :] < valid[:, None]).astype(jnp.float32)
+    pooled = (feats * mask[:, :, None]).sum(axis=1) / jnp.maximum(
+        mask.sum(axis=1, keepdims=True), 1.0
+    )
+    norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+    return pooled / jnp.maximum(norm, 1e-6)
+
+
+class Embedder:
+    """Batched text → vector encoder with length-bucketed jit."""
+
+    def __init__(
+        self,
+        model_name: str = "llama-tiny",
+        checkpoint_path: Optional[str] = None,
+        tokenizer: Optional[Tokenizer] = None,
+        max_len: int = 256,
+        seed: int = 0,
+    ) -> None:
+        self.tokenizer = tokenizer or load_tokenizer()
+        cfg = get_model_config(model_name)
+        if checkpoint_path is None and isinstance(self.tokenizer, ByteTokenizer):
+            cfg = cfg.replace(
+                vocab_size=self.tokenizer.vocab_size, tie_embeddings=True
+            )
+        self.cfg = cfg.replace(dtype=jnp.float32)
+        self.max_len = min(max_len, self.cfg.max_seq_len)
+        if checkpoint_path is not None:
+            from pilottai_tpu.models.loader import load_hf_checkpoint
+
+            self.params = load_hf_checkpoint(self.cfg, checkpoint_path, dtype=jnp.float32)
+        else:
+            self.params = init_params(self.cfg, jax.random.PRNGKey(seed), dtype=jnp.float32)
+        self.dim = self.cfg.n_kv_heads * self.cfg.head_dim
+        self._lock = threading.Lock()
+
+    def _bucket(self, n: int) -> int:
+        b = 32
+        while b < n:
+            b *= 2
+        return min(b, self.max_len)
+
+    def encode(self, texts: List[str]) -> np.ndarray:
+        """Embed a batch of texts -> [N, dim] float32, L2-normalized."""
+        if not texts:
+            return np.zeros((0, self.dim), np.float32)
+        with global_metrics.timer("embedder.encode_latency"):
+            ids = [self.tokenizer.encode(t)[: self.max_len] for t in texts]
+            T = self._bucket(max(len(i) for i in ids))
+            batch = np.zeros((len(ids), T), np.int32)
+            valid = np.zeros((len(ids),), np.int32)
+            for row, seq in enumerate(ids):
+                batch[row, : len(seq)] = seq
+                valid[row] = len(seq)
+            with self._lock:
+                out = _encode_batch(
+                    self.params, self.cfg, jnp.asarray(batch), jnp.asarray(valid)
+                )
+            result = np.asarray(out)
+        global_metrics.inc("embedder.texts", len(texts))
+        return result
+
+    def encode_one(self, text: str) -> np.ndarray:
+        return self.encode([text])[0]

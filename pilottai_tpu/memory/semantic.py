@@ -1,0 +1,379 @@
+"""EnhancedMemory: the long-term semantic store.
+
+Reference parity: ``pilott/memory/enhanced_memory.py`` (292 LoC) — four
+stores under separate locks (semantic / task history / agent interactions /
+patterns, ``:27-46``), ``MemoryItem`` with tags/priority/TTL (``:9-21``),
+tag+priority-filtered search (``:110-131``), task-history versioning
+(``:146-160``), interaction log (``:162-182``), TTL patterns
+(``:184-218``), periodic cleanup (``:248-282``).
+
+The headline change: ``semantic_search`` is embedding-based on device (one
+jitted matmul over a vector ring buffer) instead of substring matching,
+with stable-id indexes that survive eviction (the reference's positional
+indexes drift, §2.12-h). Substring search remains available as
+``keyword_search`` and as the fallback when no embedder is attached.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from pilottai_tpu.utils.logging import get_logger
+from pilottai_tpu.utils.metrics import global_metrics
+
+
+@dataclass
+class MemoryItem:
+    """One semantic entry (reference ``enhanced_memory.py:9-21``)."""
+
+    text: str
+    data: Any = None
+    tags: Set[str] = field(default_factory=set)
+    priority: int = 0
+    ttl: Optional[float] = None  # seconds
+    entry_id: int = 0
+    created_at: float = field(default_factory=time.time)
+
+    @property
+    def expired(self) -> bool:
+        return self.ttl is not None and time.time() - self.created_at > self.ttl
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "id": self.entry_id,
+            "text": self.text,
+            "data": self.data,
+            "tags": sorted(self.tags),
+            "priority": self.priority,
+            "created_at": self.created_at,
+        }
+
+
+class _VectorStore:
+    """Fixed-capacity embedding ring buffer with device top-k search.
+
+    Vectors live in one [capacity, dim] array; cosine top-k is a single
+    matmul + top_k on the accelerator. Rows of evicted entries are zeroed
+    (zero vectors can never win a cosine search over normalized queries).
+    """
+
+    def __init__(self, capacity: int, dim: int) -> None:
+        import jax.numpy as jnp  # local: keep module import light
+
+        self.capacity = capacity
+        self.dim = dim
+        self._vectors = jnp.zeros((capacity, dim), jnp.float32)
+        self._row_ids = np.full((capacity,), -1, np.int64)  # entry_id per row
+        self._id_to_row: Dict[int, int] = {}
+        self._next_row = 0
+
+    def add(self, entry_id: int, vector: np.ndarray) -> None:
+        row = self._next_row % self.capacity
+        old_id = self._row_ids[row]
+        if old_id >= 0:
+            self._id_to_row.pop(int(old_id), None)
+        self._vectors = self._vectors.at[row].set(vector)
+        self._row_ids[row] = entry_id
+        self._id_to_row[entry_id] = row
+        self._next_row += 1
+
+    def remove(self, entry_id: int) -> None:
+        row = self._id_to_row.pop(entry_id, None)
+        if row is not None:
+            import jax.numpy as jnp
+
+            self._vectors = self._vectors.at[row].set(jnp.zeros((self.dim,)))
+            self._row_ids[row] = -1
+
+    def search(self, query: np.ndarray, k: int) -> List[Tuple[int, float]]:
+        import jax
+        import jax.numpy as jnp
+
+        @partial(jax.jit, static_argnames=("k",))
+        def _topk(vectors, q, k):
+            scores = vectors @ q
+            return jax.lax.top_k(scores, k)
+
+        k = min(k, self.capacity)
+        scores, rows = _topk(self._vectors, jnp.asarray(query, jnp.float32), k)
+        out: List[Tuple[int, float]] = []
+        for score, row in zip(np.asarray(scores), np.asarray(rows)):
+            entry_id = int(self._row_ids[int(row)])
+            if entry_id >= 0 and score > 0.0:
+                out.append((entry_id, float(score)))
+        return out
+
+
+class EnhancedMemory:
+    """Semantic + episodic memory for agents."""
+
+    def __init__(
+        self,
+        embedder: Optional[Any] = None,   # memory.embedder.Embedder
+        capacity: int = 10_000,           # reference deque maxlen=10000
+        task_history_size: int = 1000,
+        cleanup_interval: float = 3600.0,
+    ) -> None:
+        self.embedder = embedder
+        self.capacity = capacity
+        self.cleanup_interval = cleanup_interval
+        self._items: Dict[int, MemoryItem] = {}
+        self._order: List[int] = []  # insertion order for FIFO eviction
+        self._tag_index: Dict[str, Set[int]] = {}
+        self._next_id = 0
+        self._vectors: Optional[_VectorStore] = None
+        self._semantic_lock = asyncio.Lock()
+
+        self._task_history: Dict[str, List[Dict[str, Any]]] = {}
+        self._task_history_size = task_history_size
+        self._task_lock = asyncio.Lock()
+
+        self._interactions: List[Dict[str, Any]] = []
+        self._interaction_lock = asyncio.Lock()
+
+        self._patterns: Dict[str, MemoryItem] = {}
+        self._pattern_lock = asyncio.Lock()
+
+        self._cleanup_task: Optional[asyncio.Task] = None
+        self._log = get_logger("memory.semantic")
+
+    # ------------------------------------------------------------------ #
+    # Lifecycle (background janitor, reference ``:248-282``)
+    # ------------------------------------------------------------------ #
+
+    async def start(self) -> None:
+        if self._cleanup_task is None:
+            self._cleanup_task = asyncio.create_task(self._periodic_cleanup())
+
+    async def stop(self) -> None:
+        if self._cleanup_task is not None:
+            self._cleanup_task.cancel()
+            try:
+                await self._cleanup_task
+            except asyncio.CancelledError:
+                pass
+            self._cleanup_task = None
+
+    async def _periodic_cleanup(self) -> None:
+        while True:
+            await asyncio.sleep(self.cleanup_interval)
+            await self.cleanup()
+
+    # ------------------------------------------------------------------ #
+    # Semantic store (reference ``:60-144``)
+    # ------------------------------------------------------------------ #
+
+    async def store_semantic(
+        self,
+        text: str,
+        data: Any = None,
+        tags: Optional[Set[str]] = None,
+        priority: int = 0,
+        ttl: Optional[float] = None,
+    ) -> int:
+        async with self._semantic_lock:
+            item = MemoryItem(
+                text=text, data=data, tags=set(tags or ()), priority=priority,
+                ttl=ttl, entry_id=self._next_id,
+            )
+            self._next_id += 1
+            self._items[item.entry_id] = item
+            self._order.append(item.entry_id)
+            for tag in item.tags:
+                self._tag_index.setdefault(tag, set()).add(item.entry_id)
+            if self.embedder is not None:
+                if self._vectors is None:
+                    self._vectors = _VectorStore(self.capacity, self.embedder.dim)
+                vec = await asyncio.to_thread(self.embedder.encode_one, text)
+                self._vectors.add(item.entry_id, vec)
+            while len(self._items) > self.capacity:
+                self._evict(self._order.pop(0))
+            global_metrics.inc("memory.semantic_stored")
+            return item.entry_id
+
+    def _evict(self, entry_id: int) -> None:
+        item = self._items.pop(entry_id, None)
+        if item is None:
+            return
+        for tag in item.tags:
+            ids = self._tag_index.get(tag)
+            if ids:
+                ids.discard(entry_id)
+                if not ids:
+                    del self._tag_index[tag]
+        if self._vectors is not None:
+            self._vectors.remove(entry_id)
+
+    def _filter(
+        self,
+        ids: List[int],
+        tags: Optional[Set[str]],
+        min_priority: Optional[int],
+    ) -> List[MemoryItem]:
+        out = []
+        for entry_id in ids:
+            item = self._items.get(entry_id)
+            if item is None or item.expired:
+                continue
+            if tags and not tags.issubset(item.tags):
+                continue
+            if min_priority is not None and item.priority < min_priority:
+                continue
+            out.append(item)
+        return out
+
+    async def semantic_search(
+        self,
+        query: str,
+        limit: int = 5,
+        tags: Optional[Set[str]] = None,
+        min_priority: Optional[int] = None,
+    ) -> List[Dict[str, Any]]:
+        """Embedding top-k on device; keyword fallback without an embedder.
+
+        Replaces the reference's substring scan (``enhanced_memory.py:110``).
+        Returns items with similarity scores, most similar first.
+        """
+        async with self._semantic_lock:
+            if self.embedder is None or self._vectors is None:
+                return await self._keyword_search_locked(
+                    query, limit, tags, min_priority
+                )
+            qvec = await asyncio.to_thread(self.embedder.encode_one, query)
+            # Over-fetch so tag/priority filters still leave `limit` results.
+            hits = self._vectors.search(qvec, k=min(limit * 4, self.capacity))
+            items = self._filter([eid for eid, _ in hits], tags, min_priority)
+            scores = dict(hits)
+            global_metrics.inc("memory.semantic_searches")
+            return [
+                {**item.to_dict(), "score": scores.get(item.entry_id, 0.0)}
+                for item in items[:limit]
+            ]
+
+    async def keyword_search(
+        self, query: str, limit: int = 5, tags: Optional[Set[str]] = None,
+        min_priority: Optional[int] = None,
+    ) -> List[Dict[str, Any]]:
+        async with self._semantic_lock:
+            return await self._keyword_search_locked(query, limit, tags, min_priority)
+
+    async def _keyword_search_locked(
+        self, query: str, limit: int, tags: Optional[Set[str]],
+        min_priority: Optional[int],
+    ) -> List[Dict[str, Any]]:
+        needle = query.lower()
+        candidates = self._filter(list(self._items), tags, min_priority)
+        hits = [i for i in candidates if needle in i.text.lower()]
+        hits.sort(key=lambda i: (i.priority, i.created_at), reverse=True)
+        return [{**item.to_dict(), "score": 1.0} for item in hits[:limit]]
+
+    # ------------------------------------------------------------------ #
+    # Task history (reference ``:146-160,220-246``)
+    # ------------------------------------------------------------------ #
+
+    async def store_task(self, task_id: str, record: Dict[str, Any]) -> None:
+        async with self._task_lock:
+            history = self._task_history.setdefault(task_id, [])
+            history.append({**record, "version": len(history), "ts": time.time()})
+            if len(history) > self._task_history_size:
+                del history[: len(history) - self._task_history_size]
+
+    async def get_task_history(self, task_id: str) -> List[Dict[str, Any]]:
+        async with self._task_lock:
+            return list(self._task_history.get(task_id, []))
+
+    async def get_recent_tasks(self, limit: int = 10) -> List[Dict[str, Any]]:
+        async with self._task_lock:
+            latest = [h[-1] for h in self._task_history.values() if h]
+            latest.sort(key=lambda r: r["ts"], reverse=True)
+            return latest[:limit]
+
+    # ------------------------------------------------------------------ #
+    # Agent interactions (reference ``:162-182``)
+    # ------------------------------------------------------------------ #
+
+    async def log_interaction(
+        self, source_agent: str, target_agent: str, payload: Any
+    ) -> None:
+        async with self._interaction_lock:
+            self._interactions.append(
+                {
+                    "source": source_agent,
+                    "target": target_agent,
+                    "payload": payload,
+                    "ts": time.time(),
+                }
+            )
+            if len(self._interactions) > 10_000:
+                del self._interactions[:5000]
+
+    async def get_interactions(
+        self, agent_id: Optional[str] = None, limit: int = 100
+    ) -> List[Dict[str, Any]]:
+        async with self._interaction_lock:
+            rows = self._interactions
+            if agent_id is not None:
+                rows = [
+                    r for r in rows
+                    if r["source"] == agent_id or r["target"] == agent_id
+                ]
+            return rows[-limit:]
+
+    # ------------------------------------------------------------------ #
+    # Patterns with TTL (reference ``:184-218``)
+    # ------------------------------------------------------------------ #
+
+    async def store_pattern(
+        self, key: str, value: Any, ttl: Optional[float] = None
+    ) -> None:
+        async with self._pattern_lock:
+            self._patterns[key] = MemoryItem(text=key, data=value, ttl=ttl)
+
+    async def get_pattern(self, key: str) -> Optional[Any]:
+        async with self._pattern_lock:
+            item = self._patterns.get(key)
+            if item is None or item.expired:
+                self._patterns.pop(key, None)
+                return None
+            return item.data
+
+    # ------------------------------------------------------------------ #
+
+    async def cleanup(self) -> int:
+        """Drop expired items across stores; returns count removed."""
+        removed = 0
+        async with self._semantic_lock:
+            for entry_id in [i for i, item in self._items.items() if item.expired]:
+                self._evict(entry_id)
+                if entry_id in self._order:
+                    self._order.remove(entry_id)
+                removed += 1
+        async with self._pattern_lock:
+            for key in [k for k, v in self._patterns.items() if v.expired]:
+                del self._patterns[key]
+                removed += 1
+        return removed
+
+    async def clear(self) -> None:
+        async with self._semantic_lock:
+            self._items.clear()
+            self._order.clear()
+            self._tag_index.clear()
+            if self._vectors is not None and self.embedder is not None:
+                self._vectors = _VectorStore(self.capacity, self.embedder.dim)
+
+    def get_metrics(self) -> Dict[str, Any]:
+        return {
+            "semantic_items": len(self._items),
+            "tags": len(self._tag_index),
+            "task_histories": len(self._task_history),
+            "interactions": len(self._interactions),
+            "patterns": len(self._patterns),
+            "embedder": self.embedder is not None,
+        }

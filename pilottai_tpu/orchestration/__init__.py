@@ -1,0 +1,17 @@
+"""Orchestration control plane: load balancing, autoscaling, fault
+tolerance.
+
+Reference parity: ``pilott/orchestration/`` — LoadBalancer
+(``load_balancer.py``), DynamicScaling (``orchestration.py``; the
+reference ships a dead duplicate in ``scaling.py:425-666``, §2.12-d — one
+copy here), FaultTolerance (``scaling.py:34-423``). Unlike the reference,
+these are wired into ``Serve``'s lifecycle (ServeConfig flags) instead of
+floating unattached (§3.1), and their load signals come from agent queues
+and engine metrics rather than blocking psutil probes (§2.12-h).
+"""
+
+from pilottai_tpu.orchestration.fault_tolerance import AgentHealth, FaultTolerance
+from pilottai_tpu.orchestration.load_balancer import LoadBalancer
+from pilottai_tpu.orchestration.scaling import DynamicScaling
+
+__all__ = ["LoadBalancer", "DynamicScaling", "FaultTolerance", "AgentHealth"]

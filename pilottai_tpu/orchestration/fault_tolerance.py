@@ -1,0 +1,264 @@
+"""FaultTolerance: health monitoring, bounded recovery, agent replacement
+with task migration.
+
+Reference parity: ``pilott/orchestration/scaling.py:34-423`` (the richest
+auxiliary subsystem, SURVEY §5.3) — ``AgentHealth`` (``:40-47``), 30s
+monitoring loop (``:134-144``), health = f(heartbeat ≤ timeout, stuck
+tasks, error count) → 4-level status (``:209-228``), bounded in-place
+recovery (stop→reset→start→verify) with attempt cap + cooldown
+(``:263-311``), replacement with recoverable-task transfer (``:323-378``),
+recovery audit history (``:313-321``), metrics (``:380-389``).
+
+TPU grounding: heartbeats map to per-host liveness (multi-host: over DCN
+via ``parallel.mesh.initialize_distributed`` process groups); replacement
+maps to re-spawning an agent after TPU-VM preemption, with its queued work
+requeued — BASELINE config #5's recovery story.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from pilottai_tpu.core.agent import BaseAgent
+from pilottai_tpu.core.config import FaultToleranceConfig
+from pilottai_tpu.core.status import AgentStatus, HealthStatus
+from pilottai_tpu.utils.logging import get_logger
+from pilottai_tpu.utils.metrics import global_metrics
+
+
+@dataclass
+class AgentHealth:
+    """Tracked health state per agent (reference ``:40-47``)."""
+
+    agent_id: str
+    status: HealthStatus = HealthStatus.HEALTHY
+    last_heartbeat: float = field(default_factory=time.time)
+    error_count: int = 0
+    stuck_tasks: int = 0
+    recovery_attempts: int = 0
+    last_recovery: float = 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "agent_id": self.agent_id,
+            "status": self.status.value,
+            "heartbeat_age": time.time() - self.last_heartbeat,
+            "error_count": self.error_count,
+            "stuck_tasks": self.stuck_tasks,
+            "recovery_attempts": self.recovery_attempts,
+        }
+
+
+class FaultTolerance:
+    """Watches agents, recovers the sick, replaces the dead."""
+
+    def __init__(
+        self,
+        orchestrator: Any,  # Serve
+        config: Optional[FaultToleranceConfig] = None,
+    ) -> None:
+        self.orchestrator = orchestrator
+        self.config = config or FaultToleranceConfig()
+        self.health: Dict[str, AgentHealth] = {}
+        self.recovery_history: List[Dict[str, Any]] = []
+        self._task: Optional[asyncio.Task] = None
+        self._log = get_logger("orchestration.fault")
+
+    # ------------------------------------------------------------------ #
+
+    async def start(self) -> None:
+        for agent in self.orchestrator.agent_list():
+            self.register_agent(agent)
+        if self._task is None:
+            self._task = asyncio.create_task(self._monitoring_loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    def register_agent(self, agent: BaseAgent) -> None:
+        self.health.setdefault(agent.id, AgentHealth(agent_id=agent.id))
+
+    def unregister_agent(self, agent_id: str) -> None:
+        self.health.pop(agent_id, None)
+
+    async def _monitoring_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.config.check_interval)
+            try:
+                await self.check_once()
+            except Exception as exc:  # noqa: BLE001 - keep the loop alive
+                self._log.error("monitoring cycle failed: %s", exc, exc_info=True)
+
+    # ------------------------------------------------------------------ #
+
+    def _assess(self, agent: BaseAgent) -> AgentHealth:
+        """Classify health (reference ``:209-252``)."""
+        self.register_agent(agent)
+        health = self.health[agent.id]
+        info = agent.get_health()
+        health.last_heartbeat = info["last_heartbeat"]
+        health.error_count = info["error_count"]
+        health.stuck_tasks = sum(
+            1
+            for t in agent.current_tasks.values()
+            if t.started_at is not None
+            and time.time() - t.started_at > self.config.stuck_task_timeout
+        )
+        heartbeat_age = time.time() - health.last_heartbeat
+        problems = 0
+        if heartbeat_age > self.config.heartbeat_timeout:
+            problems += 2
+        if health.error_count >= self.config.error_threshold:
+            problems += 1
+        if health.stuck_tasks > 0:
+            problems += 1
+        if agent.status == AgentStatus.ERROR:
+            problems += 2
+        if problems == 0:
+            health.status = HealthStatus.HEALTHY
+        elif problems == 1:
+            health.status = HealthStatus.DEGRADED
+        elif problems == 2:
+            health.status = HealthStatus.UNHEALTHY
+        else:
+            health.status = HealthStatus.CRITICAL
+        return health
+
+    async def check_once(self) -> Dict[str, HealthStatus]:
+        """One monitoring pass; recover/replace as needed."""
+        statuses: Dict[str, HealthStatus] = {}
+        for agent in self.orchestrator.agent_list():
+            health = self._assess(agent)
+            statuses[agent.id] = health.status
+            global_metrics.set_gauge(
+                f"fault.health.{agent.id[:8]}",
+                list(HealthStatus).index(health.status),
+            )
+            if health.status == HealthStatus.UNHEALTHY:
+                await self._try_recover(agent, health)
+            elif health.status == HealthStatus.CRITICAL:
+                if not await self._try_recover(agent, health):
+                    await self._replace_agent(agent, health)
+        # Reap health records of agents no longer in the pool.
+        live = {a.id for a in self.orchestrator.agent_list()}
+        for agent_id in list(self.health):
+            if agent_id not in live:
+                del self.health[agent_id]
+        return statuses
+
+    # ------------------------------------------------------------------ #
+
+    def _recovery_allowed(self, health: AgentHealth) -> bool:
+        """Attempt cap + cooldown (reference ``:263-277``)."""
+        if health.recovery_attempts >= self.config.max_recovery_attempts:
+            return False
+        return time.time() - health.last_recovery >= self.config.recovery_cooldown or \
+            health.recovery_attempts == 0
+
+    async def _try_recover(self, agent: BaseAgent, health: AgentHealth) -> bool:
+        """In-place recovery: stop → reset → start → verify (reference
+        ``:279-311``)."""
+        if not self._recovery_allowed(health):
+            return False
+        health.recovery_attempts += 1
+        health.last_recovery = time.time()
+        self._log.info(
+            "recovering agent %s (attempt %d)",
+            agent.id[:8], health.recovery_attempts,
+        )
+        try:
+            await agent.stop()
+            await agent.reset()
+            await agent.start()
+            ok = agent.status.is_available
+        except Exception as exc:  # noqa: BLE001 - recovery boundary
+            self._log.warning("recovery of %s failed: %s", agent.id[:8], exc)
+            ok = False
+        self._audit("recover", agent.id, ok)
+        if ok:
+            health.status = HealthStatus.HEALTHY
+            agent.send_heartbeat()
+            health.error_count = 0
+            global_metrics.inc("fault.recoveries")
+        return ok
+
+    async def _replace_agent(self, agent: BaseAgent, health: AgentHealth) -> Optional[BaseAgent]:
+        """Spawn a replacement, transfer recoverable work, retire the old
+        agent (reference ``:323-378``)."""
+        self._log.warning("replacing critical agent %s", agent.id[:8])
+        recoverable = self._recoverable_tasks(agent)
+        try:
+            replacement = await self.orchestrator.create_agent(
+                agent_type=agent.config.role_type.value
+                if agent.config.role_type.value in ("worker",)
+                else "worker",
+                config=agent.config.model_copy(),
+            )
+        except Exception as exc:  # noqa: BLE001 - replacement boundary
+            self._log.error("replacement spawn failed: %s", exc)
+            self._audit("replace", agent.id, False)
+            return None
+        transferred = 0
+        for task in recoverable:
+            agent.remove_task(task.id)
+            try:
+                await replacement.add_task(task)
+                transferred += 1
+            except Exception:  # noqa: BLE001
+                task.status = task.status  # leave for orchestrator retry
+        await self.orchestrator.remove_agent(agent.id)
+        self.unregister_agent(agent.id)
+        self.register_agent(replacement)
+        self._audit(
+            "replace", agent.id, True,
+            extra={"replacement": replacement.id, "transferred": transferred},
+        )
+        global_metrics.inc("fault.replacements")
+        self._log.info(
+            "replaced %s -> %s (%d task(s) transferred)",
+            agent.id[:8], replacement.id[:8], transferred,
+        )
+        return replacement
+
+    def _recoverable_tasks(self, agent: BaseAgent) -> List[Any]:
+        """Queued ∧ not marked non-recoverable (reference ``:354-378``)."""
+        return [
+            t for t in agent.queued_tasks()
+            if not t.metadata.get("non_recoverable")
+        ]
+
+    def _audit(self, action: str, agent_id: str, ok: bool, extra: Optional[Dict] = None) -> None:
+        self.recovery_history.append(
+            {
+                "action": action,
+                "agent_id": agent_id,
+                "success": ok,
+                "ts": time.time(),
+                **(extra or {}),
+            }
+        )
+        if len(self.recovery_history) > 1000:
+            del self.recovery_history[:500]
+
+    # ------------------------------------------------------------------ #
+
+    def get_metrics(self) -> Dict[str, Any]:
+        counts: Dict[str, int] = {}
+        for health in self.health.values():
+            counts[health.status.value] = counts.get(health.status.value, 0) + 1
+        return {
+            "agents_tracked": len(self.health),
+            "by_status": counts,
+            "recoveries": int(global_metrics.get("fault.recoveries")),
+            "replacements": int(global_metrics.get("fault.replacements")),
+            "audit_entries": len(self.recovery_history),
+        }

@@ -1,0 +1,169 @@
+"""DynamicScaling: agent-pool autoscaler.
+
+Reference parity: ``pilott/orchestration/orchestration.py`` (the exported
+copy; its dead duplicate in ``scaling.py:425-666`` has no counterpart
+here, §2.12-d) — 60s loop (``:73-83``), system load = weighted queue
+utilization + queue size (``:129-134``), recency-weighted trend over the
+last 5 samples (``:157-167``), scale-up via ``orchestrator.create_agent``
+(``:169-191``), scale-down drains the lowest-success-rate idle agent
+(wait → stop → remove, ``:193-231``), cooldown gate (``:233-240``),
+metrics (``:265-281``).
+
+TPU grounding: "scaling" here resizes the *admission* side — more agents
+means more concurrent reasoning loops feeding the shared engine batcher —
+not OS threads. The engine's slot count stays fixed; agents are cheap.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+from pilottai_tpu.core.config import ScalingConfig
+from pilottai_tpu.core.status import AgentStatus
+from pilottai_tpu.utils.logging import get_logger
+from pilottai_tpu.utils.metrics import global_metrics
+
+
+class DynamicScaling:
+    """Grows/drains the orchestrator's agent pool on load trend."""
+
+    def __init__(
+        self,
+        orchestrator: Any,  # Serve
+        config: Optional[ScalingConfig] = None,
+        agent_type: str = "worker",
+    ) -> None:
+        self.orchestrator = orchestrator
+        self.config = config or ScalingConfig()
+        self.agent_type = agent_type
+        self._samples: deque = deque(maxlen=self.config.trend_window)
+        self._last_action = 0.0
+        self._task: Optional[asyncio.Task] = None
+        self._log = get_logger("orchestration.scaling")
+        self.scale_ups = 0
+        self.scale_downs = 0
+
+    # ------------------------------------------------------------------ #
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._scaling_loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _scaling_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.config.check_interval)
+            try:
+                await self.scale_once()
+            except Exception as exc:  # noqa: BLE001 - keep the loop alive
+                self._log.error("scaling cycle failed: %s", exc, exc_info=True)
+
+    # ------------------------------------------------------------------ #
+
+    def system_load(self) -> float:
+        """0.45 mean agent queue-util + 0.30 orchestrator queue fill +
+        0.25 running-task saturation (reference weights ``:129-134``,
+        psutil terms replaced with engine-side signals)."""
+        agents = self.orchestrator.agent_list()
+        mean_queue = (
+            sum(a.queue_utilization for a in agents) / len(agents) if agents else 1.0
+        )
+        backlog = len(self.orchestrator.task_queue) / max(
+            self.orchestrator.config.max_queue_size, 1
+        )
+        running = len(self.orchestrator.running_tasks) / max(
+            self.orchestrator.config.max_concurrent_tasks, 1
+        )
+        weighted = 0.45 * mean_queue + 0.30 * backlog + 0.25 * min(running, 1.0)
+        # Floor by mean queue utilization: saturated agent queues alone must
+        # cross the scale-up threshold even with an empty orchestrator queue.
+        return min(1.0, max(mean_queue, weighted))
+
+    def trend(self) -> float:
+        """Recency-weighted slope (reference ``:157-167``)."""
+        if len(self._samples) < 2:
+            return 0.0
+        weights = range(1, len(self._samples))
+        deltas = [
+            (self._samples[i] - self._samples[i - 1]) * w
+            for i, w in zip(range(1, len(self._samples)), weights)
+        ]
+        return sum(deltas) / sum(weights)
+
+    def _cooled_down(self) -> bool:
+        return time.monotonic() - self._last_action >= self.config.cooldown
+
+    async def scale_once(self) -> Optional[str]:
+        """One scaling decision; returns "up"/"down"/None."""
+        load = self.system_load()
+        self._samples.append(load)
+        n_agents = len(self.orchestrator.agents)
+        global_metrics.set_gauge("scaling.system_load", load)
+
+        if (
+            load > self.config.scale_up_threshold
+            and n_agents < self.config.max_agents
+            and self._cooled_down()
+        ):
+            await self._scale_up()
+            return "up"
+        if (
+            load < self.config.scale_down_threshold
+            and self.trend() <= 0
+            and n_agents > self.config.min_agents
+            and self._cooled_down()
+        ):
+            if await self._scale_down():
+                return "down"
+        return None
+
+    async def _scale_up(self) -> None:
+        agent = await self.orchestrator.create_agent(self.agent_type)
+        self._last_action = time.monotonic()
+        self.scale_ups += 1
+        global_metrics.inc("scaling.scale_ups")
+        self._log.info("scaled up: new agent %s (pool=%d)",
+                       agent.id[:8], len(self.orchestrator.agents))
+
+    async def _scale_down(self) -> bool:
+        """Drain the lowest-success-rate idle agent (reference ``:193-231``)."""
+        idle = [
+            a for a in self.orchestrator.agent_list()
+            if a.status == AgentStatus.IDLE
+            and not a.current_tasks
+            and a.task_queue.qsize() == 0
+        ]
+        if not idle:
+            return False
+        victim = min(idle, key=lambda a: a.success_rate)
+        await self.orchestrator.remove_agent(victim.id)
+        self._last_action = time.monotonic()
+        self.scale_downs += 1
+        global_metrics.inc("scaling.scale_downs")
+        self._log.info("scaled down: removed agent %s (pool=%d)",
+                       victim.id[:8], len(self.orchestrator.agents))
+        return True
+
+    # ------------------------------------------------------------------ #
+
+    def get_metrics(self) -> Dict[str, Any]:
+        return {
+            "system_load": self.system_load(),
+            "trend": self.trend(),
+            "agents": len(self.orchestrator.agents),
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "min_agents": self.config.min_agents,
+            "max_agents": self.config.max_agents,
+        }

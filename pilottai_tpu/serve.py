@@ -392,6 +392,14 @@ class Serve:
             asyncio.shield(future), timeout=timeout or self.config.task_timeout * 4
         )
 
+    async def requeue_task(self, task: Task) -> None:
+        """Put a detached task back through orchestrator routing (used by
+        the load balancer's last-resort rollback)."""
+        task.status = TaskStatus.PENDING
+        task.agent_id = None
+        self.all_tasks.setdefault(task.id, task)
+        await self._queue_task(task)
+
     def get_task(self, task_id: str) -> Optional[Task]:
         return self.all_tasks.get(task_id)
 

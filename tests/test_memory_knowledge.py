@@ -1,0 +1,242 @@
+"""Semantic memory (embedding search on device), knowledge manager and
+delegation tests."""
+
+import asyncio
+import time
+
+import pytest
+
+from pilottai_tpu.core.agent import BaseAgent
+from pilottai_tpu.core.config import AgentConfig, LLMConfig
+from pilottai_tpu.core.task import Task
+from pilottai_tpu.delegation.delegator import TaskDelegator
+from pilottai_tpu.engine.handler import LLMHandler
+from pilottai_tpu.knowledge.manager import KnowledgeManager
+from pilottai_tpu.knowledge.source import CallableSource, FileSource, MemorySource
+from pilottai_tpu.memory.embedder import Embedder
+from pilottai_tpu.memory.semantic import EnhancedMemory
+
+
+@pytest.fixture(scope="module")
+def embedder():
+    return Embedder(model_name="llama-tiny", max_len=64)
+
+
+# --------------------------- embedder ---------------------------------- #
+
+def test_embedder_shapes_and_normalization(embedder):
+    vecs = embedder.encode(["hello world", "completely different text here"])
+    assert vecs.shape == (2, embedder.dim)
+    import numpy as np
+
+    norms = np.linalg.norm(vecs, axis=1)
+    assert np.allclose(norms, 1.0, atol=1e-4)
+
+
+def test_embedder_similarity_orders_sensibly(embedder):
+    import numpy as np
+
+    base = embedder.encode_one("the quarterly financial report shows revenue")
+    near = embedder.encode_one("the quarterly financial report shows profit")
+    far = embedder.encode_one("zx9!@ qq")
+    assert float(base @ near) > float(base @ far)
+
+
+# --------------------------- semantic memory ---------------------------- #
+
+@pytest.mark.asyncio
+async def test_semantic_search_finds_similar(embedder):
+    mem = EnhancedMemory(embedder=embedder, capacity=100)
+    await mem.store_semantic("revenue grew 20 percent in the fourth quarter")
+    await mem.store_semantic("the cat sat on the windowsill all afternoon")
+    await mem.store_semantic("profits increased during the final quarter")
+    hits = await mem.semantic_search(
+        "revenue grew 20 percent in the fourth quarter", limit=2
+    )
+    assert hits and "quarter" in hits[0]["text"]
+    assert hits[0]["score"] >= hits[-1]["score"]
+
+
+@pytest.mark.asyncio
+async def test_semantic_search_tag_and_priority_filters(embedder):
+    mem = EnhancedMemory(embedder=embedder, capacity=100)
+    await mem.store_semantic("alpha record", tags={"a"}, priority=5)
+    await mem.store_semantic("alpha record", tags={"b"}, priority=1)
+    hits = await mem.semantic_search("alpha record", tags={"a"})
+    assert all("a" in h["tags"] for h in hits)
+    hits = await mem.semantic_search("alpha record", min_priority=3)
+    assert all(h["priority"] >= 3 for h in hits)
+
+
+@pytest.mark.asyncio
+async def test_keyword_fallback_without_embedder():
+    mem = EnhancedMemory(embedder=None)
+    await mem.store_semantic("找不到 needle in haystack")
+    hits = await mem.semantic_search("NEEDLE")
+    assert len(hits) == 1
+
+
+@pytest.mark.asyncio
+async def test_ttl_expiry_and_cleanup(embedder):
+    mem = EnhancedMemory(embedder=None)
+    await mem.store_semantic("ephemeral", ttl=0.01)
+    await mem.store_semantic("durable")
+    await asyncio.sleep(0.02)
+    assert await mem.semantic_search("ephemeral") == []
+    removed = await mem.cleanup()
+    assert removed == 1
+    assert mem.get_metrics()["semantic_items"] == 1
+
+
+@pytest.mark.asyncio
+async def test_eviction_at_capacity(embedder):
+    mem = EnhancedMemory(embedder=embedder, capacity=3)
+    for i in range(5):
+        await mem.store_semantic(f"record number {i}")
+    assert mem.get_metrics()["semantic_items"] == 3
+    hits = await mem.semantic_search("record number 4", limit=5)
+    assert all(int(h["text"].split()[-1]) >= 2 for h in hits)
+
+
+@pytest.mark.asyncio
+async def test_task_history_versioning_and_patterns():
+    mem = EnhancedMemory()
+    await mem.store_task("t1", {"phase": "start"})
+    await mem.store_task("t1", {"phase": "end"})
+    history = await mem.get_task_history("t1")
+    assert [h["version"] for h in history] == [0, 1]
+    recents = await mem.get_recent_tasks()
+    assert recents[0]["phase"] == "end"
+
+    await mem.store_pattern("retry_policy", {"max": 3}, ttl=50)
+    assert (await mem.get_pattern("retry_policy"))["max"] == 3
+    await mem.store_pattern("stale", 1, ttl=0.001)
+    await asyncio.sleep(0.01)
+    assert await mem.get_pattern("stale") is None
+
+
+@pytest.mark.asyncio
+async def test_interaction_log_filters():
+    mem = EnhancedMemory()
+    await mem.log_interaction("a", "b", "hi")
+    await mem.log_interaction("b", "c", "yo")
+    assert len(await mem.get_interactions("a")) == 1
+    assert len(await mem.get_interactions()) == 2
+
+
+# --------------------------- knowledge ---------------------------------- #
+
+@pytest.mark.asyncio
+async def test_knowledge_file_source_and_cache(tmp_path):
+    doc = tmp_path / "notes.txt"
+    doc.write_text("alpha fact one\nbeta fact two\nalpha fact three\n")
+    km = KnowledgeManager(cache_ttl=100)
+    await km.add_source(FileSource("notes", doc))
+    hits = await km.query_knowledge("alpha")
+    assert len(hits) == 2
+    await km.query_knowledge("alpha")
+    stats = km.get_cache_stats()
+    assert stats["hits"] == 1 and stats["misses"] == 1
+    assert km.invalidate("alpha@*") == 1
+
+
+@pytest.mark.asyncio
+async def test_knowledge_retry_then_success():
+    attempts = {"n": 0}
+
+    def flaky(query):
+        attempts["n"] += 1
+        if attempts["n"] < 2:
+            raise RuntimeError("transient")
+        return [{"answer": 42}]
+
+    source = CallableSource("flaky", flaky, retries=2, retry_delay=0.01)
+    km = KnowledgeManager()
+    await km.add_source(source)
+    hits = await km.query_knowledge("anything", use_cache=False)
+    assert hits and hits[0]["answer"] == 42
+    assert attempts["n"] == 2
+
+
+@pytest.mark.asyncio
+async def test_knowledge_memory_source(embedder):
+    mem = EnhancedMemory(embedder=embedder, capacity=50)
+    await mem.store_semantic("kubernetes cluster configuration guide")
+    km = KnowledgeManager()
+    await km.add_source(MemorySource("memory", mem))
+    hits = await km.query_knowledge("kubernetes cluster configuration guide")
+    assert hits and hits[0]["source"] == "memory"
+
+
+@pytest.mark.asyncio
+async def test_knowledge_unknown_source():
+    km = KnowledgeManager()
+    with pytest.raises(KeyError):
+        await km.query_knowledge("x", sources=["ghost"])
+
+
+# --------------------------- delegation --------------------------------- #
+
+def make_agent(**cfg_kwargs):
+    return BaseAgent(
+        config=AgentConfig(**cfg_kwargs),
+        llm=LLMHandler(LLMConfig(provider="mock")),
+    )
+
+
+@pytest.mark.asyncio
+async def test_delegation_gates():
+    manager = make_agent(role="manager", delegation_enabled=True,
+                         max_task_complexity=3)
+    child = make_agent(role="worker")
+    await child.start()
+    manager.add_child_agent(child)
+    delegator = TaskDelegator(manager)
+
+    simple = Task(description="easy", complexity=1)
+    target, reason = await delegator.evaluate_delegation(simple)
+    assert target is None and "self-execution" in reason
+
+    complex_task = Task(description="hard", complexity=8)
+    target, reason = await delegator.evaluate_delegation(complex_task)
+    assert target is child and "complexity" in reason
+
+
+@pytest.mark.asyncio
+async def test_delegation_disabled():
+    manager = make_agent(role="manager", delegation_enabled=False)
+    delegator = TaskDelegator(manager)
+    target, reason = await delegator.evaluate_delegation(
+        Task(description="x", complexity=9)
+    )
+    assert target is None and "disabled" in reason
+
+
+@pytest.mark.asyncio
+async def test_delegation_prefers_historically_successful():
+    manager = make_agent(role="manager", delegation_enabled=True,
+                         max_task_complexity=2)
+    good, bad = make_agent(role="w1"), make_agent(role="w2")
+    await good.start(); await bad.start()
+    manager.add_child_agent(good); manager.add_child_agent(bad)
+    delegator = TaskDelegator(manager)
+    for _ in range(5):
+        await delegator.record_delegation(good.id, Task(description="x", type="etl"),
+                                          success=True, execution_time=1.0)
+        await delegator.record_delegation(bad.id, Task(description="x", type="etl"),
+                                          success=False, execution_time=1.0,
+                                          error="ValueError: boom")
+    task = Task(description="new etl", type="etl", complexity=5)
+    target, _ = await delegator.evaluate_delegation(task)
+    assert target is good
+    metrics = delegator.get_metrics()
+    assert metrics[bad.id]["errors_by_type"]["ValueError"] == 5
+
+
+@pytest.mark.asyncio
+async def test_delegation_history_cleanup():
+    manager = make_agent(role="m", delegation_enabled=True)
+    delegator = TaskDelegator(manager, history_retention=0.01)
+    await delegator.record_delegation("a1", Task(description="x"), success=True)
+    await asyncio.sleep(0.02)
+    assert await delegator.cleanup_history() == 1

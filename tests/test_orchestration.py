@@ -1,0 +1,243 @@
+"""Orchestration control-plane tests — reference strategy (SURVEY §4):
+force the metric inputs, assert the control decision."""
+
+import asyncio
+import time
+
+import pytest
+
+from pilottai_tpu.core.agent import BaseAgent
+from pilottai_tpu.core.config import (
+    AgentConfig,
+    FaultToleranceConfig,
+    LLMConfig,
+    LoadBalancerConfig,
+    ScalingConfig,
+    ServeConfig,
+)
+from pilottai_tpu.core.status import AgentStatus, HealthStatus
+from pilottai_tpu.core.task import Task
+from pilottai_tpu.engine.handler import LLMHandler
+from pilottai_tpu.orchestration.fault_tolerance import FaultTolerance
+from pilottai_tpu.orchestration.load_balancer import LoadBalancer
+from pilottai_tpu.orchestration.scaling import DynamicScaling
+from pilottai_tpu.serve import Serve
+
+
+def worker(**cfg):
+    return BaseAgent(
+        config=AgentConfig(role="worker", **cfg),
+        llm=LLMHandler(LLMConfig(provider="mock")),
+    )
+
+
+def make_serve(agents):
+    return Serve(
+        name="orch-test",
+        agents=agents,
+        manager_llm=LLMHandler(LLMConfig(provider="mock")),
+        config=ServeConfig(max_concurrent_tasks=4),
+    )
+
+
+# ----------------------------- balancer -------------------------------- #
+
+@pytest.mark.asyncio
+async def test_balancer_moves_tasks_from_hot_to_cold():
+    hot = worker(max_queue_size=4)
+    cold = worker(max_queue_size=100)
+    await hot.start(); await cold.start()
+    for i in range(4):
+        await hot.add_task(Task(description=f"queued {i}"))
+    serve = make_serve([hot, cold])
+    lb = LoadBalancer(serve, LoadBalancerConfig(max_tasks_per_cycle=2))
+    moved = await lb.balance_once()
+    assert moved == 2
+    assert len(cold.queued_tasks()) == 2
+    assert len(hot.queued_tasks()) == 2
+    assert lb.get_metrics()["moves"] == 2
+
+
+@pytest.mark.asyncio
+async def test_balancer_respects_unmoveable():
+    hot = worker(max_queue_size=2)
+    cold = worker()
+    await hot.start(); await cold.start()
+    pinned = Task(description="pinned", metadata={"unmoveable": True})
+    await hot.add_task(pinned)
+    await hot.add_task(Task(description="free"))
+    serve = make_serve([hot, cold])
+    lb = LoadBalancer(serve)
+    await lb.balance_once()
+    assert pinned.id in {t.id for t in hot.queued_tasks()}
+
+
+@pytest.mark.asyncio
+async def test_balancer_noop_when_balanced():
+    a, b = worker(), worker()
+    await a.start(); await b.start()
+    serve = make_serve([a, b])
+    lb = LoadBalancer(serve)
+    assert await lb.balance_once() == 0
+
+
+# ----------------------------- scaling --------------------------------- #
+
+@pytest.mark.asyncio
+async def test_scaling_up_on_high_load():
+    busy = worker(max_queue_size=2)
+    await busy.start()
+    for i in range(2):
+        await busy.add_task(Task(description=f"q{i}"))
+    serve = make_serve([busy])
+    scaler = DynamicScaling(
+        serve, ScalingConfig(min_agents=1, max_agents=3, cooldown=0.0)
+    )
+    decision = await scaler.scale_once()
+    assert decision == "up"
+    assert len(serve.agents) == 2
+    assert scaler.scale_ups == 1
+
+
+@pytest.mark.asyncio
+async def test_scaling_down_drains_idle_lowest_success():
+    a, b, c = worker(), worker(), worker()
+    for agent in (a, b, c):
+        await agent.start()
+    b.task_metrics["failed"] = 5  # lowest success rate
+    serve = make_serve([a, b, c])
+    scaler = DynamicScaling(
+        serve, ScalingConfig(min_agents=1, max_agents=5, cooldown=0.0,
+                             scale_down_threshold=0.5)
+    )
+    decision = await scaler.scale_once()
+    assert decision == "down"
+    assert b.id not in serve.agents
+    assert b.status == AgentStatus.STOPPED
+
+
+@pytest.mark.asyncio
+async def test_scaling_cooldown_blocks_consecutive_actions():
+    busy = worker(max_queue_size=1)
+    await busy.start()
+    await busy.add_task(Task(description="q"))
+    serve = make_serve([busy])
+    scaler = DynamicScaling(
+        serve,
+        ScalingConfig(min_agents=1, max_agents=5, cooldown=300.0,
+                      scale_up_threshold=0.3),
+    )
+    assert await scaler.scale_once() == "up"
+    assert await scaler.scale_once() is None  # cooling down
+
+
+@pytest.mark.asyncio
+async def test_scaling_respects_max_agents():
+    busy = worker(max_queue_size=1)
+    await busy.start()
+    await busy.add_task(Task(description="q"))
+    serve = make_serve([busy])
+    scaler = DynamicScaling(
+        serve, ScalingConfig(min_agents=1, max_agents=1, cooldown=0.0)
+    )
+    assert await scaler.scale_once() is None
+
+
+# ----------------------------- fault tolerance -------------------------- #
+
+@pytest.mark.asyncio
+async def test_health_classification_and_recovery():
+    agent = worker()
+    await agent.start()
+    serve = make_serve([agent])
+    ft = FaultTolerance(serve, FaultToleranceConfig(
+        heartbeat_timeout=0.05, recovery_cooldown=0.0, max_recovery_attempts=3,
+    ))
+    ft.register_agent(agent)
+    statuses = await ft.check_once()
+    assert statuses[agent.id] == HealthStatus.HEALTHY
+
+    # Stale heartbeat -> UNHEALTHY -> in-place recovery refreshes it.
+    agent._last_heartbeat = time.time() - 10
+    statuses = await ft.check_once()
+    assert ft.health[agent.id].recovery_attempts == 1
+    assert agent.status == AgentStatus.IDLE
+    assert time.time() - agent._last_heartbeat < 5
+    assert ft.recovery_history[-1]["action"] == "recover"
+    assert ft.recovery_history[-1]["success"] is True
+
+
+@pytest.mark.asyncio
+async def test_critical_agent_replaced_with_task_transfer():
+    sick = worker()
+    await sick.start()
+    await sick.add_task(Task(description="queued work"))
+    await sick.add_task(Task(description="lost cause", metadata={"non_recoverable": True}))
+    serve = make_serve([sick])
+    ft = FaultTolerance(serve, FaultToleranceConfig(
+        heartbeat_timeout=0.01, max_recovery_attempts=0,  # recovery exhausted
+        error_threshold=1,
+    ))
+    ft.register_agent(agent := sick)
+    # stale heartbeat + errors + error status -> CRITICAL
+    agent._last_heartbeat = time.time() - 100
+    agent._error_count = 5
+    agent.status = AgentStatus.ERROR
+    await ft.check_once()
+    assert sick.id not in serve.agents
+    assert len(serve.agents) == 1
+    replacement = next(iter(serve.agents.values()))
+    transferred = replacement.queued_tasks()
+    assert len(transferred) == 1
+    assert transferred[0].description == "queued work"
+    assert ft.get_metrics()["replacements"] >= 1
+
+
+@pytest.mark.asyncio
+async def test_recovery_attempt_cap():
+    agent = worker()
+    await agent.start()
+    serve = make_serve([agent])
+    ft = FaultTolerance(serve, FaultToleranceConfig(
+        heartbeat_timeout=0.01, recovery_cooldown=1000.0, max_recovery_attempts=1,
+    ))
+    ft.register_agent(agent)
+    agent._last_heartbeat = time.time() - 100
+
+    async def fail_start():
+        raise RuntimeError("cannot start")
+
+    original_start = agent.start
+    agent.start = fail_start  # recovery fails
+    await ft.check_once()
+    assert ft.health[agent.id].recovery_attempts == 1
+    agent._last_heartbeat = time.time() - 100
+    await ft.check_once()  # capped: no second attempt
+    assert ft.health[agent.id].recovery_attempts == 1
+    agent.start = original_start
+
+
+# ----------------------------- integrated lifecycle --------------------- #
+
+@pytest.mark.asyncio
+async def test_services_wired_into_serve_lifecycle():
+    serve = Serve(
+        name="wired",
+        agents=[worker(), worker()],
+        manager_llm=LLMHandler(LLMConfig(provider="mock")),
+        config=ServeConfig(
+            load_balancing_enabled=True,
+            dynamic_scaling_enabled=True,
+            fault_tolerance_enabled=True,
+        ),
+    )
+    await serve.start()
+    try:
+        assert serve.load_balancer is not None
+        assert serve.dynamic_scaling is not None
+        assert serve.fault_tolerance is not None
+        result = await serve.execute_task("work under full services", timeout=30)
+        assert result.success
+    finally:
+        await serve.stop()
+    assert serve.load_balancer._task is None  # loops actually stopped

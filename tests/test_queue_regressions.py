@@ -1,0 +1,66 @@
+"""Regressions for the agent queue ghost-slot findings: detached tasks must
+free capacity immediately, and rebalance rollback must never orphan work."""
+
+import asyncio
+
+import pytest
+
+from pilottai_tpu.core.agent import AgentTaskQueue, BaseAgent
+from pilottai_tpu.core.config import AgentConfig, LLMConfig
+from pilottai_tpu.core.task import Task
+from pilottai_tpu.engine.handler import LLMHandler
+
+
+def worker(**cfg):
+    return BaseAgent(config=AgentConfig(role="w", **cfg),
+                     llm=LLMHandler(LLMConfig(provider="mock")))
+
+
+def test_removed_tasks_free_capacity_immediately():
+    q = AgentTaskQueue(maxsize=2)
+    a, b = Task(description="a"), Task(description="b")
+    q.put_nowait(a); q.put_nowait(b)
+    with pytest.raises(asyncio.QueueFull):
+        q.put_nowait(Task(description="c"))
+    q.remove(a.id)
+    q.put_nowait(Task(description="d"))  # ghost slot must not block this
+    assert q.qsize() == 2
+    got = [q.get_nowait().description, q.get_nowait().description]
+    assert got == ["b", "d"]  # removed 'a' skipped
+    with pytest.raises(asyncio.QueueEmpty):
+        q.get_nowait()
+
+
+@pytest.mark.asyncio
+async def test_agent_accepts_after_rebalance_detach():
+    agent = worker(max_queue_size=2)
+    await agent.start()
+    t1, t2 = Task(description="t1"), Task(description="t2")
+    await agent.add_task(t1); await agent.add_task(t2)
+    agent.remove_task(t1.id)
+    await agent.add_task(Task(description="t3"))  # must not raise
+    assert agent.task_queue.qsize() == 2
+
+
+@pytest.mark.asyncio
+async def test_queue_get_timeout_returns_none():
+    q = AgentTaskQueue(maxsize=1)
+    assert await q.get(timeout=0.05) is None
+
+
+@pytest.mark.asyncio
+async def test_queue_worker_skips_detached():
+    agent = worker(max_queue_size=4)
+    await agent.start()
+    keep, drop = Task(description="keep"), Task(description="drop")
+    await agent.add_task(drop); await agent.add_task(keep)
+    agent.remove_task(drop.id)
+    agent.start_queue_worker()
+    for _ in range(100):
+        if agent.task_metrics["completed"] >= 1:
+            break
+        await asyncio.sleep(0.05)
+    await agent.stop()
+    assert agent.task_metrics["completed"] == 1
+    ids = [h["task_id"] for h in agent.task_history]
+    assert ids == [keep.id]
