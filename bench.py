@@ -1,0 +1,104 @@
+"""Headline benchmark: agent-steps/sec/chip through the in-tree engine.
+
+An "agent step" is one LLM call inside the agent's plan/act/evaluate loop
+(SURVEY.md §3.4: a simple task is ≥4 such calls; the reference pays a
+remote HTTPS round-trip per step, ``pilott/engine/llm.py:59``). Here the
+same step runs on local devices through the continuous batcher.
+
+Baseline: the reference publishes no numbers (SURVEY.md §6); BASELINE.json's
+north star is ≤500 ms p50 per agent step → 2.0 steps/sec/chip. vs_baseline
+is measured steps/sec/chip against that 2.0.
+
+Prints ONE JSON line.
+"""
+
+import asyncio
+import json
+import statistics
+import time
+
+import jax
+
+
+CONCURRENCY = 32       # concurrent agent steps in flight
+STEPS = 96             # total timed steps
+MAX_NEW_TOKENS = 48    # JSON-ish agent-step reply length
+BASELINE_STEPS_PER_SEC = 2.0
+
+
+def pick_config():
+    platform = jax.default_backend()
+    on_accel = platform not in ("cpu",)
+    from pilottai_tpu.core.config import LLMConfig
+
+    return on_accel, LLMConfig(
+        model_name="llama3-1b-byte" if on_accel else "llama-tiny",
+        provider="tpu" if on_accel else "cpu",
+        engine_slots=min(CONCURRENCY, 32),
+        engine_max_seq=512,
+        dtype="bfloat16" if on_accel else "float32",
+    )
+
+
+PROMPT = (
+    "Analyze the task and respond with JSON: "
+    '{"requires_decomposition": false, "complexity": 3, '
+    '"estimated_resources": {"agents": 1}}. Task: summarize the quarterly '
+    "report into three bullet points for the executive team."
+)
+
+
+async def run_bench():
+    on_accel, cfg = pick_config()
+    from pilottai_tpu.engine.handler import LLMHandler
+    from pilottai_tpu.engine.types import GenerationParams
+
+    handler = LLMHandler(cfg)
+    params = GenerationParams(max_new_tokens=MAX_NEW_TOKENS, temperature=0.0)
+
+    async def one_step():
+        resp = await handler.apredict(PROMPT, params=params)
+        return resp
+
+    # Warmup: compile prefill bucket + decode, fill the pipeline.
+    await asyncio.gather(*[one_step() for _ in range(min(8, CONCURRENCY))])
+
+    latencies = []
+    done = 0
+    t0 = time.perf_counter()
+
+    async def worker():
+        nonlocal done
+        while done < STEPS:
+            done += 1
+            s = time.perf_counter()
+            await one_step()
+            latencies.append(time.perf_counter() - s)
+
+    await asyncio.gather(*[worker() for _ in range(CONCURRENCY)])
+    wall = time.perf_counter() - t0
+    await handler.stop()
+
+    n_chips = max(len(jax.devices()), 1) if on_accel else 1
+    steps_per_sec_chip = len(latencies) / wall / n_chips
+    p50_ms = statistics.median(latencies) * 1000.0
+    print(
+        json.dumps(
+            {
+                "metric": "agent_steps_per_sec_per_chip",
+                "value": round(steps_per_sec_chip, 3),
+                "unit": "steps/s/chip",
+                "vs_baseline": round(steps_per_sec_chip / BASELINE_STEPS_PER_SEC, 3),
+                "p50_step_ms": round(p50_ms, 1),
+                "model": cfg.model_name,
+                "provider": cfg.provider,
+                "n_chips": n_chips,
+                "concurrency": CONCURRENCY,
+                "steps": len(latencies),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    asyncio.run(run_bench())

@@ -27,7 +27,7 @@ import uuid
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
-from pilottai_tpu.core.config import AgentConfig
+from pilottai_tpu.core.config import AgentConfig, LLMConfig
 from pilottai_tpu.core.status import AgentStatus
 from pilottai_tpu.core.task import Task, TaskResult, TaskStatus
 from pilottai_tpu.prompts.manager import PromptManager
@@ -117,6 +117,10 @@ class BaseAgent:
                 "BaseAgent requires an llm handle (LLMHandler); use "
                 "LLMConfig(provider='mock') for tests"
             )  # reference enforces the same at core/agent.py:77
+        if isinstance(llm, (LLMConfig, dict)):
+            from pilottai_tpu.engine.handler import LLMHandler
+
+            llm = LLMHandler(llm)
         self.llm = llm
         self.id = agent_id or str(uuid.uuid4())
         self.role = self.config.role

@@ -55,6 +55,24 @@ class MemoryItem:
         }
 
 
+# Module-level so the jit cache is hit on every search (a per-call closure
+# would re-trace and re-compile each query). jax import stays lazy.
+_TOPK_FN = None
+
+
+def _topk(vectors, q, k):
+    global _TOPK_FN
+    if _TOPK_FN is None:
+        import jax
+
+        @partial(jax.jit, static_argnames=("k",))
+        def fn(vectors, q, k):
+            return jax.lax.top_k(vectors @ q, k)
+
+        _TOPK_FN = fn
+    return _TOPK_FN(vectors, q, k=k)
+
+
 class _VectorStore:
     """Fixed-capacity embedding ring buffer with device top-k search.
 
@@ -92,13 +110,7 @@ class _VectorStore:
             self._row_ids[row] = -1
 
     def search(self, query: np.ndarray, k: int) -> List[Tuple[int, float]]:
-        import jax
         import jax.numpy as jnp
-
-        @partial(jax.jit, static_argnames=("k",))
-        def _topk(vectors, q, k):
-            scores = vectors @ q
-            return jax.lax.top_k(scores, k)
 
         k = min(k, self.capacity)
         scores, rows = _topk(self._vectors, jnp.asarray(query, jnp.float32), k)

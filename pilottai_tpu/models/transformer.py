@@ -78,6 +78,40 @@ def _unembed(cfg: ModelConfig, params: Dict[str, Any], x: jax.Array) -> jax.Arra
     return logits
 
 
+def _full_seq_block(
+    cfg: ModelConfig,
+    qscale: float,
+    x: jax.Array,
+    lp: Dict[str, Any],
+    window: jax.Array,
+    sin: jax.Array,
+    cos: jax.Array,
+    ipos: jax.Array,
+    jpos: jax.Array,
+    base_mask: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One transformer block over a full sequence (shared by prefill and
+    the training forward). Returns (x, k, v)."""
+    win_mask = jnp.where(window > 0, (ipos - jpos) < jnp.maximum(window, 1), True)
+    mask = base_mask & win_mask
+    h = rms_norm(x, lp["ln1"]["scale"], cfg.rms_eps, cfg.rms_offset)
+    q, k, v = _qkv(cfg, lp["attn"], h, sin, cos)
+    attn = dot_product_attention(
+        q, k, v, mask=mask, scale=qscale, logit_softcap=cfg.attn_softcap
+    )
+    out = _attn_out(cfg, lp["attn"], attn)
+    if cfg.post_norms:
+        out = rms_norm(out, lp["ln1_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
+    x = x + out
+    h = rms_norm(x, lp["ln2"]["scale"], cfg.rms_eps, cfg.rms_offset)
+    out = _mlp(cfg, lp["mlp"], h)
+    if cfg.post_norms:
+        out = rms_norm(out, lp["ln2_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
+    x = x + out
+    x = with_logical_constraint(x, ("batch", "seq", None))
+    return x, k, v
+
+
 # --------------------------------------------------------------------- #
 # Prefill
 # --------------------------------------------------------------------- #
@@ -111,23 +145,9 @@ def forward_prefill(
     def layer_fn(carry, scanned):
         x = carry
         lp, window = scanned
-        win_mask = jnp.where(window > 0, (ipos - jpos) < jnp.maximum(window, 1), True)
-        mask = base_mask & win_mask
-        h = rms_norm(x, lp["ln1"]["scale"], cfg.rms_eps, cfg.rms_offset)
-        q, k, v = _qkv(cfg, lp["attn"], h, sin, cos)
-        attn = dot_product_attention(
-            q, k, v, mask=mask, scale=qscale, logit_softcap=cfg.attn_softcap
+        x, k, v = _full_seq_block(
+            cfg, qscale, x, lp, window, sin, cos, ipos, jpos, base_mask
         )
-        out = _attn_out(cfg, lp["attn"], attn)
-        if cfg.post_norms:
-            out = rms_norm(out, lp["ln1_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
-        x = x + out
-        h = rms_norm(x, lp["ln2"]["scale"], cfg.rms_eps, cfg.rms_offset)
-        out = _mlp(cfg, lp["mlp"], h)
-        if cfg.post_norms:
-            out = rms_norm(out, lp["ln2_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
-        x = x + out
-        x = with_logical_constraint(x, ("batch", "seq", None))
         return x, (k, v)
 
     x, (ks, vs) = jax.lax.scan(
@@ -199,3 +219,57 @@ def forward_decode(
     new_cache = KVCache(k=new_k, v=new_v, lengths=new_lengths)
     del B
     return logits, new_cache
+
+
+# --------------------------------------------------------------------- #
+# Training forward
+# --------------------------------------------------------------------- #
+
+@partial(jax.jit, static_argnames=("cfg", "remat"))
+def forward_train(
+    params: Dict[str, Any],
+    cfg: ModelConfig,
+    tokens: jax.Array,      # [B, T] (right-padded)
+    positions: jax.Array,   # [B, T]
+    valid: jax.Array,       # [B] true lengths
+    remat: bool = True,
+) -> jax.Array:
+    """Full-sequence forward for training: logits only, no KV outputs.
+
+    With ``remat=True`` each layer body is wrapped in ``jax.checkpoint``
+    so the backward pass recomputes activations instead of storing T×L of
+    them — the HBM-for-FLOPs trade that makes long-sequence training fit.
+    No reference counterpart (the reference has no training at all,
+    SURVEY.md §1 "What the reference is NOT").
+    """
+    x = _embed(cfg, params, tokens)
+    x = with_logical_constraint(x, ("batch", "seq", None))
+    sin, cos = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+    windows = jnp.asarray(cfg.window_sizes())
+    qscale = cfg.query_scale if cfg.query_scale is not None else cfg.head_dim**-0.5
+
+    T = tokens.shape[1]
+    jpos = positions[:, None, :]
+    ipos = positions[:, :, None]
+    base_mask = (jpos <= ipos) & (
+        jnp.arange(T)[None, None, :] < valid[:, None, None]
+    )
+
+    def block(x, lp, window):
+        x, _, _ = _full_seq_block(
+            cfg, qscale, x, lp, window, sin, cos, ipos, jpos, base_mask
+        )
+        return x
+
+    if remat:
+        block = jax.checkpoint(
+            block, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+
+    def layer_fn(carry, scanned):
+        lp, window = scanned
+        return block(carry, lp, window), None
+
+    x, _ = jax.lax.scan(layer_fn, x, (params["layers"], windows))
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps, cfg.rms_offset)
+    return _unembed(cfg, params, x)

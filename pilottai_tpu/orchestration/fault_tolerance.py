@@ -175,6 +175,14 @@ class FaultTolerance:
             "recovering agent %s (attempt %d)",
             agent.id[:8], health.recovery_attempts,
         )
+        # Detach the backlog first: reset() cancels whatever is still
+        # queued, and a stale heartbeat must not cost the agent its work.
+        preserved = [
+            t for t in (
+                agent.remove_task(task.id)
+                for task in self._recoverable_tasks(agent)
+            ) if t is not None
+        ]
         try:
             await agent.stop()
             await agent.reset()
@@ -183,6 +191,14 @@ class FaultTolerance:
         except Exception as exc:  # noqa: BLE001 - recovery boundary
             self._log.warning("recovery of %s failed: %s", agent.id[:8], exc)
             ok = False
+        for task in preserved:
+            if ok:
+                try:
+                    await agent.add_task(task)
+                    continue
+                except Exception:  # noqa: BLE001 - fall through to requeue
+                    pass
+            await self._requeue(task)
         self._audit("recover", agent.id, ok)
         if ok:
             health.status = HealthStatus.HEALTHY
@@ -196,11 +212,15 @@ class FaultTolerance:
         agent (reference ``:323-378``)."""
         self._log.warning("replacing critical agent %s", agent.id[:8])
         recoverable = self._recoverable_tasks(agent)
+        from pilottai_tpu.core.factory import AgentFactory
+
+        # Same registered type when possible; "worker" as the fallback.
+        agent_type = agent.config.role_type.value
+        if agent_type not in AgentFactory.list_agent_types():
+            agent_type = "worker"
         try:
             replacement = await self.orchestrator.create_agent(
-                agent_type=agent.config.role_type.value
-                if agent.config.role_type.value in ("worker",)
-                else "worker",
+                agent_type=agent_type,
                 config=agent.config.model_copy(),
             )
         except Exception as exc:  # noqa: BLE001 - replacement boundary
@@ -208,13 +228,20 @@ class FaultTolerance:
             self._audit("replace", agent.id, False)
             return None
         transferred = 0
+        had_worker = agent._worker_task is not None
         for task in recoverable:
-            agent.remove_task(task.id)
+            detached = agent.remove_task(task.id)
+            if detached is None:
+                continue
             try:
-                await replacement.add_task(task)
+                await replacement.add_task(detached)
                 transferred += 1
-            except Exception:  # noqa: BLE001
-                task.status = task.status  # leave for orchestrator retry
+            except Exception:  # noqa: BLE001 - saturated queue etc.
+                await self._requeue(detached)
+        if had_worker:
+            # Mirror the old agent's drive mode, or transferred work would
+            # sit queued with nothing draining it.
+            replacement.start_queue_worker()
         await self.orchestrator.remove_agent(agent.id)
         self.unregister_agent(agent.id)
         self.register_agent(replacement)
@@ -228,6 +255,14 @@ class FaultTolerance:
             agent.id[:8], replacement.id[:8], transferred,
         )
         return replacement
+
+    async def _requeue(self, task: Any) -> None:
+        """Route a detached task back through orchestrator routing; a task
+        must never be silently orphaned."""
+        try:
+            await self.orchestrator.requeue_task(task)
+        except Exception as exc:  # noqa: BLE001 - last resort: log loudly
+            self._log.error("task %s lost: requeue failed: %s", task.id[:8], exc)
 
     def _recoverable_tasks(self, agent: BaseAgent) -> List[Any]:
         """Queued ∧ not marked non-recoverable (reference ``:354-378``)."""

@@ -1,0 +1,17 @@
+"""Training: mesh-sharded train step over the shared transformer trunk."""
+
+from pilottai_tpu.train.trainer import (
+    TrainConfig,
+    Trainer,
+    make_optimizer,
+    next_token_loss,
+    synthetic_batches,
+)
+
+__all__ = [
+    "TrainConfig",
+    "Trainer",
+    "make_optimizer",
+    "next_token_loss",
+    "synthetic_batches",
+]

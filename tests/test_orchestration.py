@@ -194,6 +194,60 @@ async def test_critical_agent_replaced_with_task_transfer():
 
 
 @pytest.mark.asyncio
+async def test_recovery_preserves_queued_backlog():
+    """In-place recovery must not cancel the agent's queued tasks (reset()
+    drops the queue; FT detaches and re-adds around it)."""
+    agent = worker()
+    await agent.start()
+    backlog = [Task(description=f"backlog {i}") for i in range(3)]
+    for t in backlog:
+        await agent.add_task(t)
+    serve = make_serve([agent])
+    ft = FaultTolerance(serve, FaultToleranceConfig(
+        heartbeat_timeout=0.05, recovery_cooldown=0.0, max_recovery_attempts=3,
+    ))
+    ft.register_agent(agent)
+    agent._last_heartbeat = time.time() - 10
+    await ft.check_once()
+    assert ft.health[agent.id].recovery_attempts == 1
+    queued = {t.id for t in agent.queued_tasks()}
+    assert queued == {t.id for t in backlog}
+    assert all(not t.status.is_terminal for t in backlog)
+
+
+@pytest.mark.asyncio
+async def test_replacement_overflow_requeues_at_orchestrator():
+    """Transfer overflow (replacement queue smaller than the backlog) must
+    requeue through the orchestrator, never orphan tasks."""
+    sick = worker(max_queue_size=10, max_concurrent_tasks=1)
+    sick.config.max_queue_size = 1  # replacement copies this: holds 1 task
+    sick.task_queue.maxsize = 10
+    await sick.start()
+    tasks = [Task(description=f"work {i}") for i in range(3)]
+    for t in tasks:
+        await sick.add_task(t)
+    serve = make_serve([sick])
+    ft = FaultTolerance(serve, FaultToleranceConfig(
+        heartbeat_timeout=0.01, max_recovery_attempts=0, error_threshold=1,
+    ))
+    ft.register_agent(sick)
+    sick._last_heartbeat = time.time() - 100
+    sick._error_count = 5
+    sick.status = AgentStatus.ERROR
+    await ft.check_once()
+    assert sick.id not in serve.agents
+    replacement = next(a for a in serve.agents.values() if a.id != sick.id)
+    assert len(replacement.queued_tasks()) == 1
+    # The other two went through Serve.requeue_task -> orchestrator queue.
+    orphaned = [
+        t for t in tasks
+        if t.id not in {q.id for q in replacement.queued_tasks()}
+        and t.id not in serve.all_tasks
+    ]
+    assert not orphaned
+
+
+@pytest.mark.asyncio
 async def test_recovery_attempt_cap():
     agent = worker()
     await agent.start()

@@ -1,0 +1,102 @@
+"""Trainer: sharded train step on the virtual 8-device mesh.
+
+Strategy per SURVEY.md §4: CPU-jax + forced multi-device host platform;
+assert the control decision (loss finite & decreasing, shardings stable,
+remat equivalence) rather than model quality.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pilottai_tpu.models.common import init_params
+from pilottai_tpu.models.registry import get_model_config
+from pilottai_tpu.models.transformer import forward_train
+from pilottai_tpu.parallel.mesh import MeshConfig, create_mesh
+from pilottai_tpu.train import Trainer, TrainConfig, next_token_loss, synthetic_batches
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return create_mesh(MeshConfig(data=1, fsdp=2, model=2, seq=2))
+
+
+def test_train_step_runs_and_improves(mesh):
+    cfg = get_model_config("llama-tiny")
+    t = Trainer(
+        cfg,
+        TrainConfig(learning_rate=1e-2, warmup_steps=1, total_steps=50),
+        mesh=mesh,
+    )
+    state = t.init(jax.random.key(0))
+    # One fixed batch, repeated: loss must drop (memorization).
+    batch = next(synthetic_batches(cfg, 4, 32))
+    losses = []
+    for _ in range(8):
+        state, m = t.step(state, batch)
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_train_step_state_shardings_stable(mesh):
+    cfg = get_model_config("llama-tiny")
+    t = Trainer(cfg, TrainConfig(warmup_steps=1, total_steps=10), mesh=mesh)
+    state = t.init(jax.random.key(0))
+    it = synthetic_batches(cfg, 4, 32)
+    state, _ = t.step(state, next(it))
+    sh1 = jax.tree.map(lambda a: a.sharding, state[0])
+    state, _ = t.step(state, next(it))
+    sh2 = jax.tree.map(lambda a: a.sharding, state[0])
+    assert jax.tree.all(jax.tree.map(lambda a, b: a == b, sh1, sh2))
+
+
+def test_params_actually_sharded(mesh):
+    cfg = get_model_config("llama-tiny")
+    t = Trainer(cfg, TrainConfig(), mesh=mesh)
+    params, _ = t.init(jax.random.key(0))
+    wq = params["layers"]["attn"]["wq"]
+    # TP: q-dim axis split over 'model' (2 shards).
+    assert wq.sharding.spec[-1] == "model"
+    assert len(wq.addressable_shards) == 8
+
+
+def test_remat_matches_no_remat():
+    cfg = get_model_config("llama-tiny")
+    params = init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16)), jnp.int32
+    )
+    positions = jnp.broadcast_to(jnp.arange(16)[None, :], (2, 16))
+    valid = jnp.asarray([16, 12], jnp.int32)
+
+    def loss(p, remat):
+        logits = forward_train(p, cfg, tokens, positions, valid, remat=remat)
+        return next_token_loss(logits, tokens, valid)
+
+    g1 = jax.grad(lambda p: loss(p, True))(params)
+    g2 = jax.grad(lambda p: loss(p, False))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5), g1, g2
+    )
+
+
+def test_gemma_family_trains(mesh):
+    cfg = get_model_config("gemma-tiny")
+    t = Trainer(cfg, TrainConfig(warmup_steps=1, total_steps=10), mesh=mesh)
+    state = t.init(jax.random.key(1))
+    state, m = t.step(state, next(synthetic_batches(cfg, 4, 24, seed=3)))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_loss_ignores_padding():
+    cfg = get_model_config("llama-tiny")
+    B, T, V = 2, 8, cfg.vocab_size
+    logits = jnp.zeros((B, T, V), jnp.float32)
+    tokens = jnp.zeros((B, T), jnp.int32)
+    full = next_token_loss(logits, tokens, jnp.asarray([8, 8], jnp.int32))
+    half = next_token_loss(logits, tokens, jnp.asarray([4, 4], jnp.int32))
+    # Uniform logits → identical mean loss regardless of mask size.
+    np.testing.assert_allclose(float(full), float(half), rtol=1e-6)
+    np.testing.assert_allclose(float(full), float(np.log(V)), rtol=1e-5)
