@@ -23,7 +23,12 @@ from pilottai_tpu.models.common import (
     rms_norm,
     rope_tables,
 )
-from pilottai_tpu.ops.attention import dot_product_attention, sliding_window_row_mask
+from pilottai_tpu.ops.attention import (
+    dot_product_attention,
+    flash_enabled,
+    flash_shapes_ok,
+    sliding_window_row_mask,
+)
 from pilottai_tpu.ops.kvcache import KVCache, append_token
 from pilottai_tpu.parallel.sharding import with_logical_constraint
 
@@ -89,16 +94,37 @@ def _full_seq_block(
     ipos: jax.Array,
     jpos: jax.Array,
     base_mask: jax.Array,
+    positions: Optional[jax.Array] = None,  # [B, T]; enables flash dispatch
+    valid: Optional[jax.Array] = None,      # [B]
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One transformer block over a full sequence (shared by prefill and
     the training forward). Returns (x, k, v)."""
-    win_mask = jnp.where(window > 0, (ipos - jpos) < jnp.maximum(window, 1), True)
-    mask = base_mask & win_mask
     h = rms_norm(x, lp["ln1"]["scale"], cfg.rms_eps, cfg.rms_offset)
     q, k, v = _qkv(cfg, lp["attn"], h, sin, cos)
-    attn = dot_product_attention(
-        q, k, v, mask=mask, scale=qscale, logit_softcap=cfg.attn_softcap
-    )
+    T = q.shape[1]
+    # Pallas flash kernel on single-chip TPU (multi-chip TP shards heads;
+    # the kernel isn't shard_map-wrapped yet, so XLA keeps that path).
+    if (
+        positions is not None
+        and valid is not None
+        and flash_enabled()
+        and flash_shapes_ok(T, T, head_dim=cfg.head_dim, itemsize=q.dtype.itemsize)
+        and len(jax.devices()) == 1
+    ):
+        from pilottai_tpu.ops.pallas.flash_attention import flash_attention
+
+        attn = flash_attention(
+            q, k, v, positions, positions, valid, window,
+            scale=qscale, softcap=cfg.attn_softcap,
+        )
+    else:
+        win_mask = jnp.where(
+            window > 0, (ipos - jpos) < jnp.maximum(window, 1), True
+        )
+        mask = base_mask & win_mask
+        attn = dot_product_attention(
+            q, k, v, mask=mask, scale=qscale, logit_softcap=cfg.attn_softcap
+        )
     out = _attn_out(cfg, lp["attn"], attn)
     if cfg.post_norms:
         out = rms_norm(out, lp["ln1_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
@@ -146,7 +172,8 @@ def forward_prefill(
         x = carry
         lp, window = scanned
         x, k, v = _full_seq_block(
-            cfg, qscale, x, lp, window, sin, cos, ipos, jpos, base_mask
+            cfg, qscale, x, lp, window, sin, cos, ipos, jpos, base_mask,
+            positions=positions, valid=valid,
         )
         return x, (k, v)
 

@@ -13,12 +13,43 @@ Design notes (TPU):
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -2.0**30  # large negative, safe in bf16 after cast
+
+
+def flash_enabled() -> bool:
+    """Use the Pallas flash kernel for full-sequence attention on TPU.
+
+    Gated off on CPU (interpret mode is far slower than XLA there) and by
+    ``PILOTTAI_NO_FLASH=1`` for A/B comparison."""
+    if os.environ.get("PILOTTAI_NO_FLASH"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
+_FLASH_KV_VMEM_BUDGET = 8 * 1024 * 1024  # bytes for resident K+V per grid cell
+
+
+def flash_shapes_ok(
+    T: int,
+    S: int,
+    head_dim: int = 128,
+    itemsize: int = 2,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> bool:
+    """Block divisibility plus a VMEM bound: the kernel keeps the full
+    [S, H] K and V resident (double-buffered by the pipeline), so S must
+    fit the budget or Mosaic fails allocation where XLA would have run."""
+    if T % block_q or S % block_k or T < block_q or S < block_k:
+        return False
+    kv_bytes = 2 * S * head_dim * itemsize * 2  # K+V, double-buffered
+    return kv_bytes <= _FLASH_KV_VMEM_BUDGET
 
 
 def dot_product_attention(

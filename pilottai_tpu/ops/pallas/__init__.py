@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for the hot ops (SURVEY.md §7 hard part 1)."""
+
+from pilottai_tpu.ops.pallas.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,174 @@
+"""Flash attention (online softmax) as a Pallas TPU kernel.
+
+Replaces the O(T·S)-memory XLA attention (``ops/attention.py``) for large
+prefills: logits are never materialized; each (batch, head, q-block) grid
+cell streams KV blocks through VMEM keeping running max/sum statistics in
+fp32. Matmuls hit the MXU in bf16; masking (causal from absolute
+positions, per-layer sliding window, valid-length) is computed in-kernel
+so no [B, T, S] mask array ever exists in HBM.
+
+Fully-masked KV blocks (beyond the causal horizon or the valid length)
+are skipped with ``lax.cond`` — for causal prefill that halves the work.
+
+No reference counterpart: the reference computes no attention at all
+(SURVEY.md §2.13); this is the serving engine's hot op.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -2.0**30
+
+
+def _flash_kernel(
+    window_ref,   # SMEM (1,) int32 (scalar prefetch) — sliding window; 0 = global
+    valid_ref,    # SMEM (B,) int32 (scalar prefetch) — valid kv length per batch row
+    qpos_ref,     # VMEM (1, 1, bq)     — absolute positions of the q block
+    kpos_ref,     # VMEM (1, 1, S)      — absolute positions of all keys
+    q_ref,        # VMEM (1, 1, bq, H)  — head-major layout
+    k_ref,        # VMEM (1, 1, S, H)
+    v_ref,        # VMEM (1, 1, S, H)
+    o_ref,        # VMEM (1, 1, bq, H)
+    *,
+    scale: float,
+    softcap: float,
+    block_k: int,
+):
+    bq = q_ref.shape[2]
+    H = q_ref.shape[3]
+    S = k_ref.shape[2]
+    n_kb = S // block_k
+
+    q = q_ref[0, 0, :, :]                                    # [bq, H] bf16
+
+    qpos = qpos_ref[0, 0, :].reshape(bq, 1)                  # [bq, 1]
+    window = window_ref[0]
+    valid = valid_ref[pl.program_id(0)]
+    qpos_max = jnp.max(qpos)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        j0 = kb * block_k
+        kpos = kpos_ref[0, 0, pl.ds(j0, block_k)].reshape(1, block_k)
+        jidx = j0 + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+
+        # Block-level skip: every key in this block is after every query
+        # (causal), past the valid length, or older than the sliding
+        # window for every query -> contributes nothing.
+        block_live = (jnp.min(kpos) <= qpos_max) & (j0 < valid)
+        block_live &= (window <= 0) | ((jnp.min(qpos) - jnp.max(kpos)) < window)
+
+        def attend(carry):
+            m, l, acc = carry
+            k = k_ref[0, 0, pl.ds(j0, block_k), :]           # [bk, H]
+            v = v_ref[0, 0, pl.ds(j0, block_k), :]           # [bk, H]
+            # bf16 × bf16 on the MXU, fp32 accumulate; scale folded in
+            # afterwards so the matmul itself stays at full MXU rate.
+            s = jax.lax.dot_general(
+                q, k,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                         # [bq, bk]
+            if softcap > 0.0:
+                s = jnp.tanh(s / softcap) * softcap
+            mask = (kpos <= qpos) & (jidx < valid)
+            # (window <= 0) | in_window, as pure boolean algebra — Mosaic
+            # cannot legalize select over i1 vectors.
+            mask &= (window <= 0) | ((qpos - kpos) < window)
+            s = jnp.where(mask, s, NEG_INF)
+
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)                            # [bq, bk]
+            corr = jnp.exp(m - m_new)                         # [bq, 1]
+            l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                                 # [bq, H]
+            acc_new = acc * corr + pv
+            return m_new, l_new, acc_new
+
+        return jax.lax.cond(block_live, attend, lambda c: c, (m, l, acc))
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, H), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
+
+    out = acc / jnp.maximum(l, 1e-30)
+    out = jnp.where(l > 0.0, out, 0.0)                        # fully-masked rows
+    o_ref[0, 0, :, :] = out.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "softcap", "block_q", "block_k", "interpret"),
+)
+def flash_attention(
+    q: jax.Array,          # [B, T, N, H]
+    k: jax.Array,          # [B, S, K, H]
+    v: jax.Array,          # [B, S, K, H]
+    q_positions: jax.Array,   # [B, T] absolute positions
+    kv_positions: jax.Array,  # [B, S] absolute positions
+    valid: jax.Array,         # [B] valid kv length (sequence index bound)
+    window: jax.Array,        # scalar int32; 0 = global attention
+    scale: Optional[float] = None,
+    softcap: float = 0.0,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Causal GQA flash attention. Mask semantics match
+    ``models/transformer.py`` prefill: attend iff kv_pos <= q_pos, kv index
+    < valid, and (window == 0 or q_pos - kv_pos < window)."""
+    B, T, N, H = q.shape
+    _, S, K, _ = k.shape
+    assert N % K == 0
+    G = N // K
+    assert T % block_q == 0, f"T={T} not divisible by block_q={block_q}"
+    assert S % block_k == 0, f"S={S} not divisible by block_k={block_k}"
+    scale = scale if scale is not None else H ** -0.5
+
+    window = jnp.asarray(window, jnp.int32).reshape(1)
+    valid = jnp.asarray(valid, jnp.int32).reshape(B)
+    qpos = jnp.asarray(q_positions, jnp.int32)[:, None, :]   # [B, 1, T]
+    kpos = jnp.asarray(kv_positions, jnp.int32)[:, None, :]  # [B, 1, S]
+
+    # Head-major layout so blocks tile as (bq, H)/(S, H) — the TPU lowering
+    # requires the last two block dims be tile-aligned or full.
+    q_t = q.transpose(0, 2, 1, 3)                            # [B, N, T, H]
+    k_t = k.transpose(0, 2, 1, 3)                            # [B, K, S, H]
+    v_t = v.transpose(0, 2, 1, 3)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, softcap=softcap, block_k=block_k
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # window, valid land in SMEM pre-kernel
+        grid=(B, N, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q), lambda b, n, i, *_: (b, 0, i)),
+            pl.BlockSpec((1, 1, S), lambda b, n, i, *_: (b, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, H), lambda b, n, i, *_: (b, n, i, 0)),
+            pl.BlockSpec((1, 1, S, H), lambda b, n, i, *_: (b, n // G, 0, 0)),
+            pl.BlockSpec((1, 1, S, H), lambda b, n, i, *_: (b, n // G, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, H), lambda b, n, i, *_: (b, n, i, 0)
+        ),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q_t.shape, q.dtype),
+        interpret=interpret,
+    )(window, valid, qpos, kpos, q_t, k_t, v_t)
+    return out.transpose(0, 2, 1, 3)                         # back to [B, T, N, H]

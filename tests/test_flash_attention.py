@@ -1,0 +1,108 @@
+"""Pallas flash attention: parity vs the XLA reference implementation.
+
+Runs in interpreter mode on the CPU test platform (the compiled kernel is
+exercised on real TPU by bench.py / the engine). Tolerances are tight:
+interpret mode is bit-faithful to the kernel's fp32 online softmax.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pilottai_tpu.ops.attention import (
+    dot_product_attention,
+    flash_enabled,
+    flash_shapes_ok,
+    make_attention_mask,
+)
+from pilottai_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _setup(B=2, T=256, N=4, K=2, H=64, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(B, T, N, H)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, K, H)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, K, H)), jnp.float32)
+    ps = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T)).astype(jnp.int32)
+    return q, k, v, ps
+
+
+def _reference(q, k, v, ps, valid, window, softcap, scale):
+    """Oracle via the shipped mask helper (contiguous positions only —
+    ``make_attention_mask`` assumes cache slot j holds position j)."""
+    mask = make_attention_mask(ps, q.shape[1], valid, window=window)
+    return dot_product_attention(
+        q, k, v, mask=mask, scale=scale, logit_softcap=softcap
+    )
+
+
+@pytest.mark.parametrize(
+    "window,softcap",
+    [(0, 0.0), (64, 0.0), (0, 50.0), (64, 30.0)],
+)
+def test_flash_matches_reference(window, softcap):
+    q, k, v, ps = _setup()
+    valid = jnp.asarray([256, 180], jnp.int32)
+    scale = q.shape[-1] ** -0.5
+    ref = _reference(q, k, v, ps, valid, window, softcap, scale)
+    got = flash_attention(
+        q, k, v, ps, ps, valid, jnp.int32(window),
+        scale=scale, softcap=softcap, interpret=True,
+    )
+    # Rows past valid hold garbage in both paths; compare live rows only.
+    np.testing.assert_allclose(ref[0], got[0], atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(ref[1, :180], got[1, :180], atol=2e-5, rtol=2e-5)
+
+
+def test_flash_gqa_and_mha():
+    for N, K in [(4, 4), (8, 2), (4, 1)]:
+        q, k, v, ps = _setup(N=N, K=K, T=128)
+        valid = jnp.full((2,), 128, jnp.int32)
+        scale = q.shape[-1] ** -0.5
+        ref = _reference(q, k, v, ps, valid, 0, 0.0, scale)
+        got = flash_attention(
+            q, k, v, ps, ps, valid, jnp.int32(0), scale=scale, interpret=True
+        )
+        np.testing.assert_allclose(ref, got, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_offset_positions():
+    """Prefill at a nonzero offset (continuation): positions start at 100.
+    Hand-built mask here — make_attention_mask assumes slot j == position j,
+    which doesn't hold at an offset."""
+    q, k, v, ps = _setup(T=128)
+    ps = ps + 100
+    valid = jnp.full((2,), 128, jnp.int32)
+    scale = q.shape[-1] ** -0.5
+    ipos, jpos = ps[:, :, None], ps[:, None, :]
+    mask = (jpos <= ipos) & (
+        jnp.arange(128)[None, None, :] < valid[:, None, None]
+    )
+    ref = dot_product_attention(q, k, v, mask=mask, scale=scale)
+    got = flash_attention(
+        q, k, v, ps, ps, valid, jnp.int32(0), scale=scale, interpret=True
+    )
+    np.testing.assert_allclose(ref, got, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_fully_masked_rows_are_zero():
+    """valid=0 for one batch row -> output rows all zeros, no NaN."""
+    q, k, v, ps = _setup(T=128)
+    valid = jnp.asarray([128, 0], jnp.int32)
+    got = flash_attention(
+        q, k, v, ps, ps, valid, jnp.int32(0),
+        scale=q.shape[-1] ** -0.5, interpret=True,
+    )
+    assert not bool(jnp.isnan(got).any())
+    np.testing.assert_array_equal(np.asarray(got[1]), 0.0)
+
+
+def test_dispatch_gates(monkeypatch):
+    monkeypatch.setenv("PILOTTAI_NO_FLASH", "1")
+    assert not flash_enabled()  # env kill-switch wins on any platform
+    assert flash_shapes_ok(256, 256)
+    assert not flash_shapes_ok(192, 256)
+    assert not flash_shapes_ok(64, 64)          # below one block
+    assert flash_shapes_ok(8192, 8192, head_dim=128, itemsize=2)
+    assert not flash_shapes_ok(16384, 16384, head_dim=128, itemsize=2)  # VMEM
