@@ -43,6 +43,11 @@ class ModelConfig:
     sliding_pattern: int = 0       # every Nth layer is global (gemma2: 2)
     query_scale: Optional[float] = None  # default head_dim**-0.5
 
+    # Mixture-of-experts (0 = dense MLP). Experts shard over the 'expert'
+    # logical axis (mesh 'model' by default) — expert parallelism.
+    n_experts: int = 0
+    n_active_experts: int = 2      # top-k routing
+
     dtype: Any = jnp.bfloat16
 
     @property
@@ -66,9 +71,12 @@ class ModelConfig:
 
     def param_count(self) -> int:
         E, F, V, L = self.hidden_size, self.intermediate_size, self.vocab_size, self.n_layers
+        mlp = 2 * E * F + F * E
+        if self.n_experts > 0:
+            mlp = self.n_experts * mlp + E * self.n_experts  # experts + router
         per_layer = (
             E * self.q_dim + 2 * E * self.kv_dim + self.q_dim * E  # attn
-            + 2 * E * F + F * E                                     # mlp
+            + mlp
             + 2 * E + (2 * E if self.post_norms else 0)             # norms
         )
         head = 0 if self.tie_embeddings else E * V
@@ -100,12 +108,21 @@ def init_params(
             "wv": normal(keys[2], (L, E, cfg.kv_dim), E),
             "wo": normal(keys[3], (L, cfg.q_dim, E), cfg.q_dim),
         },
-        "mlp": {
+    }
+    if cfg.n_experts > 0:
+        X = cfg.n_experts
+        layers["moe"] = {
+            "router": normal(jax.random.fold_in(keys[4], 7), (L, E, X), E),
+            "wg": normal(keys[4], (L, X, E, F), E),
+            "wu": normal(keys[5], (L, X, E, F), E),
+            "wd": normal(keys[6], (L, X, F, E), F),
+        }
+    else:
+        layers["mlp"] = {
             "wg": normal(keys[4], (L, E, F), E),
             "wu": normal(keys[5], (L, E, F), E),
             "wd": normal(keys[6], (L, F, E), F),
-        },
-    }
+        }
     if cfg.post_norms:
         zero_or_one = jnp.zeros if cfg.rms_offset else jnp.ones
         layers["ln1_post"] = {"scale": zero_or_one((L, E), dtype)}
@@ -139,12 +156,20 @@ def param_logical_axes(cfg: ModelConfig) -> Dict[str, Any]:
             "wv": ("layers", "embed", "kv_heads"),
             "wo": ("layers", "heads", "embed"),
         },
-        "mlp": {
+    }
+    if cfg.n_experts > 0:
+        layers["moe"] = {
+            "router": ("layers", "embed", None),
+            "wg": ("layers", "expert", "embed", "mlp_expert"),
+            "wu": ("layers", "expert", "embed", "mlp_expert"),
+            "wd": ("layers", "expert", "mlp_expert", "embed"),
+        }
+    else:
+        layers["mlp"] = {
             "wg": ("layers", "embed", "mlp"),
             "wu": ("layers", "embed", "mlp"),
             "wd": ("layers", "mlp", "embed"),
-        },
-    }
+        }
     if cfg.post_norms:
         layers["ln1_post"] = {"scale": ("layers", None)}
         layers["ln2_post"] = {"scale": ("layers", None)}

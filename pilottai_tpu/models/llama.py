@@ -59,3 +59,29 @@ LLAMA_TINY = ModelConfig(
     intermediate_size=256,
     max_seq_len=512,
 )
+
+# Mixtral-style sparse MoE on the Llama trunk (public Mixtral-8x7B shape:
+# 8 experts, top-2 routing, RoPE theta 1e6).
+MIXTRAL_8X7B = ModelConfig(
+    name="mixtral-8x7b",
+    family="llama",
+    vocab_size=32_000,
+    hidden_size=4096,
+    n_layers=32,
+    n_heads=32,
+    n_kv_heads=8,
+    head_dim=128,
+    intermediate_size=14336,
+    max_seq_len=32_768,
+    rope_theta=1_000_000.0,
+    rms_eps=1e-5,
+    tie_embeddings=False,
+    n_experts=8,
+    n_active_experts=2,
+)
+MIXTRAL_8X7B_BYTE = MIXTRAL_8X7B.replace(
+    name="mixtral-8x7b-byte", vocab_size=512, tie_embeddings=True
+)
+
+# Tiny MoE for tests / the multichip dry run (exercises expert parallelism).
+MOE_TINY = LLAMA_TINY.replace(name="moe-tiny", n_experts=4, n_active_experts=2)

@@ -20,6 +20,9 @@ for _cfg in (
     llama.LLAMA3_8B_BYTE,
     llama.LLAMA3_1B_BYTE,
     llama.LLAMA_TINY,
+    llama.MIXTRAL_8X7B,
+    llama.MIXTRAL_8X7B_BYTE,
+    llama.MOE_TINY,
     gemma.GEMMA_2B,
     gemma.GEMMA2_2B,
     gemma.GEMMA_2B_BYTE,

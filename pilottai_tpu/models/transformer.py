@@ -39,10 +39,22 @@ def _activation(cfg: ModelConfig, x: jax.Array) -> jax.Array:
     return jax.nn.silu(x)
 
 
-def _mlp(cfg: ModelConfig, p: Dict[str, Any], x: jax.Array) -> jax.Array:
+def _mlp(
+    cfg: ModelConfig, lp: Dict[str, Any], x: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Feed-forward: dense SwiGLU (``lp["mlp"]``), or top-k MoE when the
+    layer carries a ``moe`` sub-tree (cfg.n_experts > 0).
+
+    Returns (out, aux_loss) — aux_loss is 0.0 for dense layers and the
+    load-balancing term for MoE (collected by forward_train's scan)."""
+    if "moe" in lp:
+        from pilottai_tpu.models.moe import moe_mlp
+
+        return moe_mlp(cfg, lp["moe"], x, lambda h: _activation(cfg, h))
+    p = lp["mlp"]
     gate = _activation(cfg, x @ p["wg"])
     up = x @ p["wu"]
-    return (gate * up) @ p["wd"]
+    return (gate * up) @ p["wd"], jnp.zeros((), jnp.float32)
 
 
 def _qkv(
@@ -96,15 +108,25 @@ def _full_seq_block(
     base_mask: jax.Array,
     positions: Optional[jax.Array] = None,  # [B, T]; enables flash dispatch
     valid: Optional[jax.Array] = None,      # [B]
+    ring_mesh: Any = None,                  # Mesh → ring attention over 'seq'
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One transformer block over a full sequence (shared by prefill and
     the training forward). Returns (x, k, v)."""
     h = rms_norm(x, lp["ln1"]["scale"], cfg.rms_eps, cfg.rms_offset)
     q, k, v = _qkv(cfg, lp["attn"], h, sin, cos)
     T = q.shape[1]
+    if ring_mesh is not None and positions is not None and valid is not None:
+        # Context parallelism: K/V rotate around the 'seq' ring (ICI);
+        # differentiable, so the training path uses it directly.
+        from pilottai_tpu.parallel.ring_attention import ring_attention
+
+        attn = ring_attention(
+            q, k, v, positions, valid, window,
+            scale=qscale, softcap=cfg.attn_softcap, mesh=ring_mesh,
+        )
     # Pallas flash kernel on single-chip TPU (multi-chip TP shards heads;
     # the kernel isn't shard_map-wrapped yet, so XLA keeps that path).
-    if (
+    elif (
         positions is not None
         and valid is not None
         and flash_enabled()
@@ -130,12 +152,12 @@ def _full_seq_block(
         out = rms_norm(out, lp["ln1_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
     x = x + out
     h = rms_norm(x, lp["ln2"]["scale"], cfg.rms_eps, cfg.rms_offset)
-    out = _mlp(cfg, lp["mlp"], h)
+    out, aux = _mlp(cfg, lp, h)
     if cfg.post_norms:
         out = rms_norm(out, lp["ln2_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
     x = x + out
     x = with_logical_constraint(x, ("batch", "seq", None))
-    return x, k, v
+    return x, k, v, aux
 
 
 # --------------------------------------------------------------------- #
@@ -171,7 +193,7 @@ def forward_prefill(
     def layer_fn(carry, scanned):
         x = carry
         lp, window = scanned
-        x, k, v = _full_seq_block(
+        x, k, v, _ = _full_seq_block(
             cfg, qscale, x, lp, window, sin, cos, ipos, jpos, base_mask,
             positions=positions, valid=valid,
         )
@@ -231,7 +253,7 @@ def forward_decode(
             out = rms_norm(out, lp["ln1_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
         x = x + out
         h = rms_norm(x, lp["ln2"]["scale"], cfg.rms_eps, cfg.rms_offset)
-        out = _mlp(cfg, lp["mlp"], h)
+        out, _ = _mlp(cfg, lp, h)
         if cfg.post_norms:
             out = rms_norm(out, lp["ln2_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
         x = x + out
@@ -252,7 +274,7 @@ def forward_decode(
 # Training forward
 # --------------------------------------------------------------------- #
 
-@partial(jax.jit, static_argnames=("cfg", "remat"))
+@partial(jax.jit, static_argnames=("cfg", "remat", "ring_mesh"))
 def forward_train(
     params: Dict[str, Any],
     cfg: ModelConfig,
@@ -260,8 +282,11 @@ def forward_train(
     positions: jax.Array,   # [B, T]
     valid: jax.Array,       # [B] true lengths
     remat: bool = True,
-) -> jax.Array:
-    """Full-sequence forward for training: logits only, no KV outputs.
+    ring_mesh: Any = None,  # static Mesh → ring attention over the seq axis
+) -> Tuple[jax.Array, jax.Array]:
+    """Full-sequence forward for training: (logits, moe_aux_loss), no KV
+    outputs. moe_aux_loss is the mean load-balancing term over layers
+    (0.0 for dense models).
 
     With ``remat=True`` each layer body is wrapped in ``jax.checkpoint``
     so the backward pass recomputes activations instead of storing T×L of
@@ -283,10 +308,13 @@ def forward_train(
     )
 
     def block(x, lp, window):
-        x, _, _ = _full_seq_block(
-            cfg, qscale, x, lp, window, sin, cos, ipos, jpos, base_mask
+        x, _, _, aux = _full_seq_block(
+            cfg, qscale, x, lp, window, sin, cos, ipos, jpos, base_mask,
+            positions=positions if ring_mesh is not None else None,
+            valid=valid if ring_mesh is not None else None,
+            ring_mesh=ring_mesh,
         )
-        return x
+        return x, aux
 
     if remat:
         block = jax.checkpoint(
@@ -295,8 +323,10 @@ def forward_train(
 
     def layer_fn(carry, scanned):
         lp, window = scanned
-        return block(carry, lp, window), None
+        x, aux = block(carry, lp, window)
+        return x, aux
 
-    x, _ = jax.lax.scan(layer_fn, x, (params["layers"], windows))
+    x, aux_per_layer = jax.lax.scan(layer_fn, x, (params["layers"], windows))
     x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps, cfg.rms_offset)
-    return _unembed(cfg, params, x)
+    # Mean MoE load-balance loss over layers (0.0 for dense models).
+    return _unembed(cfg, params, x), jnp.mean(aux_per_layer)

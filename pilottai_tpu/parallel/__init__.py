@@ -7,6 +7,7 @@ engine the way the reference's remote-API path never could.
 """
 
 from pilottai_tpu.parallel.mesh import MeshConfig, best_mesh_config, create_mesh
+from pilottai_tpu.parallel.ring_attention import ring_attention
 from pilottai_tpu.parallel.sharding import (
     logical_to_spec,
     shard_params,
@@ -18,6 +19,7 @@ __all__ = [
     "create_mesh",
     "best_mesh_config",
     "logical_to_spec",
+    "ring_attention",
     "shard_params",
     "with_logical_constraint",
 ]

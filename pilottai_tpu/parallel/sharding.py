@@ -25,6 +25,7 @@ DEFAULT_RULES: Dict[str, Any] = {
     "layers": None,         # stacked-layer leading axis: never sharded
     "expert": "model",      # MoE experts (expert parallel rides the model axis
                             # by default; override with a dedicated axis)
+    "mlp_expert": None,     # per-expert ffn hidden: already sharded by expert
 }
 
 

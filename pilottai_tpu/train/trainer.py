@@ -45,6 +45,12 @@ class TrainConfig:
     grad_clip: float = 1.0
     remat: bool = True
     param_dtype: Any = jnp.float32  # master weights fp32; compute casts to bf16
+    # Ring attention over the mesh's 'seq' axis (context parallelism) —
+    # K/V chunks rotate over ICI instead of XLA all-gathering them.
+    context_parallel: bool = False
+    # Weight on the MoE load-balancing auxiliary loss (Switch-style);
+    # ignored for dense models.
+    moe_aux_weight: float = 0.01
 
 
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
@@ -136,6 +142,12 @@ class Trainer:
         optimizer = self.optimizer
         compute_dtype = cfg.dtype
 
+        ring_mesh = (
+            self.mesh
+            if tc.context_parallel and self.mesh.shape.get("seq", 1) > 1
+            else None
+        )
+
         def train_step(params, opt_state, tokens, valid):
             B, T = tokens.shape
             positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
@@ -147,16 +159,22 @@ class Trainer:
                     else a,
                     p,
                 )
-                logits = forward_train(
-                    compute_p, cfg, tokens, positions, valid, remat=tc.remat
+                logits, moe_aux = forward_train(
+                    compute_p, cfg, tokens, positions, valid,
+                    remat=tc.remat, ring_mesh=ring_mesh,
                 )
-                return next_token_loss(logits, tokens, valid)
+                lm_loss = next_token_loss(logits, tokens, valid)
+                return lm_loss + tc.moe_aux_weight * moe_aux, (lm_loss, moe_aux)
 
-            loss, grads = jax.value_and_grad(loss_fn)(params)
+            (loss, (lm_loss, moe_aux)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             metrics = {
-                "loss": loss,
+                "loss": lm_loss,
+                "total_loss": loss,
+                "moe_aux": moe_aux,
                 "grad_norm": optax.global_norm(grads),
                 "tokens": jnp.sum(valid).astype(jnp.float32),
             }
