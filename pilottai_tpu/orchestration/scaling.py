@@ -40,7 +40,9 @@ class DynamicScaling:
         self.config = config or ScalingConfig()
         self.agent_type = agent_type
         self._samples: deque = deque(maxlen=self.config.trend_window)
-        self._last_action = 0.0
+        # None = never acted; 0.0 would wrongly apply the cooldown to the
+        # first action when time.monotonic() (system uptime) < cooldown.
+        self._last_action: Optional[float] = None
         self._task: Optional[asyncio.Task] = None
         self._log = get_logger("orchestration.scaling")
         self.scale_ups = 0
@@ -102,6 +104,8 @@ class DynamicScaling:
         return sum(deltas) / sum(weights)
 
     def _cooled_down(self) -> bool:
+        if self._last_action is None:
+            return True
         return time.monotonic() - self._last_action >= self.config.cooldown
 
     async def scale_once(self) -> Optional[str]:

@@ -85,7 +85,9 @@ class Tool:
         self.enabled = True
         self.metrics = ToolMetrics()
         self._semaphore = asyncio.Semaphore(max_concurrent)
-        self._last_finished = 0.0
+        # None = never ran; 0.0 would wrongly apply the cooldown before the
+        # first call when time.monotonic() (uptime) < cooldown.
+        self._last_finished: Optional[float] = None
         self._seen_executions: Set[str] = set()
         self._log = get_logger("tools", tool=name)
         # Per-tool lock used by agents for sorted-order acquisition
@@ -97,7 +99,8 @@ class Tool:
     def _check_ready(self, permissions: Set[str]) -> None:
         if not self.enabled:
             raise ToolError(f"tool {self.name!r} is disabled", self.name)
-        if self.cooldown > 0 and time.monotonic() - self._last_finished < self.cooldown:
+        if (self.cooldown > 0 and self._last_finished is not None
+                and time.monotonic() - self._last_finished < self.cooldown):
             raise ToolError(f"tool {self.name!r} is cooling down", self.name)
         missing = self.required_permissions - permissions
         if missing:

@@ -72,7 +72,9 @@ def test_remat_matches_no_remat():
     valid = jnp.asarray([16, 12], jnp.int32)
 
     def loss(p, remat):
-        logits = forward_train(p, cfg, tokens, positions, valid, remat=remat)
+        logits, _moe_aux = forward_train(
+            p, cfg, tokens, positions, valid, remat=remat
+        )
         return next_token_loss(logits, tokens, valid)
 
     g1 = jax.grad(lambda p: loss(p, True))(params)
