@@ -51,6 +51,8 @@ _LAZY = {
     "Embedder": ("pilottai_tpu.memory.embedder", "Embedder"),
     "KnowledgeManager": ("pilottai_tpu.knowledge.manager", "KnowledgeManager"),
     "TaskDelegator": ("pilottai_tpu.delegation.delegator", "TaskDelegator"),
+    "TaskJournal": ("pilottai_tpu.checkpoint.journal", "TaskJournal"),
+    "TrainCheckpointer": ("pilottai_tpu.checkpoint.train_io", "TrainCheckpointer"),
 }
 
 

@@ -1,0 +1,24 @@
+"""Checkpoint/resume: the durability layer the reference lacks.
+
+SURVEY.md §5.4 — the reference loses tasks, queues and memory on any crash;
+its only persistence is AgentConfig JSON round-trips. Here:
+
+  * ``TaskJournal`` — append-only JSONL of task transitions; replayed on
+    restart to rebuild the orchestrator queue (wired into ``Serve`` via
+    ``ServeConfig.journal_path``).
+  * ``save_memory`` / ``restore_memory`` — EnhancedMemory snapshots
+    (JSON + embedding-buffer ``.npz``, no re-embedding on restore).
+  * ``TrainCheckpointer`` — orbax params+opt_state+step checkpoints with
+    retention; model-weight-only IO lives in ``models/loader.py``.
+"""
+
+from pilottai_tpu.checkpoint.journal import TaskJournal
+from pilottai_tpu.checkpoint.memory_io import restore_memory, save_memory
+from pilottai_tpu.checkpoint.train_io import TrainCheckpointer
+
+__all__ = [
+    "TaskJournal",
+    "TrainCheckpointer",
+    "restore_memory",
+    "save_memory",
+]

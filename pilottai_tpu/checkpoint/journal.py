@@ -1,0 +1,194 @@
+"""Durable task-queue journal: crash/preemption-safe task state.
+
+The reference has NO runtime persistence — tasks, queues and memory are
+process-local and lost on crash (SURVEY.md §5.4; its FaultTolerance only
+migrates live Task objects in RAM, ``pilott/orchestration/scaling.py:354-378``).
+On TPU-VMs, preemption is a first-class event, so the orchestrator journals
+every task transition to an append-only JSONL file that a restarted process
+replays to rebuild its queue.
+
+Format — one JSON object per line:
+  ``{"ev": "task",   "ts": ..., "data": {<full Task dump>}}``   (enqueue/update)
+  ``{"ev": "status", "ts": ..., "id": ..., "status": ..., "result": {...}|null}``
+
+Replay folds the log in order: the latest full dump wins for task fields,
+later status records overwrite the status/result. Tasks whose final state is
+non-terminal are the recovery set.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, TextIO
+
+from pilottai_tpu.core.task import Task, TaskResult, TaskStatus
+from pilottai_tpu.utils.logging import get_logger
+
+
+class TaskJournal:
+    """Append-only JSONL journal of task lifecycle events."""
+
+    def __init__(self, path: str | Path, fsync: bool = False) -> None:
+        self.path = Path(path)
+        self.fsync = fsync
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._fh: Optional[TextIO] = open(self.path, "a", encoding="utf-8")
+        self._log = get_logger("checkpoint.journal")
+        self._writes = 0
+
+    # ------------------------------------------------------------------ #
+    # Writing
+    # ------------------------------------------------------------------ #
+
+    def _write(self, record: Dict[str, Any]) -> None:
+        if self._fh is None:
+            raise RuntimeError("journal is closed")
+        try:
+            line = json.dumps(record)
+        except TypeError:
+            # Non-JSON values (arrays, handles) stringify — the replayed
+            # task would rerun with corrupted inputs, so say so loudly.
+            self._log.warning(
+                "journal record for task %s has non-JSON-serializable values; "
+                "they are stored as strings and will NOT survive recovery "
+                "intact — keep Task.payload/context JSON-safe",
+                record.get("id") or record.get("data", {}).get("id"),
+            )
+            line = json.dumps(record, default=str)
+        self._fh.write(line + "\n")
+        self._fh.flush()
+        if self.fsync:
+            os.fsync(self._fh.fileno())
+        self._writes += 1
+
+    def reopen(self) -> None:
+        """Re-attach to the journal file after ``close()`` (e.g. a Serve
+        stop/start cycle within one process)."""
+        if self._fh is None:
+            self._fh = open(self.path, "a", encoding="utf-8")
+
+    def record_task(self, task: Task) -> None:
+        """Full task dump — written on enqueue and requeue so replay can
+        reconstruct the Task object exactly."""
+        self._write(
+            {"ev": "task", "ts": time.time(), "data": task.model_dump(mode="json")}
+        )
+
+    def record_status(self, task: Task) -> None:
+        """Slim status transition — written on start/terminal events."""
+        self._write(
+            {
+                "ev": "status",
+                "ts": time.time(),
+                "id": task.id,
+                "status": task.status.value,
+                "result": (
+                    task.result.model_dump(mode="json")
+                    if task.result is not None
+                    else None
+                ),
+            }
+        )
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    # ------------------------------------------------------------------ #
+    # Replay
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def replay(path: str | Path) -> Dict[str, Task]:
+        """Fold the journal into {task_id: Task} with final statuses applied.
+
+        Tolerates a torn final line (crash mid-write): bad lines are skipped
+        with a warning rather than failing recovery.
+        """
+        log = get_logger("checkpoint.journal")
+        path = Path(path)
+        tasks: Dict[str, Task] = {}
+        if not path.exists():
+            return tasks
+        with open(path, encoding="utf-8") as fh:
+            for lineno, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    record = json.loads(line)
+                    if record["ev"] == "task":
+                        task = Task(**record["data"])
+                        tasks[task.id] = task
+                    elif record["ev"] == "status":
+                        task = tasks.get(record["id"])
+                        if task is None:
+                            continue
+                        task.status = TaskStatus(record["status"])
+                        if record.get("result") is not None:
+                            task.result = TaskResult(**record["result"])
+                except Exception as exc:  # noqa: BLE001 - torn/corrupt line
+                    log.warning(
+                        "journal %s line %d unreadable (%s); skipping",
+                        path, lineno, exc,
+                    )
+        return tasks
+
+    @staticmethod
+    def pending(tasks: Dict[str, Task]) -> List[Task]:
+        """Tasks needing re-execution after a crash: anything non-terminal.
+        In-flight work (IN_PROGRESS/RETRYING) is included — its result was
+        never journaled, so it must rerun."""
+        return [t for t in tasks.values() if not t.status.is_terminal]
+
+    # ------------------------------------------------------------------ #
+    # Compaction
+    # ------------------------------------------------------------------ #
+
+    def compact(self, retain_terminal: bool = False) -> int:
+        """Rewrite the journal with one record per live task.
+
+        Returns the number of tasks retained. Terminal tasks are dropped by
+        default (their results live in the orchestrator's retention window,
+        not the journal) — EXCEPT terminal children of a still-live parent,
+        whose outputs the parent aggregation will need after the next
+        recovery. Atomic via rename.
+        """
+        tasks = self.replay(self.path)
+        live = {t.id for t in tasks.values() if not t.status.is_terminal}
+        keep = [
+            t for t in tasks.values()
+            if retain_terminal
+            or not t.status.is_terminal
+            or (t.parent_task_id is not None and t.parent_task_id in live)
+        ]
+        tmp = self.path.with_suffix(self.path.suffix + ".compact")
+        with open(tmp, "w", encoding="utf-8") as fh:
+            for task in keep:
+                fh.write(
+                    json.dumps(
+                        {
+                            "ev": "task",
+                            "ts": time.time(),
+                            "data": task.model_dump(mode="json"),
+                        },
+                        default=str,
+                    )
+                    + "\n"
+                )
+            fh.flush()
+            os.fsync(fh.fileno())
+        if self._fh is not None:
+            self._fh.close()
+        os.replace(tmp, self.path)
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self._log.info("journal compacted: %d live tasks retained", len(keep))
+        return len(keep)
+
+    @property
+    def writes(self) -> int:
+        return self._writes

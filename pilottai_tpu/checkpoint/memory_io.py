@@ -1,0 +1,57 @@
+"""Semantic-memory snapshot files.
+
+``EnhancedMemory.export_state()`` is split into a JSON document (items,
+histories, interactions, patterns) and an ``.npz`` of the embedding ring
+buffer, so restore never re-embeds 10k items through the encoder
+(SURVEY.md §5.4: the reference has no memory persistence at all).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict
+
+import numpy as np
+
+MEMORY_JSON = "memory.json"
+VECTORS_NPZ = "vectors.npz"
+
+
+async def save_memory(memory: Any, directory: str | Path) -> None:
+    """Snapshot an ``EnhancedMemory`` into ``directory`` (atomic per file)."""
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    state = await memory.export_state()
+    arrays = state.pop("vector_arrays", None)
+
+    tmp = directory / (MEMORY_JSON + ".tmp")
+    tmp.write_text(json.dumps(state, default=str), encoding="utf-8")
+    tmp.replace(directory / MEMORY_JSON)
+
+    if arrays is not None:
+        tmp_npz = directory / (VECTORS_NPZ + ".tmp")
+        with open(tmp_npz, "wb") as fh:
+            np.savez_compressed(fh, **arrays)
+        tmp_npz.replace(directory / VECTORS_NPZ)
+    else:
+        # Drop any stale vector file from an earlier snapshot — restore
+        # would otherwise pair old embeddings with the new items.
+        (directory / VECTORS_NPZ).unlink(missing_ok=True)
+
+
+async def restore_memory(memory: Any, directory: str | Path) -> bool:
+    """Restore a snapshot into ``memory``; returns False if none exists."""
+    directory = Path(directory)
+    doc = directory / MEMORY_JSON
+    if not doc.exists():
+        return False
+    state: Dict[str, Any] = json.loads(doc.read_text(encoding="utf-8"))
+    npz = directory / VECTORS_NPZ
+    if npz.exists():
+        with np.load(npz) as data:
+            state["vector_arrays"] = {k: data[k] for k in data.files}
+    else:
+        state["vector_arrays"] = None
+    await memory.import_state(state)
+    return True

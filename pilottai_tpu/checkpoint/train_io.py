@@ -1,0 +1,105 @@
+"""Train-state checkpointing: params + optimizer state + step, via orbax.
+
+Directory layout (one orbax PyTree checkpoint per step)::
+
+    <root>/step_00000100/   # orbax tree: {"params": ..., "opt_state": ...}
+    <root>/step_00000200/
+    <root>/LATEST           # text file: "200"
+
+Restore requires a ``template`` state (from ``Trainer.init``) so optax
+NamedTuple optimizer states come back with their original structure —
+orbax restores raw containers otherwise. Sharded arrays restore onto the
+template's shardings, so a checkpoint written on one mesh can resume on
+another (orbax reshards on load).
+
+Reference has no checkpointing of any kind (SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import shutil
+from pathlib import Path
+from typing import Any, List, Optional, Tuple
+
+from pilottai_tpu.utils.logging import get_logger
+
+_LATEST = "LATEST"
+
+
+def _step_dir(root: Path, step: int) -> Path:
+    return root / f"step_{step:08d}"
+
+
+class TrainCheckpointer:
+    """Save/restore (params, opt_state) with retention of the last N steps."""
+
+    def __init__(self, root: str | Path, max_to_keep: int = 3) -> None:
+        self.root = Path(root).absolute()
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.max_to_keep = max_to_keep
+        self._log = get_logger("checkpoint.train")
+
+    # ------------------------------------------------------------------ #
+
+    def save(self, step: int, state: Tuple[Any, Any]) -> Path:
+        import orbax.checkpoint as ocp
+
+        params, opt_state = state
+        target = _step_dir(self.root, step)
+        ocp.PyTreeCheckpointer().save(
+            target, {"params": params, "opt_state": opt_state}, force=True
+        )
+        # LATEST write is atomic-ish (tiny file, rename) and last: a crash
+        # mid-save leaves LATEST pointing at the previous good step.
+        tmp = self.root / (_LATEST + ".tmp")
+        tmp.write_text(str(step), encoding="utf-8")
+        tmp.replace(self.root / _LATEST)
+        self._gc(keep=step)
+        self._log.info("saved train checkpoint step=%d at %s", step, target)
+        return target
+
+    def restore(
+        self, template: Tuple[Any, Any], step: Optional[int] = None
+    ) -> Tuple[Tuple[Any, Any], int]:
+        """Returns ((params, opt_state), step). Raises if no checkpoint."""
+        import orbax.checkpoint as ocp
+
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoint under {self.root}")
+        params_t, opt_t = template
+        restored = ocp.PyTreeCheckpointer().restore(
+            _step_dir(self.root, step),
+            item={"params": params_t, "opt_state": opt_t},
+        )
+        return (restored["params"], restored["opt_state"]), step
+
+    # ------------------------------------------------------------------ #
+
+    def latest_step(self) -> Optional[int]:
+        marker = self.root / _LATEST
+        if marker.exists():
+            try:
+                return int(marker.read_text().strip())
+            except ValueError:
+                pass
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def all_steps(self) -> List[int]:
+        return sorted(
+            int(p.name.split("_")[1])
+            for p in self.root.glob("step_*")
+            if p.is_dir()
+        )
+
+    def _gc(self, keep: int) -> None:
+        """Prune to the ``max_to_keep`` highest steps, always retaining
+        ``keep`` — a rollback save(150) into [200,300,400] must never delete
+        the step it just wrote (LATEST points at it)."""
+        steps = self.all_steps()
+        survivors = set(steps[-self.max_to_keep:]) | {keep}
+        for old in steps:
+            if old not in survivors:
+                shutil.rmtree(_step_dir(self.root, old), ignore_errors=True)

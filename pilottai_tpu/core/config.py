@@ -232,6 +232,11 @@ class ServeConfig(BaseModel):
     load_balancing_enabled: bool = False
     dynamic_scaling_enabled: bool = False
     fault_tolerance_enabled: bool = False
+    # Durable task journal (checkpoint/journal.py; SURVEY.md §5.4 — the
+    # reference loses all queue state on crash/preemption).
+    journal_path: Optional[str] = None
+    journal_fsync: bool = False
+    journal_recover: bool = True  # replay the journal on start()
 
 
 class RouterConfig(BaseModel):

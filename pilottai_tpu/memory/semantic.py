@@ -121,6 +121,24 @@ class _VectorStore:
                 out.append((entry_id, float(score)))
         return out
 
+    def export_arrays(self) -> Dict[str, np.ndarray]:
+        """Host copies of the buffer for snapshotting (checkpoint/memory_io)."""
+        return {
+            "vectors": np.asarray(self._vectors),
+            "row_ids": self._row_ids.copy(),
+            "next_row": np.asarray([self._next_row]),
+        }
+
+    def import_arrays(self, arrays: Dict[str, np.ndarray]) -> None:
+        import jax.numpy as jnp
+
+        self._vectors = jnp.asarray(arrays["vectors"], jnp.float32)
+        self._row_ids = np.asarray(arrays["row_ids"], np.int64).copy()
+        self._next_row = int(arrays["next_row"][0])
+        self._id_to_row = {
+            int(eid): row for row, eid in enumerate(self._row_ids) if eid >= 0
+        }
+
 
 class EnhancedMemory:
     """Semantic + episodic memory for agents."""
@@ -379,6 +397,78 @@ class EnhancedMemory:
             self._tag_index.clear()
             if self._vectors is not None and self.embedder is not None:
                 self._vectors = _VectorStore(self.capacity, self.embedder.dim)
+
+    # ------------------------------------------------------------------ #
+    # Snapshot / restore (checkpoint/memory_io.py does the file IO; the
+    # reference loses all memory on crash, SURVEY.md §5.4)
+    # ------------------------------------------------------------------ #
+
+    async def export_state(self) -> Dict[str, Any]:
+        """Host-side snapshot of every store (plus vector arrays if any)."""
+        async with self._semantic_lock, self._task_lock, \
+                self._interaction_lock, self._pattern_lock:
+            state: Dict[str, Any] = {
+                "items": [
+                    {
+                        "text": i.text, "data": i.data, "tags": sorted(i.tags),
+                        "priority": i.priority, "ttl": i.ttl,
+                        "entry_id": i.entry_id, "created_at": i.created_at,
+                    }
+                    for i in self._items.values()
+                ],
+                "order": list(self._order),
+                "next_id": self._next_id,
+                "task_history": {k: list(v) for k, v in self._task_history.items()},
+                "interactions": list(self._interactions),
+                "patterns": [
+                    {
+                        "key": k, "data": v.data, "ttl": v.ttl,
+                        "created_at": v.created_at,
+                    }
+                    for k, v in self._patterns.items()
+                ],
+                "vector_arrays": (
+                    self._vectors.export_arrays() if self._vectors is not None else None
+                ),
+            }
+            return state
+
+    async def import_state(self, state: Dict[str, Any]) -> None:
+        """Restore a snapshot. Vectors are restored verbatim (no re-embed)."""
+        async with self._semantic_lock, self._task_lock, \
+                self._interaction_lock, self._pattern_lock:
+            self._items = {}
+            self._tag_index = {}
+            for row in state["items"]:
+                item = MemoryItem(
+                    text=row["text"], data=row["data"], tags=set(row["tags"]),
+                    priority=row["priority"], ttl=row["ttl"],
+                    entry_id=row["entry_id"], created_at=row["created_at"],
+                )
+                self._items[item.entry_id] = item
+                for tag in item.tags:
+                    self._tag_index.setdefault(tag, set()).add(item.entry_id)
+            self._order = [i for i in state["order"] if i in self._items]
+            self._next_id = state["next_id"]
+            self._task_history = {
+                k: list(v) for k, v in state["task_history"].items()
+            }
+            self._interactions = list(state["interactions"])
+            self._patterns = {
+                row["key"]: MemoryItem(
+                    text=row["key"], data=row["data"], ttl=row["ttl"],
+                    created_at=row["created_at"],
+                )
+                for row in state["patterns"]
+            }
+            arrays = state.get("vector_arrays")
+            if arrays is not None and self.embedder is not None:
+                self._vectors = _VectorStore(self.capacity, self.embedder.dim)
+                self._vectors.import_arrays(arrays)
+            else:
+                # Never keep a pre-import buffer: its rows map old embeddings
+                # onto the restored entry ids.
+                self._vectors = None
 
     def get_metrics(self) -> Dict[str, Any]:
         return {

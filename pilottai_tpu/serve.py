@@ -157,6 +157,15 @@ class Serve:
         self.dynamic_scaling = None
         self.fault_tolerance = None
 
+        # Durable task journal (crash/preemption recovery, SURVEY §5.4).
+        self.journal = None
+        if self.config.journal_path:
+            from pilottai_tpu.checkpoint.journal import TaskJournal
+
+            self.journal = TaskJournal(
+                self.config.journal_path, fsync=self.config.journal_fsync
+            )
+
     # ------------------------------------------------------------------ #
     # Agent management (both API styles, fixing §2.12-a)
     # ------------------------------------------------------------------ #
@@ -207,12 +216,70 @@ class Serve:
         for agent in self.agents.values():
             agent.dependency_resolver = agent.dependency_resolver or self.get_task
             await agent.start()
+        if self.journal is not None:
+            self.journal.reopen()  # no-op unless a prior stop() closed it
+            if self.config.journal_recover:
+                await self.recover()
         self._bg_tasks = [
             asyncio.create_task(self._process_tasks(), name="serve-processor"),
             asyncio.create_task(self._cleanup_loop(), name="serve-cleanup"),
         ]
         await self._start_services()
         self._log.info("serve started with %d agents", len(self.agents))
+
+    async def recover(self) -> int:
+        """Replay the journal and requeue unfinished work.
+
+        Recovery semantics are at-least-once: tasks that were queued or in
+        flight when the process died rerun from scratch (their results were
+        never journaled). Decomposed parents are NOT re-queued — their
+        parent/child links are restored and they complete when their
+        surviving children do. Returns the number of tasks requeued.
+        """
+        from pilottai_tpu.checkpoint.journal import TaskJournal
+
+        tasks = TaskJournal.replay(self.journal.path)
+        requeued = 0
+        for task in tasks.values():
+            known = task.id in self.all_tasks
+            self.all_tasks.setdefault(task.id, task)
+            if known:
+                continue
+            if task.status == TaskStatus.COMPLETED:
+                self.completed_tasks[task.id] = task
+            elif task.status.is_terminal:
+                self.failed_tasks[task.id] = task
+            elif task.subtasks and all(c in tasks for c in task.subtasks):
+                self._parent_children[task.id] = list(task.subtasks)
+                task.status = TaskStatus.BLOCKED  # waits on recovered children
+            elif task.subtasks:
+                # Some children never reached the journal (crash mid-
+                # decomposition) or were compacted away — aggregating now
+                # would silently lose their outputs. Re-run the parent from
+                # scratch instead (at-least-once).
+                task.subtasks = []
+                task.status = TaskStatus.PENDING
+                task.agent_id = None
+                await self._queue_task(task)
+                requeued += 1
+            else:
+                task.status = TaskStatus.PENDING
+                task.agent_id = None
+                await self._queue_task(task)
+                requeued += 1
+        # A recovered parent whose children all finished pre-crash would
+        # otherwise wait forever — re-run the aggregation check now.
+        for task in tasks.values():
+            if task.subtasks and not task.status.is_terminal:
+                await self._check_parent(task.id)
+        if requeued:
+            self._log.info(
+                "journal recovery: %d tasks requeued (%d total in journal)",
+                requeued, len(tasks),
+            )
+        # Compact so the next boot replays only live work.
+        self.journal.compact()
+        return requeued
 
     async def _start_services(self) -> None:
         if self.config.load_balancing_enabled:
@@ -246,6 +313,8 @@ class Serve:
             await agent.stop()
         if self.manager_llm is not None:
             await self.manager_llm.stop()
+        if self.journal is not None:
+            self.journal.close()
         self._log.info("serve stopped")
 
     # ------------------------------------------------------------------ #
@@ -285,6 +354,8 @@ class Serve:
         return task
 
     async def _queue_task(self, task: Task) -> None:
+        if self.journal is not None:
+            self.journal.record_task(task)
         try:
             evicted = await self.task_queue.put(task)
         except asyncio.QueueFull:
@@ -356,6 +427,8 @@ class Serve:
         task.subtasks = [s.id for s in subtasks]
         self._parent_children[task.id] = [s.id for s in subtasks]
         task.status = TaskStatus.BLOCKED
+        if self.journal is not None:  # parents never pass through _queue_task
+            self.journal.record_task(task)
         self.metrics["subtasks_created"] += len(subtasks)
         for sub in subtasks:
             self.all_tasks[sub.id] = sub
@@ -385,6 +458,12 @@ class Serve:
         )
 
     async def wait_for(self, task_id: str, timeout: Optional[float] = None) -> TaskResult:
+        # Already-terminal tasks (e.g. recovered from the journal in a
+        # finished state) resolve immediately — no _finalize will ever fire
+        # for them in this process.
+        task = self.all_tasks.get(task_id)
+        if task is not None and task.status.is_terminal and task.result is not None:
+            return task.result
         future = self._waiters.setdefault(
             task_id, asyncio.get_running_loop().create_future()
         )
@@ -544,6 +623,9 @@ class Serve:
                 task.mark_failed(result.error or "failed", result)
             self.failed_tasks[task.id] = task
             self.metrics["tasks_failed"] += 1
+
+        if self.journal is not None:
+            self.journal.record_status(task)
 
         waiter = self._waiters.get(task.id)
         if waiter is not None and not waiter.done():
