@@ -100,6 +100,7 @@ class LLMConfig(BaseModel):
     dtype: str = "bfloat16"
     engine_slots: int = Field(default=8, ge=1)       # continuous-batching slots
     engine_max_seq: Optional[int] = None             # KV length cap (default model max)
+    engine_chunk: int = Field(default=16, ge=1)      # decode tokens per dispatch
     seed: int = 0                                    # param init seed when no checkpoint
 
 

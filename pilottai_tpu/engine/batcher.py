@@ -7,9 +7,21 @@ batcher multiplexes them onto fixed-shape device computations:
 * a dedicated *device thread* runs prefill/decode (never the asyncio loop —
   the reference's blocking-psutil-in-async-loop bug, SURVEY §2.12-h, is the
   cautionary tale);
-* requests admit into KV-cache *slots* between decode steps (continuous
-  batching: no head-of-line blocking on long generations);
-* prefills compile per power-of-two length bucket; decode compiles once.
+* decode runs as **fused multi-token chunks** (``engine/decode.py``): one
+  dispatch per CHUNK tokens, with sampling + EOS/budget tracking on
+  device, because each host<->device round trip costs ~100 ms through a
+  remote-TPU tunnel — per-token syncing was the 20x p50 miss of
+  VERDICT.md Weak #2;
+* chunk dispatches are **pipelined** (depth 2): the host reads chunk N-1's
+  tokens while chunks N and N+1 compute, so even the once-per-chunk sync
+  overlaps device work;
+* admissions happen between chunks in **batched groups**: one prefill for
+  up to ``admit_batch`` prompts (padded to a fixed group size so compile
+  variants stay bounded), KV written by one batched scatter, first token
+  sampled on device with the slot's own sampling params (no host-side
+  sampling duplicate — VERDICT.md Weak #9);
+* prefills compile per power-of-two length bucket; the decode chunk
+  compiles once.
 
 All shapes static → zero recompiles at steady state.
 """
@@ -19,18 +31,27 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pilottai_tpu.engine.sampling import SamplingState, sample_tokens, update_slot
+from pilottai_tpu.engine.decode import (
+    DecodeState,
+    admit_decode,
+    decode_chunk,
+    release_decode,
+    sample_prefill_tokens,
+)
+from pilottai_tpu.engine.sampling import SamplingState, admit_sampling
 from pilottai_tpu.models.common import ModelConfig
-from pilottai_tpu.models.transformer import forward_decode, forward_prefill
-from pilottai_tpu.ops.kvcache import KVCache, write_prompt
+from pilottai_tpu.models.transformer import forward_prefill
+from pilottai_tpu.ops.kvcache import KVCache, free_slots, write_prompts
+from pilottai_tpu.ops.pallas.decode_attention import decode_shapes_ok
 from pilottai_tpu.utils.logging import get_logger
 from pilottai_tpu.utils.metrics import global_metrics
 
@@ -48,7 +69,7 @@ class GenRequest:
     future: Future = field(default_factory=Future)
     submitted_at: float = field(default_factory=time.perf_counter)
     # Set by the caller (any thread) to abandon the request; the device loop
-    # frees its slot at the next step instead of decoding dead work.
+    # frees its slot at the next chunk boundary instead of decoding dead work.
     cancelled: bool = False
 
 
@@ -57,11 +78,20 @@ class _Slot:
     request: GenRequest
     generated: List[int] = field(default_factory=list)
     prompt_len: int = 0
-    # (cancellation lives on the request: see GenRequest.cancelled)
+    # First generated token still living on device (read lazily with the
+    # admission group's array; None once folded into ``generated``).
+    first_pending: bool = True
+    # Decode tokens already covered by dispatched chunks. Once this reaches
+    # the request's budget, further chunks can't produce anything for the
+    # slot — the device loop uses it to stop dispatching no-op chunks
+    # while completions are still in the read pipeline.
+    dispatched: int = 0
 
 
 class ContinuousBatcher:
-    """Slot-based continuous batching over a jitted prefill/decode pair."""
+    """Slot-based continuous batching over jitted prefill / fused-decode."""
+
+    PIPELINE_DEPTH = 2
 
     def __init__(
         self,
@@ -71,12 +101,33 @@ class ContinuousBatcher:
         max_seq_len: Optional[int] = None,
         min_bucket: int = 64,
         cache_dtype=jnp.bfloat16,
+        chunk_size: int = 16,
+        admit_batch: int = 8,
+        use_pallas: Optional[bool] = None,
     ) -> None:
         self.cfg = cfg
         self.params = params
         self.n_slots = n_slots
         self.max_seq_len = min(max_seq_len or cfg.max_seq_len, cfg.max_seq_len)
         self.min_bucket = min_bucket
+        self.chunk_size = chunk_size
+        self.admit_batch = min(admit_batch, n_slots)
+        if use_pallas is None:
+            # Measured on v5e: with the cache read-only inside the chunk
+            # scan, XLA's dense attention beats the Pallas prefix kernel at
+            # both S=512 and S=2048 — the kernel stays available for A/B
+            # via PILOTTAI_DECODE_PALLAS=1.
+            import os
+
+            use_pallas = (
+                bool(os.environ.get("PILOTTAI_DECODE_PALLAS"))
+                and jax.default_backend() == "tpu"
+                and decode_shapes_ok(
+                    self.max_seq_len, cfg.head_dim,
+                    jnp.dtype(cache_dtype).itemsize,
+                )
+            )
+        self.use_pallas = use_pallas
         self._log = get_logger("engine.batcher")
 
         self.cache = KVCache.create(
@@ -84,13 +135,26 @@ class ContinuousBatcher:
             dtype=cache_dtype,
         )
         self.sampling = SamplingState.create(n_slots)
+        self.dstate = DecodeState.create(n_slots)
         self._slots: List[Optional[_Slot]] = [None] * n_slots
+        # Admission generation per slot: chunk results are stamped with the
+        # generation vector at dispatch, so a chunk dispatched before a slot
+        # was re-admitted can never fold tokens into the new occupant.
+        self._gen: List[int] = [0] * n_slots
         self._pending: "queue.Queue[GenRequest]" = queue.Queue()
+        self._release: List[int] = []  # slots to force-stop at next admission
+        # (group_slots, first_tokens device array) awaiting lazy host read
+        self._first_reads: deque = deque()
+        # Slot table / gen / release / first_reads are shared between the
+        # device thread (admission) and the reader thread (completion).
+        self._lock = threading.Lock()
+        # Dispatched chunks awaiting host read. Bounded so the device
+        # thread can't run unboundedly ahead of completions.
+        self._results: "queue.Queue" = queue.Queue(maxsize=self.PIPELINE_DEPTH)
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-
-        self._insert = jax.jit(write_prompt, donate_argnums=(0,))
+        self._reader: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------ #
     # Lifecycle
@@ -103,14 +167,21 @@ class ContinuousBatcher:
         self._thread = threading.Thread(
             target=self._run, name="pilottai-device-loop", daemon=True
         )
+        self._reader = threading.Thread(
+            target=self._read_loop, name="pilottai-reader", daemon=True
+        )
         self._thread.start()
+        self._reader.start()
 
     def stop(self) -> None:
         self._stop.set()
         self._wake.set()
         if self._thread is not None:
-            self._thread.join(timeout=30)
+            self._thread.join(timeout=60)
             self._thread = None
+        if self._reader is not None:
+            self._reader.join(timeout=60)
+            self._reader = None
         # Fail any stranded requests.
         while True:
             try:
@@ -122,13 +193,25 @@ class ContinuousBatcher:
         for slot in self._slots:
             if slot and not slot.request.future.done():
                 slot.request.future.set_exception(RuntimeError("engine stopped"))
+        self._slots = [None] * self.n_slots
 
-    def warmup(self, prompt_len: int = 64) -> None:
-        """Compile the decode step and one prefill bucket up front."""
-        ids = list(range(2, 2 + prompt_len))
-        req = GenRequest(prompt_ids=ids, max_new_tokens=2)
-        self.submit(req)
-        req.future.result(timeout=600)
+    def warmup(self, prompt_lens: Optional[Tuple[int, ...]] = None) -> None:
+        """Compile the admission path for EVERY prefill bucket plus the
+        decode chunk up front, so steady-state serving never waits on the
+        compiler. Groups are padded to ``admit_batch``, so one request per
+        bucket compiles the same batched write/sample/admit shapes a full
+        production wave hits."""
+        if prompt_lens is None:
+            prompt_lens = tuple(sorted(
+                {self._bucket(n) for n in range(1, self.max_seq_len + 1)}
+            ))
+        for plen in prompt_lens:
+            plen = min(plen, self.max_seq_len - 8)
+            req = GenRequest(
+                prompt_ids=list(range(2, 2 + plen)), max_new_tokens=2
+            )
+            self.submit(req)
+            req.future.result(timeout=900)
 
     # ------------------------------------------------------------------ #
     # Submission (any thread)
@@ -151,99 +234,178 @@ class ContinuousBatcher:
     # ------------------------------------------------------------------ #
 
     def _bucket(self, n: int) -> int:
+        # Power-of-two buckets only. Finer (1.5x-midpoint) buckets save
+        # padded prefill FLOPs but triple the executable count, which
+        # thrashes bounded compile/executable caches — measured as
+        # multi-second dispatch stalls on every admission.
         b = self.min_bucket
         while b < n:
             b *= 2
         return min(b, self.max_seq_len)
 
-    def _admit(self) -> None:
-        for idx in range(self.n_slots):
-            if self._slots[idx] is not None:
-                continue
-            try:
-                req = self._pending.get_nowait()
-            except queue.Empty:
-                return
-            if req.cancelled or req.future.cancelled():
-                continue
-            try:
-                self._prefill_into(idx, req)
-            except Exception as exc:  # noqa: BLE001 - fail this request only
-                self._log.error("prefill failed: %s", exc, exc_info=True)
-                self._slots[idx] = None
-                if not req.future.done():
-                    req.future.set_exception(exc)
+    def _free_slot_indices(self) -> List[int]:
+        return [i for i, s in enumerate(self._slots) if s is None]
 
-    def _prefill_into(self, idx: int, req: GenRequest) -> None:
-        ids = req.prompt_ids
-        T = self._bucket(len(ids))
-        tokens = np.zeros((1, T), np.int32)
-        tokens[0, : len(ids)] = ids
-        positions = np.arange(T, dtype=np.int32)[None]
+    def _admit(self) -> None:
+        """Stop released slots, then prefill+install pending requests in
+        padded groups. Slot selection happens under the lock; the device
+        dispatches run outside it (a dispatch that blocks on a deep device
+        queue must not stall the reader thread's completions). Admits
+        until slots or pending run out — completions arrive in waves, and
+        refilling only one group per chunk would leave slots idle."""
+        with self._lock:
+            released = list(self._release)
+            self._release.clear()
+            free = self._free_slot_indices()
+            groups: List[List[Tuple[int, GenRequest]]] = []
+            while free:
+                group: List[Tuple[int, GenRequest]] = []
+                while free and len(group) < self.admit_batch:
+                    try:
+                        req = self._pending.get_nowait()
+                    except queue.Empty:
+                        break
+                    if req.cancelled or req.future.cancelled():
+                        continue
+                    group.append((free.pop(0), req))
+                if not group:
+                    break
+                groups.append(group)
+            # Only this thread allocates slots, so the picks stay valid
+            # after the lock drops; occupied entries land in _prefill_group.
+
+        if released:
+            # Fixed-size release vector (padded with OOB indices) so the
+            # jitted release path compiles exactly once. Must precede the
+            # prompt writes below when a released slot is being reused.
+            rel = np.full((self.n_slots,), self.n_slots, np.int32)
+            rel[: len(released)] = released[: self.n_slots]
+            rel_j = jnp.asarray(rel)
+            self.dstate = release_decode(self.dstate, rel_j)
+            self.cache = free_slots(self.cache, rel_j)
+
+        for group in groups:
+            try:
+                self._prefill_group(group)
+            except Exception as exc:  # noqa: BLE001 — fail these requests only
+                self._log.error("prefill failed: %s", exc, exc_info=True)
+                with self._lock:
+                    for idx, req in group:
+                        self._slots[idx] = None
+                        if not req.future.done():
+                            req.future.set_exception(exc)
+
+    def _prefill_group(self, group: List[Tuple[int, GenRequest]]) -> None:
+        A = self.admit_batch
+        T = self._bucket(max(len(r.prompt_ids) for _, r in group))
+        tokens = np.zeros((A, T), np.int32)
+        lens = np.zeros((A,), np.int32)
+        slots = np.full((A,), self.n_slots, np.int32)  # OOB = padding row
+        temps = np.zeros((A,), np.float32)
+        topks = np.zeros((A,), np.int32)
+        topps = np.ones((A,), np.float32)
+        seeds = np.zeros((A,), np.int32)
+        eos = np.full((A,), -1, np.int32)
+        budgets = np.zeros((A,), np.int32)
+        for row, (idx, req) in enumerate(group):
+            ids = req.prompt_ids
+            tokens[row, : len(ids)] = ids
+            lens[row] = len(ids)
+            slots[row] = idx
+            temps[row] = req.temperature
+            topks[row] = req.top_k
+            topps[row] = req.top_p
+            seeds[row] = req.seed
+            eos[row] = req.eos_id
+            budgets[row] = req.max_new_tokens - 1
+
+        positions = np.broadcast_to(np.arange(T, dtype=np.int32)[None], (A, T))
+        lens_j = jnp.asarray(lens)
+        slots_j = jnp.asarray(slots)
         with global_metrics.timer("engine.prefill_latency"):
             logits, ks, vs = forward_prefill(
-                self.params, self.cfg, jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray([len(ids)], jnp.int32),
+                self.params, self.cfg, jnp.asarray(tokens),
+                jnp.asarray(positions), lens_j,
             )
-        self.cache = self._insert(
-            self.cache, jnp.int32(idx), ks[:, 0], vs[:, 0], jnp.int32(len(ids))
+        self.cache = self._write_prompts(self.cache, slots_j, ks, vs, lens_j)
+        self.sampling = admit_sampling(
+            self.sampling, slots_j, jnp.asarray(temps), jnp.asarray(topks),
+            jnp.asarray(topps), jnp.asarray(seeds), jnp.asarray(eos),
         )
-        self.sampling = update_slot(
-            self.sampling, idx, req.temperature, req.top_k, req.top_p, req.seed
+        first, self.sampling = sample_prefill_tokens(
+            logits, lens_j, slots_j, self.sampling
         )
-        # First generated token comes from the last prompt logit.
-        first = self._sample_one(np.asarray(logits[0, len(ids) - 1]), req)
-        slot = _Slot(request=req, prompt_len=len(ids))
-        slot.generated.append(first)
-        self._slots[idx] = slot
-        global_metrics.inc("engine.admitted")
-        if self._finished(slot):
-            self._complete(idx)
+        self.dstate = admit_decode(
+            self.dstate, slots_j, first, jnp.asarray(budgets),
+            jnp.asarray(lens > 0),
+        )
+        try:
+            first.copy_to_host_async()
+        except AttributeError:
+            pass
+        with self._lock:
+            for idx, req in group:
+                self._slots[idx] = _Slot(
+                    request=req, prompt_len=len(req.prompt_ids)
+                )
+                self._gen[idx] += 1
+            self._first_reads.append(
+                ([(idx, self._gen[idx]) for idx, _ in group], first)
+            )
+        global_metrics.inc("engine.admitted", len(group))
 
-    @staticmethod
-    def _sample_one(logits: np.ndarray, req: GenRequest) -> int:
-        """Host-side sampling for the first token (it comes straight out of
-        prefill); must honor the same temperature/top_k/top_p contract as
-        the device sampler used for all subsequent tokens."""
-        if req.temperature <= 0.0:
-            return int(np.argmax(logits))
-        rng = np.random.default_rng(req.seed)
-        scaled = logits.astype(np.float64) / max(req.temperature, 1e-6)
-        if req.top_k > 0:
-            kth = np.partition(scaled, -req.top_k)[-req.top_k]
-            scaled = np.where(scaled >= kth, scaled, -np.inf)
-        if req.top_p < 1.0:
-            order = np.argsort(scaled)[::-1]
-            probs_sorted = np.exp(scaled[order] - np.nanmax(scaled))
-            probs_sorted /= probs_sorted.sum()
-            cum = np.cumsum(probs_sorted)
-            keep_sorted = (cum - probs_sorted) < req.top_p  # exclusive mass
-            drop = order[~keep_sorted]
-            scaled[drop] = -np.inf
-        probs = np.exp(scaled - scaled.max())
-        probs /= probs.sum()
-        return int(rng.choice(len(probs), p=probs))
+    _write_prompts = staticmethod(
+        jax.jit(write_prompts, donate_argnums=(0,))
+    )
 
-    def _finished(self, slot: _Slot) -> bool:
-        req = slot.request
-        if req.cancelled or req.future.cancelled():
-            return True
-        last = slot.generated[-1]
-        if last == req.eos_id or last in req.stop_ids:
-            return True
-        if len(slot.generated) >= req.max_new_tokens:
-            return True
-        if slot.prompt_len + len(slot.generated) >= self.max_seq_len - 1:
-            return True
-        return False
+    def _fold_first_tokens(self, groups, hosts: List[np.ndarray]) -> None:
+        """Fold prefill-sampled first tokens into their slots (lock held).
+        Entries carry the admission generation, so a stale entry from a
+        failed/aborted generation can never feed the slot's next occupant."""
+        for (rows, _), host in zip(groups, hosts):
+            host = np.asarray(host)
+            for row, (idx, gen) in enumerate(rows):
+                slot = self._slots[idx]
+                if slot is None or not slot.first_pending or gen != self._gen[idx]:
+                    continue
+                slot.first_pending = False
+                slot.generated.append(int(host[row]))
+                self._check_finished(idx)
 
-    def _complete(self, idx: int) -> None:
+    def _drain_first_reads_now(self) -> None:
+        """Device thread: fold pending first tokens without waiting for a
+        chunk read — the only completion path for max_new_tokens <= 1
+        requests, whose zero decode budget never dispatches a chunk."""
+        with self._lock:
+            groups = list(self._first_reads)
+            self._first_reads.clear()
+        if not groups:
+            return
+        hosts = jax.device_get([f for _, f in groups])
+        with self._lock:
+            self._fold_first_tokens(groups, hosts)
+
+    def _check_finished(self, idx: int) -> None:
+        """Apply host-side completion rules to a slot; complete + free it
+        when generation is over."""
         slot = self._slots[idx]
-        assert slot is not None
-        self._slots[idx] = None
-        self.cache = self.cache._replace(lengths=self.cache.lengths.at[idx].set(0))
+        if slot is None:
+            return
         req = slot.request
         out = slot.generated
+        finished = False
+        if req.cancelled or req.future.cancelled():
+            finished = True
+        elif out and (out[-1] == req.eos_id or out[-1] in req.stop_ids):
+            finished = True
+        elif len(out) >= req.max_new_tokens:
+            finished = True
+        elif slot.prompt_len + len(out) >= self.max_seq_len - 1:
+            finished = True
+        if not finished:
+            return
+        self._slots[idx] = None
+        self._release.append(idx)
         if out and (out[-1] == req.eos_id or out[-1] in req.stop_ids):
             out = out[:-1]
         latency = time.perf_counter() - req.submitted_at
@@ -256,45 +418,128 @@ class ContinuousBatcher:
     def _active_any(self) -> bool:
         return any(s is not None for s in self._slots)
 
-    def _decode_step(self) -> None:
-        tokens = np.zeros((self.n_slots,), np.int32)
-        active = np.zeros((self.n_slots,), bool)
-        for i, slot in enumerate(self._slots):
-            if slot is not None:
-                tokens[i] = slot.generated[-1]
-                active[i] = True
-        with global_metrics.timer("engine.decode_step_latency"):
-            logits, self.cache = forward_decode(
-                self.params, self.cfg, jnp.asarray(tokens), self.cache,
-                jnp.asarray(active),
-            )
-            next_tokens, self.sampling = sample_tokens(logits, self.sampling)
-            host_tokens = np.asarray(next_tokens)  # one small D2H per step
-        global_metrics.inc("engine.decode_steps")
-        for i, slot in enumerate(self._slots):
-            if slot is None:
-                continue
-            slot.generated.append(int(host_tokens[i]))
-            if self._finished(slot):
-                self._complete(i)
+    def _chunk_useful(self) -> bool:
+        """True when at least one occupied slot still has decode budget a
+        new chunk could consume (lock held)."""
+        for s in self._slots:
+            if s is not None and s.dispatched < s.request.max_new_tokens - 1:
+                return True
+        return False
 
-    def _run(self) -> None:
-        self._log.info("device loop starting (slots=%d, max_seq=%d)",
-                       self.n_slots, self.max_seq_len)
-        while not self._stop.is_set():
-            self._admit()
-            if not self._active_any():
-                self._wake.wait(timeout=0.05)
-                self._wake.clear()
+    def _dispatch_chunk(self):
+        with global_metrics.timer("engine.chunk_dispatch_latency"):
+            toks, valid, self.cache, self.dstate, self.sampling = decode_chunk(
+                self.params, self.cfg, self.cache, self.dstate, self.sampling,
+                self.chunk_size, self.use_pallas,
+            )
+        # Start the D2H transfer as soon as the chunk finishes computing,
+        # so the blocking read one pipeline-cycle later is a cache hit, not
+        # a full round trip (the tunnel RTT is ~100 ms).
+        try:
+            toks.copy_to_host_async()
+            valid.copy_to_host_async()
+        except AttributeError:  # non-jax array types in tests
+            pass
+        global_metrics.inc("engine.decode_steps", self.chunk_size)
+        return toks, valid, tuple(self._gen)
+
+    def _process_chunk(self, toks, valid, gen_stamp) -> None:
+        """Host-read one finished chunk and fold its tokens into slots
+        (reader thread). Pending first-token arrays ride the same read."""
+        with self._lock:
+            groups = list(self._first_reads)
+            self._first_reads.clear()
+        firsts = [f for _, f in groups]
+        with global_metrics.timer("engine.chunk_read_latency"):
+            fetched = jax.device_get([toks, valid] + firsts)
+        toks_h = np.asarray(fetched[0])
+        valid_h = np.asarray(fetched[1])
+        n, B = toks_h.shape
+        with self._lock:
+            # First tokens were sampled before this chunk ran — fold them
+            # first so token order inside each slot is right.
+            if groups:
+                self._fold_first_tokens(groups, fetched[2:])
+            for b in range(B):
+                slot = self._slots[b]
+                if (
+                    slot is None
+                    or slot.first_pending
+                    or gen_stamp[b] != self._gen[b]
+                ):
+                    continue
+                for i in range(n):
+                    if not valid_h[i, b]:
+                        continue
+                    slot.generated.append(int(toks_h[i, b]))
+                    self._check_finished(b)
+                    if self._slots[b] is None:
+                        break
+        global_metrics.inc("engine.generated_tokens_device", int(valid_h.sum()))
+
+    def _read_loop(self) -> None:
+        """Reader thread: blockingly reads dispatched chunks and resolves
+        completions, so the device thread never stalls on a transfer."""
+        while True:
+            try:
+                item = self._results.get(timeout=0.1)
+            except queue.Empty:
+                if self._stop.is_set():
+                    break
                 continue
             try:
-                self._decode_step()
-            except Exception as exc:  # noqa: BLE001 - device loop boundary
-                self._log.error("decode step failed: %s", exc, exc_info=True)
-                for i, slot in enumerate(self._slots):
-                    if slot is not None and not slot.request.future.done():
+                self._process_chunk(*item)
+            except Exception as exc:  # noqa: BLE001 — reader boundary
+                # The chunk's tokens are lost on the host while the device
+                # has already consumed their budget; swallowing would hang
+                # the affected requests forever and leak their slots.
+                self._log.error("reader error: %s", exc, exc_info=True)
+                self._fail_occupied_slots(exc)
+            self._wake.set()
+        self._log.info("reader stopped")
+
+    def _fail_occupied_slots(self, exc: Exception) -> None:
+        """Fail every in-flight request and reset slot bookkeeping after an
+        unrecoverable device/transfer error (either thread)."""
+        with self._lock:
+            for i, slot in enumerate(self._slots):
+                if slot is not None:
+                    if not slot.request.future.done():
                         slot.request.future.set_exception(exc)
-                        self._slots[i] = None
+                    self._slots[i] = None
+                    self._gen[i] += 1
+                    self._release.append(i)
+            self._first_reads.clear()
+
+    def _run(self) -> None:
+        self._log.info(
+            "device loop starting (slots=%d, max_seq=%d, chunk=%d, pallas=%s)",
+            self.n_slots, self.max_seq_len, self.chunk_size, self.use_pallas,
+        )
+        while not self._stop.is_set():
+            try:
+                self._admit()
+                with self._lock:
+                    useful = self._chunk_useful()
+                    if useful:
+                        for s in self._slots:
+                            if s is not None:
+                                s.dispatched += self.chunk_size
+                if useful:
+                    item = self._dispatch_chunk()
+                    while not self._stop.is_set():
+                        try:
+                            self._results.put(item, timeout=0.5)
+                            break
+                        except queue.Full:
+                            continue
+                else:
+                    self._drain_first_reads_now()
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+            except Exception as exc:  # noqa: BLE001 — device loop boundary
+                self._log.error("device loop error: %s", exc, exc_info=True)
+                self._fail_occupied_slots(exc)
         self._log.info("device loop stopped")
 
     # ------------------------------------------------------------------ #

@@ -1,0 +1,314 @@
+"""Fused multi-step decode: N tokens per device dispatch.
+
+Why this exists: through a remote-TPU tunnel (and even locally, at small
+per-step cost) every host<->device round trip costs ~100 ms; a
+one-dispatch-per-token decode loop is latency-bound long before the chip
+is. ``decode_chunk`` jits a ``lax.scan`` over N decode steps — sampling,
+EOS/budget tracking, and KV writes all on device — so the host touches
+the device once per N tokens, and the batcher pipelines chunks so even
+that touch overlaps compute (``engine/batcher.py``).
+
+The KV-cache trick: inside the chunk the big per-layer cache panels are
+**read-only** (prefix attention via the Pallas decode kernel — a custom
+call that wrote carry state would force XLA to copy the panels every
+layer, every step). Each step's fresh K/V goes to a tiny per-layer ring
+buffer ([B, K, N, H]); in-chunk attention runs dense over the ring and
+merges with the prefix pass by the standard online-softmax combine; one
+batched scatter per layer lands the ring in the big cache at chunk end.
+
+No reference counterpart: the reference's only decode loop is a remote
+HTTP call (``pilott/engine/llm.py:59``). This file is the engine half of
+the ≤500 ms p50 agent-step target (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pilottai_tpu.engine.sampling import SamplingState, sample_core
+from pilottai_tpu.models.common import ModelConfig, rms_norm, rope_tables
+from pilottai_tpu.models.transformer import _attn_out, _embed, _mlp, _qkv, _unembed
+from pilottai_tpu.ops.kvcache import KVCache, write_chunk_rows
+from pilottai_tpu.ops.pallas.decode_attention import decode_attention
+
+NEG_INF = -2.0**30
+
+
+class DecodeState(NamedTuple):
+    """Per-slot generation state living on device across chunks."""
+
+    tokens: jax.Array  # [B] int32 — next input token (last sampled)
+    done: jax.Array    # [B] bool — finished or empty slot
+    budget: jax.Array  # [B] int32 — generations still allowed
+
+    @classmethod
+    def create(cls, n_slots: int) -> "DecodeState":
+        return cls(
+            tokens=jnp.zeros((n_slots,), jnp.int32),
+            done=jnp.ones((n_slots,), bool),
+            budget=jnp.zeros((n_slots,), jnp.int32),
+        )
+
+
+@partial(jax.jit, donate_argnames=("state",))
+def admit_decode(
+    state: DecodeState,
+    slots: jax.Array,         # [A] int32; OOB rows dropped
+    first_tokens: jax.Array,  # [A] int32 — sampled from the prefill logits
+    budgets: jax.Array,       # [A] int32 — max_new_tokens - 1 (first token
+                              # already produced); <= 0 admits as done
+    live: jax.Array,          # [A] bool — False rows are padding
+) -> DecodeState:
+    slots = jnp.where(live, slots, state.tokens.shape[0])
+    return DecodeState(
+        tokens=state.tokens.at[slots].set(first_tokens, mode="drop"),
+        done=state.done.at[slots].set(budgets <= 0, mode="drop"),
+        budget=state.budget.at[slots].set(jnp.maximum(budgets, 0), mode="drop"),
+    )
+
+
+@partial(jax.jit, donate_argnames=("state",))
+def release_decode(state: DecodeState, slots: jax.Array) -> DecodeState:
+    """Host-side completion/cancel: stop decoding these slots."""
+    return DecodeState(
+        tokens=state.tokens,
+        done=state.done.at[slots].set(True, mode="drop"),
+        budget=state.budget.at[slots].set(0, mode="drop"),
+    )
+
+
+def _prefix_stats_dense(
+    qg: jax.Array,       # [B, K, G, H]
+    layer_k: jax.Array,  # [B, K, S, H]
+    layer_v: jax.Array,
+    last: jax.Array,     # [B] max valid key index (may be -1: empty)
+    qpos: jax.Array,     # [B] query absolute position
+    scale: float,
+    softcap: float,
+    window: int,
+):
+    """XLA fallback for the Pallas prefix kernel (CPU tests / tiny models).
+    Same (acc, m, l) contract."""
+    B, K, G, H = qg.shape
+    S = layer_k.shape[2]
+    s = jnp.einsum(
+        "bkgh,bksh->bkgs", qg, layer_k, preferred_element_type=jnp.float32
+    ) * scale
+    if softcap > 0.0:
+        s = jnp.tanh(s / softcap) * softcap
+    col = jnp.arange(S)[None, None, None, :]
+    mask = col <= last[:, None, None, None]
+    if window > 0:
+        mask &= (qpos[:, None, None, None] - col) < window
+    s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)                                   # [B, K, G]
+    p = jnp.where(
+        m[..., None] > NEG_INF / 2, jnp.exp(s - m[..., None]), 0.0
+    )
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum(
+        "bkgs,bksh->bkgh", p.astype(layer_v.dtype), layer_v,
+        preferred_element_type=jnp.float32,
+    )
+    return acc.reshape(B, K * G, H), m.reshape(B, K * G), l.reshape(B, K * G)
+
+
+def _ring_stats(
+    qg: jax.Array,      # [B, K, G, H]
+    ring_k: jax.Array,  # [B, K, N, H]
+    ring_v: jax.Array,
+    step: jax.Array,    # scalar — current chunk step i (rows 0..i valid)
+    scale: float,
+    softcap: float,
+    window: int,
+):
+    """In-chunk attention over the ring buffer. Row j holds the token at
+    chunk-relative offset j; for an active slot offset == step, so the
+    causal mask is j <= step and the window check (step - j) < window."""
+    B, K, G, H = qg.shape
+    N = ring_k.shape[2]
+    s = jnp.einsum(
+        "bkgh,bknh->bkgn", qg, ring_k, preferred_element_type=jnp.float32
+    ) * scale
+    if softcap > 0.0:
+        s = jnp.tanh(s / softcap) * softcap
+    j = jnp.arange(N)[None, None, None, :]
+    mask = j <= step
+    if window > 0:
+        mask &= (step - j) < window
+    s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])  # row 0 always valid -> never all-masked
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum(
+        "bkgn,bknh->bkgh", p.astype(ring_v.dtype), ring_v,
+        preferred_element_type=jnp.float32,
+    )
+    return acc.reshape(B, K * G, H), m.reshape(B, K * G), l.reshape(B, K * G)
+
+
+def _combine_stats(acc_a, m_a, l_a, acc_b, m_b, l_b):
+    """Merge two online-softmax partials over disjoint key sets."""
+    m = jnp.maximum(m_a, m_b)
+    wa = jnp.where(m_a > NEG_INF / 2, jnp.exp(m_a - m), 0.0)
+    wb = jnp.where(m_b > NEG_INF / 2, jnp.exp(m_b - m), 0.0)
+    l = l_a * wa + l_b * wb
+    acc = acc_a * wa[..., None] + acc_b * wb[..., None]
+    return acc / jnp.maximum(l, 1e-30)[..., None]
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "n_steps", "use_pallas"),
+    donate_argnames=("cache", "dstate", "sampling"),
+)
+def decode_chunk(
+    params,
+    cfg: ModelConfig,
+    cache: KVCache,
+    dstate: DecodeState,
+    sampling: SamplingState,
+    n_steps: int,
+    use_pallas: bool = True,
+) -> Tuple[jax.Array, jax.Array, KVCache, DecodeState, SamplingState]:
+    """Run ``n_steps`` decode steps for every slot in one dispatch.
+
+    Returns ``(tokens [n, B], valid [n, B], cache, dstate, sampling)``;
+    ``valid[i, b]`` marks tokens actually generated (slot active entering
+    step i). Slots flip ``done`` on device at EOS / budget / context-full,
+    so a finished slot stops writing cache and burning samples mid-chunk.
+    """
+    B = dstate.tokens.shape[0]
+    S = cache.max_len
+    start = cache.lengths                    # [B] frozen during the chunk
+    windows = cfg.window_sizes()
+    qscale = cfg.query_scale if cfg.query_scale is not None else cfg.head_dim**-0.5
+    G = cfg.n_heads // cfg.n_kv_heads
+    batch_shape = (B, cfg.n_kv_heads, n_steps, cfg.head_dim)
+    cache_dtype = cache.layers[0][0].dtype
+    rings = tuple(
+        (jnp.zeros(batch_shape, cache_dtype), jnp.zeros(batch_shape, cache_dtype))
+        for _ in range(cfg.n_layers)
+    )
+    prefix_last = start - 1                  # max valid prefix key index
+
+    def step(carry, i):
+        tokens, done, budget, offset, sampling, rings = carry
+        active = ~done
+        pos = start + offset                 # current token's position
+        x = _embed(cfg, params, tokens[:, None])          # [B, 1, E]
+        sin, cos = rope_tables(pos[:, None], cfg.head_dim, cfg.rope_theta)
+
+        new_rings = []
+        for l in range(cfg.n_layers):
+            lp = jax.tree.map(lambda a: a[l], params["layers"])
+            window = int(windows[l])
+            layer_k, layer_v = cache.layers[l]
+            rk, rv = rings[l]
+            p = lp["attn"]
+
+            h = rms_norm(x, lp["ln1"]["scale"], cfg.rms_eps, cfg.rms_offset)
+            q, k, v = _qkv(cfg, p, h, sin, cos)  # [B, 1, heads, H]
+
+            rk = jax.lax.dynamic_update_slice(
+                rk, k[:, 0][:, :, None].astype(rk.dtype), (0, 0, i, 0)
+            )
+            rv = jax.lax.dynamic_update_slice(
+                rv, v[:, 0][:, :, None].astype(rv.dtype), (0, 0, i, 0)
+            )
+
+            qf = q[:, 0]                                  # [B, N, H]
+            if use_pallas:
+                acc_p, m_p, l_p = decode_attention(
+                    qf, layer_k, layer_v, prefix_last, q_positions=pos,
+                    scale=qscale, softcap=cfg.attn_softcap, window=window,
+                    return_stats=True,
+                )
+            else:
+                acc_p, m_p, l_p = _prefix_stats_dense(
+                    qf.reshape(B, cfg.n_kv_heads, G, cfg.head_dim),
+                    layer_k, layer_v, prefix_last, pos,
+                    qscale, cfg.attn_softcap, window,
+                )
+            acc_c, m_c, l_c = _ring_stats(
+                qf.reshape(B, cfg.n_kv_heads, G, cfg.head_dim),
+                rk, rv, i, qscale, cfg.attn_softcap, window,
+            )
+            attn = _combine_stats(acc_p, m_p, l_p, acc_c, m_c, l_c)
+
+            out = _attn_out(cfg, p, attn.astype(x.dtype)[:, None])
+            if cfg.post_norms:
+                out = rms_norm(
+                    out, lp["ln1_post"]["scale"], cfg.rms_eps, cfg.rms_offset
+                )
+            x_res = x + out
+            h = rms_norm(x_res, lp["ln2"]["scale"], cfg.rms_eps, cfg.rms_offset)
+            out, _ = _mlp(cfg, lp, h)
+            if cfg.post_norms:
+                out = rms_norm(
+                    out, lp["ln2_post"]["scale"], cfg.rms_eps, cfg.rms_offset
+                )
+            x = x_res + out
+            new_rings.append((rk, rv))
+
+        h = rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps, cfg.rms_offset)
+        logits = _unembed(cfg, params, h)[:, 0]           # [B, V] fp32
+
+        sampled, sampling = sample_core(logits, sampling)
+        new_budget = budget - active.astype(jnp.int32)
+        hit_eos = (sampling.eos_id >= 0) & (sampled == sampling.eos_id)
+        ctx_full = (pos + 1) >= (S - 1)
+        new_done = done | (active & (hit_eos | (new_budget <= 0) | ctx_full))
+        new_tokens = jnp.where(active, sampled, tokens)
+        new_offset = offset + active.astype(jnp.int32)
+        carry = (
+            new_tokens, new_done, new_budget, new_offset, sampling,
+            tuple(new_rings),
+        )
+        return carry, (sampled, active)
+
+    offset0 = jnp.zeros((B,), jnp.int32)
+    carry0 = (
+        dstate.tokens, dstate.done, dstate.budget, offset0, sampling, rings
+    )
+    (tokens, done, budget, offset, sampling, rings), (out_toks, out_valid) = (
+        jax.lax.scan(step, carry0, jnp.arange(n_steps))
+    )
+
+    cache = write_chunk_rows(
+        cache, [r[0] for r in rings], [r[1] for r in rings], start, offset
+    )
+    dstate = DecodeState(tokens=tokens, done=done, budget=budget)
+    return out_toks, out_valid, cache, dstate, sampling
+
+
+@partial(jax.jit, donate_argnames=("sampling",))
+def sample_prefill_tokens(
+    logits: jax.Array,    # [A, T, V] fp32 — prefill logits
+    valid: jax.Array,     # [A] prompt lengths (last logit at valid-1)
+    slots: jax.Array,     # [A] slot each prompt was admitted into
+    sampling: SamplingState,
+) -> Tuple[jax.Array, SamplingState]:
+    """Sample each admitted prompt's first generated token on device,
+    using (and advancing) the slot's sampling params — host-side sampling
+    duplication was VERDICT.md Weak #9."""
+    A = logits.shape[0]
+    last = jnp.take_along_axis(
+        logits, jnp.maximum(valid - 1, 0)[:, None, None], axis=1
+    )[:, 0]                                              # [A, V]
+    sub = SamplingState(
+        temperature=sampling.temperature[slots],
+        top_k=sampling.top_k[slots],
+        top_p=sampling.top_p[slots],
+        key=sampling.key[slots],
+        eos_id=sampling.eos_id[slots],
+    )
+    tokens, sub = sample_core(last, sub)
+    del A
+    return tokens, sampling._replace(
+        key=sampling.key.at[slots].set(sub.key, mode="drop")
+    )

@@ -112,6 +112,7 @@ class NativeEngine(LLMBackend):
             n_slots=self.config.engine_slots,
             max_seq_len=max_seq,
             cache_dtype=self.model_cfg.dtype,
+            chunk_size=self.config.engine_chunk,
         )
         self.batcher.start()
         self.batcher.warmup()

@@ -22,6 +22,7 @@ class SamplingState(NamedTuple):
     top_k: jax.Array        # [B] int32; 0 => disabled
     top_p: jax.Array        # [B] fp32; 1.0 => disabled
     key: jax.Array          # [B, 2] uint32 per-slot PRNG keys
+    eos_id: jax.Array       # [B] int32; -1 => disabled (device EOS detect)
 
     @classmethod
     def create(cls, n_slots: int, seed: int = 0) -> "SamplingState":
@@ -31,6 +32,7 @@ class SamplingState(NamedTuple):
             top_k=jnp.zeros((n_slots,), jnp.int32),
             top_p=jnp.ones((n_slots,), jnp.float32),
             key=keys,
+            eos_id=jnp.full((n_slots,), -1, jnp.int32),
         )
 
 
@@ -58,13 +60,14 @@ def _mask_top_p(logits: jax.Array, p: jax.Array) -> jax.Array:
     return jnp.where(keep | (p[:, None] >= 1.0), logits, -jnp.inf)
 
 
-@partial(jax.jit, donate_argnames=("state",))
-def sample_tokens(
+def sample_core(
     logits: jax.Array,  # [B, V] fp32
     state: SamplingState,
 ) -> tuple[jax.Array, SamplingState]:
-    """Sample one token per slot; greedy where temperature == 0."""
-    B = logits.shape[0]
+    """Sample one token per slot; greedy where temperature == 0.
+
+    Plain function (no jit) so the decode chunk can inline it inside its
+    step scan; ``sample_tokens`` is the standalone jitted wrapper."""
     greedy = jnp.argmax(logits, axis=-1)
 
     temp = jnp.maximum(state.temperature, 1e-6)[:, None]
@@ -80,8 +83,15 @@ def sample_tokens(
     sampled = jax.vmap(sample_row)(step_keys, scaled)
 
     tokens = jnp.where(state.temperature <= 0.0, greedy, sampled)
-    del B
     return tokens.astype(jnp.int32), state._replace(key=carry_keys)
+
+
+@partial(jax.jit, donate_argnames=("state",))
+def sample_tokens(
+    logits: jax.Array,  # [B, V] fp32
+    state: SamplingState,
+) -> tuple[jax.Array, SamplingState]:
+    return sample_core(logits, state)
 
 
 def update_slot(
@@ -91,6 +101,7 @@ def update_slot(
     top_k: int,
     top_p: float,
     seed: int,
+    eos_id: int = -1,
 ) -> SamplingState:
     """Host-side admission: install one request's sampling params."""
     return SamplingState(
@@ -98,4 +109,26 @@ def update_slot(
         top_k=state.top_k.at[slot].set(top_k),
         top_p=state.top_p.at[slot].set(top_p),
         key=state.key.at[slot].set(jax.random.PRNGKey(seed)[None][0]),
+        eos_id=state.eos_id.at[slot].set(eos_id),
+    )
+
+
+@partial(jax.jit, donate_argnames=("state",))
+def admit_sampling(
+    state: SamplingState,
+    slots: jax.Array,        # [A] int32; out-of-range rows are dropped
+    temperature: jax.Array,  # [A] fp32
+    top_k: jax.Array,        # [A] int32
+    top_p: jax.Array,        # [A] fp32
+    seeds: jax.Array,        # [A] int32
+    eos_id: jax.Array,       # [A] int32
+) -> SamplingState:
+    """Batched admission: install a group of requests' sampling params."""
+    keys = jax.vmap(jax.random.PRNGKey)(seeds)
+    return SamplingState(
+        temperature=state.temperature.at[slots].set(temperature, mode="drop"),
+        top_k=state.top_k.at[slots].set(top_k, mode="drop"),
+        top_p=state.top_p.at[slots].set(top_p, mode="drop"),
+        key=state.key.at[slots].set(keys, mode="drop"),
+        eos_id=state.eos_id.at[slots].set(eos_id, mode="drop"),
     )

@@ -27,9 +27,8 @@ from pilottai_tpu.ops.attention import (
     dot_product_attention,
     flash_enabled,
     flash_shapes_ok,
-    sliding_window_row_mask,
 )
-from pilottai_tpu.ops.kvcache import KVCache, append_token
+from pilottai_tpu.ops.kvcache import KVCache
 from pilottai_tpu.parallel.sharding import with_logical_constraint
 
 
@@ -221,34 +220,55 @@ def forward_decode(
 ) -> Tuple[jax.Array, KVCache]:
     """One decode step for every slot. Returns (logits [B, V] fp32, cache).
 
+    This is the dense single-step *reference* path (pure XLA, per-layer
+    K-major panels); production serving runs the fused multi-step
+    ``engine/decode.py:decode_chunk`` which is parity-tested against it.
+
     Inactive slots still flow through the matmuls (static shapes — one
     compilation serves the whole serving lifetime) but their cache writes
     are routed out-of-bounds (dropped by XLA scatter semantics) and their
     lengths stay frozen, so a freed slot is bit-identical until readmission.
     """
     B = tokens.shape[0]
-    S_total = cache.max_len
+    S = cache.max_len
     # Write index == current length; inactive slots write at S (dropped).
-    positions = jnp.where(active, cache.lengths, S_total)
+    positions = jnp.where(active, cache.lengths, S)
     x = _embed(cfg, params, tokens[:, None])  # [B, 1, E]
     sin, cos = rope_tables(positions[:, None], cfg.head_dim, cfg.rope_theta)
-    windows = jnp.asarray(cfg.window_sizes())
+    windows = cfg.window_sizes()
     qscale = cfg.query_scale if cfg.query_scale is not None else cfg.head_dim**-0.5
-    S = cache.max_len
+    bidx = jnp.arange(B)
+    G = cfg.n_heads // cfg.n_kv_heads
+    col = jnp.arange(S)[None, None, None, :]              # [1, 1, 1, S]
+    pos_b = positions[:, None, None, None]                # [B, 1, 1, 1]
 
-    def layer_fn(carry, scanned):
-        x = carry
-        lp, layer_k, layer_v, window = scanned
+    new_layers = []
+    for l in range(cfg.n_layers):
+        lp = jax.tree.map(lambda a: a[l], params["layers"])
+        window = int(windows[l])
+        layer_k, layer_v = cache.layers[l]
         h = rms_norm(x, lp["ln1"]["scale"], cfg.rms_eps, cfg.rms_offset)
         q, k_new, v_new = _qkv(cfg, lp["attn"], h, sin, cos)
-        layer_k, layer_v = append_token(layer_k, layer_v, k_new, v_new, positions)
-        mask = sliding_window_row_mask(positions[:, None], S, window)
-        mask &= jnp.arange(S)[None, None, :] <= positions[:, None, None]
-        attn = dot_product_attention(
-            q, layer_k, layer_v, mask=mask, scale=qscale,
-            logit_softcap=cfg.attn_softcap,
-        )
-        out = _attn_out(cfg, lp["attn"], attn)
+        # K-major panels: write [B, K, H] at each slot's position.
+        layer_k = layer_k.at[bidx, :, positions].set(k_new[:, 0], mode="drop")
+        layer_v = layer_v.at[bidx, :, positions].set(v_new[:, 0], mode="drop")
+
+        qg = q[:, 0].reshape(B, cfg.n_kv_heads, G, cfg.head_dim)
+        s = jnp.einsum(
+            "bkgh,bksh->bkgs", qg, layer_k, preferred_element_type=jnp.float32
+        ) * qscale
+        if cfg.attn_softcap > 0.0:
+            s = jnp.tanh(s / cfg.attn_softcap) * cfg.attn_softcap
+        mask = col <= pos_b
+        if window > 0:
+            mask &= (pos_b - col) < window
+        s = jnp.where(mask, s, -2.0**30)
+        w = jax.nn.softmax(s, axis=-1).astype(layer_v.dtype)
+        attn = jnp.einsum(
+            "bkgs,bksh->bkgh", w, layer_v, preferred_element_type=jnp.float32
+        ).astype(x.dtype)
+
+        out = _attn_out(cfg, lp["attn"], attn.reshape(B, 1, cfg.n_heads, cfg.head_dim))
         if cfg.post_norms:
             out = rms_norm(out, lp["ln1_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
         x = x + out
@@ -257,17 +277,12 @@ def forward_decode(
         if cfg.post_norms:
             out = rms_norm(out, lp["ln2_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
         x = x + out
-        return x, (layer_k, layer_v)
+        new_layers.append((layer_k, layer_v))
 
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_fn, x, (params["layers"], cache.k, cache.v, windows)
-    )
     x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps, cfg.rms_offset)
     logits = _unembed(cfg, params, x)[:, 0]  # [B, V]
     new_lengths = jnp.where(active, cache.lengths + 1, cache.lengths)
-    new_cache = KVCache(k=new_k, v=new_v, lengths=new_lengths)
-    del B
-    return logits, new_cache
+    return logits, KVCache(layers=tuple(new_layers), lengths=new_lengths)
 
 
 # --------------------------------------------------------------------- #

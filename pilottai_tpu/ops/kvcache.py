@@ -1,14 +1,26 @@
 """Slot-based KV cache for continuous batching.
 
-Shapes are static (jit-stable): ``k``/``v`` are [L, B, S, K, H] where B is
-the number of serving *slots* and S the max context. Each slot holds one
-in-flight sequence; ``lengths[b]`` is how many cache entries are valid.
-Admission/eviction happen on the host between device steps (the batcher);
-the device only ever sees full, fixed-shape arrays — no dynamic shapes, no
-recompiles.
+Layout: one ``(k, v)`` pair per layer, each ``[B, K, S, H]`` — B serving
+*slots*, K kv-heads, S max context, H head dim. Two deliberate choices:
 
-New TPU-native surface (the reference has no KV anything); the paged
-variant for long ragged contexts lives in ``pilottai_tpu/ops/pallas``.
+* **K-major panels.** Each (slot, kv-head) owns a contiguous ``[S, H]``
+  region, so the decode-attention kernel's S-reduction streams HBM
+  sequentially instead of striding across heads (the transposed layout
+  measured ~5x slower cache reads on v5e).
+* **Per-layer arrays, not one stacked ``[L, ...]``.** The decode chunk
+  unrolls layers and feeds each layer's panels to a Pallas call; separate
+  arrays mean the operands are the buffers themselves — a stacked array
+  would force a per-layer dynamic-slice copy of the whole layer cache in
+  front of every custom call.
+
+Shapes are static (jit-stable). ``lengths[b]`` counts valid entries; the
+stale bytes past it are masked at attention time, so freeing a slot is a
+single scalar write. Admission/eviction happen on the host between device
+chunks; the device only ever sees full, fixed-shape arrays.
+
+New TPU-native surface (the reference has no KV anything). A paged
+(block-table) variant for long ragged contexts is planned but NOT yet
+implemented; this dense cache is the only one in-tree.
 """
 
 from __future__ import annotations
@@ -20,21 +32,28 @@ import jax.numpy as jnp
 
 
 class KVCache(NamedTuple):
-    k: jax.Array        # [L, B, S, K, H]
-    v: jax.Array        # [L, B, S, K, H]
-    lengths: jax.Array  # [B] int32 — valid entries per slot
+    layers: Tuple[Tuple[jax.Array, jax.Array], ...]  # per-layer (k, v) [B, K, S, H]
+    lengths: jax.Array                               # [B] int32 — valid entries
 
     @property
     def n_layers(self) -> int:
-        return self.k.shape[0]
+        return len(self.layers)
 
     @property
     def n_slots(self) -> int:
-        return self.k.shape[1]
+        return self.layers[0][0].shape[0]
 
     @property
     def max_len(self) -> int:
-        return self.k.shape[2]
+        return self.layers[0][0].shape[2]
+
+    @property
+    def n_kv_heads(self) -> int:
+        return self.layers[0][0].shape[1]
+
+    @property
+    def head_dim(self) -> int:
+        return self.layers[0][0].shape[3]
 
     @classmethod
     def create(
@@ -46,58 +65,86 @@ class KVCache(NamedTuple):
         head_dim: int,
         dtype=jnp.bfloat16,
     ) -> "KVCache":
-        shape = (n_layers, n_slots, max_len, n_kv_heads, head_dim)
-        return cls(
-            k=jnp.zeros(shape, dtype=dtype),
-            v=jnp.zeros(shape, dtype=dtype),
-            lengths=jnp.zeros((n_slots,), dtype=jnp.int32),
+        shape = (n_slots, n_kv_heads, max_len, head_dim)
+        layers = tuple(
+            (jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype))
+            for _ in range(n_layers)
         )
+        return cls(layers=layers, lengths=jnp.zeros((n_slots,), dtype=jnp.int32))
 
 
-def write_prompt(
+def write_prompts(
     cache: KVCache,
-    slot: jax.Array,      # scalar int32
-    k_new: jax.Array,     # [L, T, K, H] — prompt K for every layer
-    v_new: jax.Array,     # [L, T, K, H]
-    length: jax.Array,    # scalar int32 — true (unpadded) prompt length
+    slots: jax.Array,      # [A] int32 — target slot per admitted prompt
+    ks: jax.Array,         # [L, A, T, K, H] — prefill K for every layer
+    vs: jax.Array,         # [L, A, T, K, H]
+    lengths: jax.Array,    # [A] int32 — true (unpadded) prompt lengths;
+                           # <= 0 marks a padding row (dropped)
 ) -> KVCache:
-    """Insert a freshly prefilled prompt into ``slot`` (host-driven admission).
+    """Insert a batch of freshly prefilled prompts (host-driven admission).
 
-    T may be padded; entries beyond ``length`` are zeros and masked out at
-    attention time via ``lengths``.
+    T may be padded; entries beyond ``lengths[a]`` are zeros and masked out
+    at attention time. Padding rows (``lengths[a] <= 0``) are routed to an
+    out-of-bounds slot index so XLA scatter semantics drop them.
     """
-    T = k_new.shape[1]
-    k = jax.lax.dynamic_update_slice(
-        cache.k, k_new[:, None], (0, slot, 0, 0, 0)
-    )
-    v = jax.lax.dynamic_update_slice(
-        cache.v, v_new[:, None], (0, slot, 0, 0, 0)
-    )
-    del T
-    lengths = cache.lengths.at[slot].set(length)
-    return KVCache(k=k, v=v, lengths=lengths)
+    A = ks.shape[1]
+    # dynamic_update_slice (not scatter): XLA aliases it in place on the
+    # donated cache, where an advanced-index scatter measured a full-cache
+    # copy per admission. dus clamps out-of-range starts instead of
+    # dropping, so padding rows are routed to the *first* row's slot and
+    # written before it (reversed order) — row 0 is always a live request,
+    # and its later write overwrites the padding garbage.
+    safe_slots = jnp.where(lengths > 0, slots, slots[0])
+    new_layers = []
+    for layer_idx, (k, v) in enumerate(cache.layers):
+        # [A, T, K, H] -> [A, K, T, H] to match the K-major panels.
+        k_new = jnp.swapaxes(ks[layer_idx], 1, 2)
+        v_new = jnp.swapaxes(vs[layer_idx], 1, 2)
+        for a in reversed(range(A)):
+            start = (safe_slots[a], 0, 0, 0)
+            k = jax.lax.dynamic_update_slice(k, k_new[a][None], start)
+            v = jax.lax.dynamic_update_slice(v, v_new[a][None], start)
+        new_layers.append((k, v))
+    new_lengths = cache.lengths
+    for a in reversed(range(A)):
+        new_lengths = jax.lax.dynamic_update_slice(
+            new_lengths, jnp.maximum(lengths[a], 0)[None], (safe_slots[a],)
+        )
+    return KVCache(layers=tuple(new_layers), lengths=new_lengths)
 
 
-def append_token(
-    layer_k: jax.Array,   # [B, S, K, H] one layer's cache
-    layer_v: jax.Array,
-    k_new: jax.Array,     # [B, 1, K, H]
-    v_new: jax.Array,
-    positions: jax.Array,  # [B] int32 — write index per slot (= current length)
-) -> Tuple[jax.Array, jax.Array]:
-    """Scatter one decode step's K/V into each slot at its own position.
+def write_chunk_rows(
+    cache: KVCache,
+    ring_ks,               # list per layer: [B, K, n, H] chunk ring
+    ring_vs,
+    start: jax.Array,      # [B] int32 — slot length at chunk start
+    accepted: jax.Array,   # [B] int32 — rows actually generated this chunk
+) -> KVCache:
+    """Scatter one decode chunk's ring buffers into the big cache.
 
-    Uses one-hot matmul-free scatter via ``at[...]`` with batched indices —
-    lowers to an efficient dynamic-update on TPU.
+    Row j of slot b lands at position start[b] + j when j < accepted[b];
+    rejected rows (beyond EOS/budget) are routed past S and dropped.
     """
-    B = layer_k.shape[0]
-    batch_idx = jnp.arange(B)
-    k = layer_k.at[batch_idx, positions].set(k_new[:, 0])
-    v = layer_v.at[batch_idx, positions].set(v_new[:, 0])
-    return k, v
+    B = cache.n_slots
+    S = cache.max_len
+    n = ring_ks[0].shape[2]
+    j = jnp.arange(n)[None, :]                               # [1, n]
+    pos = jnp.where(j < accepted[:, None], start[:, None] + j, S)  # [B, n]
+    bidx = jnp.arange(B)[:, None]
+    new_layers = []
+    for (k, v), rk, rv in zip(cache.layers, ring_ks, ring_vs):
+        # Advanced indices (bidx, pos) broadcast to [B, n]; the kv-head
+        # slice rides along -> update values [B, n, K, H].
+        k = k.at[bidx, :, pos].set(rk.transpose(0, 2, 1, 3), mode="drop")
+        v = v.at[bidx, :, pos].set(rv.transpose(0, 2, 1, 3), mode="drop")
+        new_layers.append((k, v))
+    new_lengths = jnp.minimum(cache.lengths + accepted, S)
+    return KVCache(layers=tuple(new_layers), lengths=new_lengths)
 
 
-def free_slot(cache: KVCache, slot: jax.Array) -> KVCache:
-    """Mark a slot empty (host calls when a sequence finishes). The stale
-    K/V bytes stay — masked out by lengths — so no device writes needed."""
-    return cache._replace(lengths=cache.lengths.at[slot].set(0))
+def free_slots(cache: KVCache, slots: jax.Array) -> KVCache:
+    """Mark slots empty (host calls when sequences finish). The stale K/V
+    bytes stay — masked out by lengths — so no panel writes needed."""
+    return cache._replace(
+        lengths=cache.lengths.at[slots].set(0, mode="drop")
+    )

@@ -10,7 +10,7 @@ from pilottai_tpu.models.gemma import GEMMA_TINY
 from pilottai_tpu.models.llama import LLAMA_TINY
 from pilottai_tpu.models.registry import get_model_config, list_models
 from pilottai_tpu.models.transformer import forward_decode, forward_prefill
-from pilottai_tpu.ops.kvcache import KVCache, write_prompt
+from pilottai_tpu.ops.kvcache import KVCache, write_prompts
 from pilottai_tpu.engine.sampling import SamplingState, sample_tokens, update_slot
 
 
@@ -33,7 +33,9 @@ def _prefill_then_decode_logits(cfg, tokens_list):
     )
     cache = KVCache.create(cfg.n_layers, 2, T, cfg.n_kv_heads, cfg.head_dim,
                            dtype=jnp.float32)
-    cache = write_prompt(cache, jnp.int32(0), ks[:, 0], vs[:, 0], jnp.int32(half))
+    cache = write_prompts(
+        cache, jnp.asarray([0]), ks[:, :1], vs[:, :1], jnp.asarray([half])
+    )
 
     active = jnp.asarray([True, False])
     decode_logits = []
