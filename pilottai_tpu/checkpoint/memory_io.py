@@ -9,6 +9,7 @@ buffer, so restore never re-embeds 10k items through the encoder
 from __future__ import annotations
 
 import json
+import logging
 from pathlib import Path
 from typing import Any, Dict
 
@@ -26,7 +27,18 @@ async def save_memory(memory: Any, directory: str | Path) -> None:
     arrays = state.pop("vector_arrays", None)
 
     tmp = directory / (MEMORY_JSON + ".tmp")
-    tmp.write_text(json.dumps(state, default=str), encoding="utf-8")
+    try:
+        doc = json.dumps(state)
+    except TypeError:
+        # Mirror TaskJournal._write: the lossy fallback must be loud —
+        # stringified payloads come back as strings after restore.
+        logging.getLogger("pilottai_tpu.checkpoint.memory_io").warning(
+            "memory snapshot has non-JSON-serializable payloads; they are "
+            "stored as strings and will NOT restore intact — keep "
+            "MemoryItem.data/interactions JSON-safe"
+        )
+        doc = json.dumps(state, default=str)
+    tmp.write_text(doc, encoding="utf-8")
     tmp.replace(directory / MEMORY_JSON)
 
     if arrays is not None:

@@ -69,9 +69,13 @@ class TrainCheckpointer:
             if step is None:
                 raise FileNotFoundError(f"no checkpoint under {self.root}")
         params_t, opt_t = template
+        item = {"params": params_t, "opt_state": opt_t}
+        # Restore onto the *template's* shardings: without restore_args,
+        # orbax populates sharding from the checkpoint file, which is
+        # unsafe when resuming on a different mesh/topology.
+        restore_args = ocp.checkpoint_utils.construct_restore_args(item)
         restored = ocp.PyTreeCheckpointer().restore(
-            _step_dir(self.root, step),
-            item={"params": params_t, "opt_state": opt_t},
+            _step_dir(self.root, step), item=item, restore_args=restore_args,
         )
         return (restored["params"], restored["opt_state"]), step
 

@@ -120,7 +120,8 @@ class ContinuousBatcher:
             import os
 
             use_pallas = (
-                bool(os.environ.get("PILOTTAI_DECODE_PALLAS"))
+                os.environ.get("PILOTTAI_DECODE_PALLAS", "").lower()
+                in ("1", "true", "yes")
                 and jax.default_backend() == "tpu"
                 and decode_shapes_ok(
                     self.max_seq_len, cfg.head_dim,
@@ -148,6 +149,7 @@ class ContinuousBatcher:
         # Slot table / gen / release / first_reads are shared between the
         # device thread (admission) and the reader thread (completion).
         self._lock = threading.Lock()
+        self._drain_queued = False  # a drain sentinel is in _results
         # Dispatched chunks awaiting host read. Bounded so the device
         # thread can't run unboundedly ahead of completions.
         self._results: "queue.Queue" = queue.Queue(maxsize=self.PIPELINE_DEPTH)
@@ -218,6 +220,11 @@ class ContinuousBatcher:
     # ------------------------------------------------------------------ #
 
     def submit(self, request: GenRequest) -> Future:
+        # An empty prompt would be indistinguishable from an admission
+        # padding row (lens <= 0 => dropped) and hang; decode from a single
+        # pad token instead.
+        if not request.prompt_ids:
+            request.prompt_ids = [0]
         # Leave room for at least one generated token; clamp the keep window
         # so it can never be <= 0 (a negative-zero slice would keep the whole
         # oversized prompt and crash the prefill copy).
@@ -372,10 +379,14 @@ class ContinuousBatcher:
                 slot.generated.append(int(host[row]))
                 self._check_finished(idx)
 
-    def _drain_first_reads_now(self) -> None:
-        """Device thread: fold pending first tokens without waiting for a
-        chunk read — the only completion path for max_new_tokens <= 1
-        requests, whose zero decode budget never dispatches a chunk."""
+    def _drain_first_reads(self) -> None:
+        """Reader thread ONLY: fold pending first tokens outside a chunk
+        read — the completion path for max_new_tokens <= 1 requests, whose
+        zero decode budget never dispatches a chunk. Running this on the
+        device thread raced the reader's chunk processing (the reader would
+        see first_pending still True mid-drain and silently drop the
+        chunk's tokens), so the device thread requests it via a sentinel in
+        the results queue instead."""
         with self._lock:
             groups = list(self._first_reads)
             self._first_reads.clear()
@@ -488,6 +499,12 @@ class ContinuousBatcher:
                     break
                 continue
             try:
+                if item is None:  # drain-first-tokens sentinel
+                    with self._lock:
+                        self._drain_queued = False
+                    self._drain_first_reads()
+                    self._wake.set()
+                    continue
                 self._process_chunk(*item)
             except Exception as exc:  # noqa: BLE001 — reader boundary
                 # The chunk's tokens are lost on the host while the device
@@ -534,7 +551,14 @@ class ContinuousBatcher:
                         except queue.Full:
                             continue
                 else:
-                    self._drain_first_reads_now()
+                    with self._lock:
+                        need_drain = (
+                            bool(self._first_reads) and not self._drain_queued
+                        )
+                        if need_drain:
+                            self._drain_queued = True
+                    if need_drain:
+                        self._results.put(None)  # reader folds, in order
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
             except Exception as exc:  # noqa: BLE001 — device loop boundary

@@ -132,8 +132,18 @@ class _VectorStore:
     def import_arrays(self, arrays: Dict[str, np.ndarray]) -> None:
         import jax.numpy as jnp
 
-        self._vectors = jnp.asarray(arrays["vectors"], jnp.float32)
+        vectors = np.asarray(arrays["vectors"], np.float32)
+        # The snapshot's geometry wins: keeping the constructor-time
+        # capacity/dim would corrupt add()'s ring indexing (and search's k
+        # bound) when restoring a snapshot saved under a different config.
+        self.capacity, self.dim = vectors.shape
+        self._vectors = jnp.asarray(vectors)
         self._row_ids = np.asarray(arrays["row_ids"], np.int64).copy()
+        if len(self._row_ids) != self.capacity:
+            raise ValueError(
+                f"vector snapshot is inconsistent: {self.capacity} rows vs "
+                f"{len(self._row_ids)} row ids"
+            )
         self._next_row = int(arrays["next_row"][0])
         self._id_to_row = {
             int(eid): row for row, eid in enumerate(self._row_ids) if eid >= 0
@@ -463,6 +473,15 @@ class EnhancedMemory:
             }
             arrays = state.get("vector_arrays")
             if arrays is not None and self.embedder is not None:
+                dim = int(np.asarray(arrays["vectors"]).shape[1])
+                if dim != self.embedder.dim:
+                    # Silently scoring queries against foreign embeddings
+                    # would make every search wrong; fail loudly instead.
+                    raise ValueError(
+                        f"memory snapshot embedding dim {dim} != attached "
+                        f"embedder dim {self.embedder.dim}; restore with a "
+                        "matching embedder or drop the vector snapshot"
+                    )
                 self._vectors = _VectorStore(self.capacity, self.embedder.dim)
                 self._vectors.import_arrays(arrays)
             else:

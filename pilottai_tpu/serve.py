@@ -309,6 +309,13 @@ class Serve:
             bg.cancel()
         await asyncio.gather(*self._bg_tasks, return_exceptions=True)
         self._bg_tasks = []
+        # Settle in-flight executions before the journal closes: a task
+        # finishing after close would hit record_status on a closed journal
+        # inside _finalize and strand its waiter.
+        inflight = list(self._inflight)
+        for t in inflight:
+            t.cancel()
+        await asyncio.gather(*inflight, return_exceptions=True)
         for agent in self.agents.values():
             await agent.stop()
         if self.manager_llm is not None:
@@ -460,10 +467,18 @@ class Serve:
     async def wait_for(self, task_id: str, timeout: Optional[float] = None) -> TaskResult:
         # Already-terminal tasks (e.g. recovered from the journal in a
         # finished state) resolve immediately — no _finalize will ever fire
-        # for them in this process.
+        # for them in this process. CANCELLED/evicted tasks are journaled
+        # with result=null: synthesize a result rather than hanging on a
+        # waiter that can never fire.
         task = self.all_tasks.get(task_id)
-        if task is not None and task.status.is_terminal and task.result is not None:
-            return task.result
+        if task is not None and task.status.is_terminal:
+            if task.result is not None:
+                return task.result
+            return TaskResult(
+                success=False,
+                error=f"task {task_id} recovered in terminal state "
+                      f"{task.status.value} with no recorded result",
+            )
         future = self._waiters.setdefault(
             task_id, asyncio.get_running_loop().create_future()
         )

@@ -75,6 +75,36 @@ def test_single_token_request_completes():
         batcher.stop()
 
 
+def test_short_generation_one_chunk_boundary():
+    # max_new just past one chunk (review finding: a first-token drain on
+    # the device thread could race the reader and drop a chunk's tokens,
+    # hanging the request). Folding is now serialized on the reader.
+    batcher, _ = _tiny_batcher(max_seq=64, n_slots=2)
+    batcher.chunk_size = 8
+    batcher.start()
+    try:
+        for _ in range(3):
+            req = GenRequest(prompt_ids=[3, 4, 5], max_new_tokens=9)
+            out = batcher.submit(req).result(timeout=60)
+            assert len(out) <= 9
+    finally:
+        batcher.stop()
+
+
+def test_empty_prompt_completes():
+    # Review finding: an empty prompt looked like an admission padding row
+    # and hung forever; it now decodes from a pad token.
+    batcher, _ = _tiny_batcher(max_seq=64, n_slots=2)
+    batcher.start()
+    try:
+        out = batcher.submit(
+            GenRequest(prompt_ids=[], max_new_tokens=4)
+        ).result(timeout=60)
+        assert 1 <= len(out) <= 4
+    finally:
+        batcher.stop()
+
+
 def test_cancelled_request_frees_slot():
     batcher, _ = _tiny_batcher(max_seq=64, n_slots=1)
     batcher.start()

@@ -84,3 +84,115 @@ def test_setup_logging_explicit_config_wins_after_autoconfig(tmp_path):
     file_handlers = [h for h in root.handlers if isinstance(h, stdlog.FileHandler)]
     assert file_handlers, "explicit setup_logging must attach file handlers"
     plog.setup_logging(LogConfig())  # restore console-only for other tests
+
+
+@pytest.mark.asyncio
+async def test_stop_settles_inflight_before_journal_close(tmp_path):
+    """Advisor: a task finishing after stop() used to hit record_status on
+    a closed journal inside _finalize; stop must settle in-flight work
+    first."""
+    from pilottai_tpu.core.agent import BaseAgent
+    from pilottai_tpu.core.config import ServeConfig
+    from pilottai_tpu.engine.handler import LLMHandler
+    from pilottai_tpu.engine.mock import MockBackend
+    from pilottai_tpu.serve import Serve
+
+    backend = MockBackend(latency=0.5)  # slow agent steps
+    agent = BaseAgent(
+        config=AgentConfig(role="processor"),
+        llm=LLMHandler(LLMConfig(provider="mock"), backend=backend),
+    )
+    serve = Serve(
+        name="t", agents=[agent],
+        manager_llm=LLMHandler(LLMConfig(provider="mock"), backend=MockBackend()),
+        config=ServeConfig(
+            journal_path=str(tmp_path / "j.jsonl"), decomposition_enabled=False,
+        ),
+    )
+    await serve.start()
+    await serve.add_task("slow task mid-flight at stop")
+    await asyncio.sleep(0.2)  # execution underway
+    await serve.stop()  # must not raise / log journal-closed errors
+    assert serve.journal is not None
+
+
+@pytest.mark.asyncio
+async def test_wait_for_recovered_cancelled_task_returns_immediately(tmp_path):
+    """Advisor: wait_for on a journal-recovered CANCELLED task (result
+    null) used to hang until timeout."""
+    from pilottai_tpu.checkpoint.journal import TaskJournal
+    from pilottai_tpu.core.config import ServeConfig
+    from pilottai_tpu.core.task import TaskStatus
+    from pilottai_tpu.engine.handler import LLMHandler
+    from pilottai_tpu.engine.mock import MockBackend
+    from pilottai_tpu.serve import Serve
+
+    path = str(tmp_path / "j.jsonl")
+    journal = TaskJournal(path)
+    t = Task(description="evicted")
+    t.status = TaskStatus.CANCELLED
+    journal.record_task(t)
+    journal.record_status(t)
+    journal.close()
+
+    serve = Serve(
+        name="t",
+        manager_llm=LLMHandler(LLMConfig(provider="mock"), backend=MockBackend()),
+        config=ServeConfig(journal_path=path, decomposition_enabled=False),
+    )
+    await serve.recover()
+    result = await asyncio.wait_for(serve.wait_for(t.id), timeout=2)
+    assert not result.success
+    assert "cancelled" in (result.error or "").lower() or "CANCELLED" in (result.error or "")
+
+
+def test_vector_store_import_adopts_snapshot_geometry():
+    """Advisor: restoring a snapshot saved with a different capacity used
+    to leave stale capacity/dim and corrupt ring indexing."""
+    import numpy as np
+
+    from pilottai_tpu.memory.semantic import _VectorStore
+
+    src = _VectorStore(capacity=4, dim=8)
+    for i in range(3):
+        v = np.zeros(8, np.float32)
+        v[i] = 1.0
+        src.add(i, v)
+    snap = src.export_arrays()
+
+    dst = _VectorStore(capacity=16, dim=32)  # different config
+    dst.import_arrays(snap)
+    assert dst.capacity == 4 and dst.dim == 8
+    # add() must wrap at the snapshot capacity, not the constructor's.
+    for i in range(3, 9):
+        v = np.zeros(8, np.float32)
+        v[i % 8] = 1.0
+        dst.add(i, v)
+    hits = dst.search(np.eye(8, dtype=np.float32)[5 % 8], k=2)
+    assert hits and all(eid < 9 for eid, _ in hits)
+
+
+@pytest.mark.asyncio
+async def test_memory_import_rejects_dim_mismatch():
+    import numpy as np
+
+    from pilottai_tpu.memory.semantic import EnhancedMemory
+
+    class FakeEmbedder:
+        dim = 8
+
+        async def encode(self, texts):
+            return np.ones((len(texts), 8), np.float32)
+
+    mem = EnhancedMemory(embedder=FakeEmbedder())
+    state = {
+        "items": [], "order": [], "next_id": 0, "task_history": {},
+        "interactions": [], "patterns": [],
+        "vector_arrays": {
+            "vectors": np.zeros((4, 16), np.float32),  # dim 16 != 8
+            "row_ids": np.full((4,), -1, np.int64),
+            "next_row": np.asarray([0]),
+        },
+    }
+    with pytest.raises(ValueError, match="dim"):
+        await mem.import_state(state)
