@@ -1,0 +1,47 @@
+"""Run the document pipeline demo.
+
+    python examples/document_pipeline/main.py [--provider mock|cpu|tpu]
+                                              [--embedder] [path] [question]
+
+Reference counterpart: ``docs/examples/pdf_processing/main.py:79``
+(``process_pdf``) — the only end-to-end workload the reference ships.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from examples.document_pipeline.pipeline import SAMPLE_DOC, run_pipeline  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("path", nargs="?", default=str(SAMPLE_DOC))
+    ap.add_argument(
+        "question", nargs="?",
+        default="What are the key findings and the main risk?",
+    )
+    ap.add_argument("--provider", default="mock", choices=["mock", "cpu", "tpu"])
+    ap.add_argument(
+        "--embedder", action="store_true",
+        help="attach the on-device embedding encoder to semantic memory",
+    )
+    args = ap.parse_args()
+
+    out = asyncio.run(
+        run_pipeline(
+            path=args.path, question=args.question,
+            provider=args.provider, use_embedder=args.embedder,
+        )
+    )
+    print(json.dumps(out, indent=2, default=str))
+
+
+if __name__ == "__main__":
+    main()

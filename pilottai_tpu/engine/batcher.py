@@ -104,6 +104,7 @@ class ContinuousBatcher:
         chunk_size: int = 16,
         admit_batch: int = 8,
         use_pallas: Optional[bool] = None,
+        on_tpu: Optional[bool] = None,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -112,6 +113,13 @@ class ContinuousBatcher:
         self.min_bucket = min_bucket
         self.chunk_size = chunk_size
         self.admit_batch = min(admit_batch, n_slots)
+        # Whether this batcher's computations actually run on a TPU (the
+        # cpu provider can run on a machine whose default backend IS a
+        # TPU, so the process-level check is not enough for the Pallas
+        # prefill/decode kernels).
+        if on_tpu is None:
+            on_tpu = jax.default_backend() == "tpu"
+        self.on_tpu = on_tpu
         if use_pallas is None:
             # Measured on v5e: with the cache read-only inside the chunk
             # scan, XLA's dense attention beats the Pallas prefix kernel at
@@ -122,7 +130,7 @@ class ContinuousBatcher:
             use_pallas = (
                 os.environ.get("PILOTTAI_DECODE_PALLAS", "").lower()
                 in ("1", "true", "yes")
-                and jax.default_backend() == "tpu"
+                and self.on_tpu
                 and decode_shapes_ok(
                     self.max_seq_len, cfg.head_dim,
                     jnp.dtype(cache_dtype).itemsize,
@@ -332,7 +340,7 @@ class ContinuousBatcher:
         with global_metrics.timer("engine.prefill_latency"):
             logits, ks, vs = forward_prefill(
                 self.params, self.cfg, jnp.asarray(tokens),
-                jnp.asarray(positions), lens_j,
+                jnp.asarray(positions), lens_j, use_flash=self.on_tpu,
             )
         self.cache = self._write_prompts(self.cache, slots_j, ks, vs, lens_j)
         self.sampling = admit_sampling(

@@ -113,6 +113,7 @@ class NativeEngine(LLMBackend):
             max_seq_len=max_seq,
             cache_dtype=self.model_cfg.dtype,
             chunk_size=self.config.engine_chunk,
+            on_tpu=(self.platform != "cpu" and devices[0].platform == "tpu"),
         )
         self.batcher.start()
         self.batcher.warmup()

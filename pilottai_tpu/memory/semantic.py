@@ -129,6 +129,14 @@ class _VectorStore:
             "next_row": np.asarray([self._next_row]),
         }
 
+    @classmethod
+    def from_arrays(cls, arrays: Dict[str, np.ndarray]) -> "_VectorStore":
+        """Build directly from a snapshot — skips the constructor's zeros
+        allocation that import_arrays would immediately discard."""
+        store = cls.__new__(cls)
+        store.import_arrays(arrays)
+        return store
+
     def import_arrays(self, arrays: Dict[str, np.ndarray]) -> None:
         import jax.numpy as jnp
 
@@ -482,8 +490,7 @@ class EnhancedMemory:
                         f"embedder dim {self.embedder.dim}; restore with a "
                         "matching embedder or drop the vector snapshot"
                     )
-                self._vectors = _VectorStore(self.capacity, self.embedder.dim)
-                self._vectors.import_arrays(arrays)
+                self._vectors = _VectorStore.from_arrays(arrays)
             else:
                 # Never keep a pre-import buffer: its rows map old embeddings
                 # onto the restored entry ids.

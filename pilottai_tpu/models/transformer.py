@@ -108,6 +108,7 @@ def _full_seq_block(
     positions: Optional[jax.Array] = None,  # [B, T]; enables flash dispatch
     valid: Optional[jax.Array] = None,      # [B]
     ring_mesh: Any = None,                  # Mesh → ring attention over 'seq'
+    allow_flash: bool = True,               # False when running off-TPU
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One transformer block over a full sequence (shared by prefill and
     the training forward). Returns (x, k, v)."""
@@ -128,6 +129,7 @@ def _full_seq_block(
     elif (
         positions is not None
         and valid is not None
+        and allow_flash
         and flash_enabled()
         and flash_shapes_ok(T, T, head_dim=cfg.head_dim, itemsize=q.dtype.itemsize)
         and len(jax.devices()) == 1
@@ -163,13 +165,17 @@ def _full_seq_block(
 # Prefill
 # --------------------------------------------------------------------- #
 
-@partial(jax.jit, static_argnames=("cfg",))
+@partial(jax.jit, static_argnames=("cfg", "use_flash"))
 def forward_prefill(
     params: Dict[str, Any],
     cfg: ModelConfig,
     tokens: jax.Array,      # [B, T] (right-padded)
     positions: jax.Array,   # [B, T] absolute positions (pad slots arbitrary)
     valid: jax.Array,       # [B] true prompt lengths
+    use_flash: bool = True,  # callers running off-TPU (e.g. the cpu
+                             # provider on a machine whose DEFAULT backend
+                             # is a TPU) must pass False — flash_enabled()
+                             # only sees the default backend
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Full-prompt forward. Returns (logits [B, T, V] fp32, k, v) where
     k/v are [L, B, T, K, H] ready to insert into a KVCache."""
@@ -194,7 +200,7 @@ def forward_prefill(
         lp, window = scanned
         x, k, v, _ = _full_seq_block(
             cfg, qscale, x, lp, window, sin, cos, ipos, jpos, base_mask,
-            positions=positions, valid=valid,
+            positions=positions, valid=valid, allow_flash=use_flash,
         )
         return x, (k, v)
 

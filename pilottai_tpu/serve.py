@@ -316,6 +316,18 @@ class Serve:
         for t in inflight:
             t.cancel()
         await asyncio.gather(*inflight, return_exceptions=True)
+        # Cancellation skips _finalize (CancelledError is a BaseException),
+        # so journal the interruption and resolve outstanding waiters —
+        # a wait_for with no timeout must not hang across stop().
+        for task in list(self.running_tasks.values()):
+            if not task.status.is_terminal:
+                task.status = TaskStatus.CANCELLED
+                if self.journal is not None:
+                    self.journal.record_status(task)
+        stopped = TaskResult(success=False, error="serve stopped")
+        for waiter in self._waiters.values():
+            if not waiter.done():
+                waiter.set_result(stopped)
         for agent in self.agents.values():
             await agent.stop()
         if self.manager_llm is not None:

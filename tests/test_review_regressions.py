@@ -196,3 +196,33 @@ async def test_memory_import_rejects_dim_mismatch():
     }
     with pytest.raises(ValueError, match="dim"):
         await mem.import_state(state)
+
+
+@pytest.mark.asyncio
+async def test_stop_resolves_untimed_waiters(tmp_path):
+    """Review finding: stop() cancelled in-flight tasks without finalizing,
+    stranding a wait_for with no timeout forever."""
+    from pilottai_tpu.core.agent import BaseAgent
+    from pilottai_tpu.core.config import ServeConfig
+    from pilottai_tpu.engine.handler import LLMHandler
+    from pilottai_tpu.engine.mock import MockBackend
+    from pilottai_tpu.serve import Serve
+
+    agent = BaseAgent(
+        config=AgentConfig(role="processor"),
+        llm=LLMHandler(LLMConfig(provider="mock"), backend=MockBackend(latency=5.0)),
+    )
+    serve = Serve(
+        name="t", agents=[agent],
+        manager_llm=LLMHandler(LLMConfig(provider="mock"), backend=MockBackend()),
+        config=ServeConfig(
+            journal_path=str(tmp_path / "j.jsonl"), decomposition_enabled=False,
+        ),
+    )
+    await serve.start()
+    task = await serve.add_task("very slow work")
+    waiter = asyncio.ensure_future(serve.wait_for(task.id, timeout=120))
+    await asyncio.sleep(0.2)
+    await serve.stop()
+    result = await asyncio.wait_for(waiter, timeout=2)
+    assert not result.success and "stopped" in (result.error or "")
