@@ -411,12 +411,15 @@ class BaseAgent:
         )
 
     async def _ask(self, prompt: str, tools: Optional[List[Dict[str, Any]]] = None) -> Dict[str, Any]:
+        # Every rules.yaml prompt demands strict JSON: constrained decoding
+        # makes the reply well-formed by construction on in-tree engines.
         response = await self.llm.generate_response(
             [
                 {"role": "system", "content": self.system_prompt()},
                 {"role": "user", "content": prompt},
             ],
             tools=tools,
+            json_mode=True,
         )
         self.conversation_history.append(
             {"prompt_tail": prompt[-200:], "response": response.content[:500]}
@@ -573,7 +576,9 @@ class BaseAgent:
             state=str(state),
         )
         data = extract_json(
-            (await self.llm.generate_response([{"role": "user", "content": prompt}])).content
+            (await self.llm.generate_response(
+                [{"role": "user", "content": prompt}], json_mode=True
+            )).content
         ) or {}
         return {
             "strategy": data.get("strategy", "parallel"),
@@ -594,7 +599,9 @@ class BaseAgent:
             ),
         )
         data = extract_json(
-            (await self.llm.generate_response([{"role": "user", "content": prompt}])).content
+            (await self.llm.generate_response(
+                [{"role": "user", "content": prompt}], json_mode=True
+            )).content
         ) or {}
         chosen = data.get("agent_id", "")
         for agent in pool:

@@ -65,6 +65,9 @@ class GenRequest:
     top_p: float = 1.0
     seed: int = 0
     eos_id: int = -1
+    # Grammar-constrained JSON decoding (byte tokenizers only; the engine
+    # gates it — engine/json_mask.py).
+    json_mode: bool = False
     stop_ids: List[int] = field(default_factory=list)
     future: Future = field(default_factory=Future)
     submitted_at: float = field(default_factory=time.perf_counter)
@@ -322,6 +325,7 @@ class ContinuousBatcher:
         seeds = np.zeros((A,), np.int32)
         eos = np.full((A,), -1, np.int32)
         budgets = np.zeros((A,), np.int32)
+        jsonm = np.zeros((A,), bool)
         for row, (idx, req) in enumerate(group):
             ids = req.prompt_ids
             tokens[row, : len(ids)] = ids
@@ -332,6 +336,7 @@ class ContinuousBatcher:
             topps[row] = req.top_p
             seeds[row] = req.seed
             eos[row] = req.eos_id
+            jsonm[row] = req.json_mode
             budgets[row] = req.max_new_tokens - 1
 
         positions = np.broadcast_to(np.arange(T, dtype=np.int32)[None], (A, T))
@@ -346,9 +351,11 @@ class ContinuousBatcher:
         self.sampling = admit_sampling(
             self.sampling, slots_j, jnp.asarray(temps), jnp.asarray(topks),
             jnp.asarray(topps), jnp.asarray(seeds), jnp.asarray(eos),
+            jnp.asarray(jsonm),
         )
         first, self.sampling = sample_prefill_tokens(
-            logits, lens_j, slots_j, self.sampling
+            logits, lens_j, slots_j, self.sampling,
+            remaining=jnp.asarray(budgets) + 1,  # total incl. this token
         )
         self.dstate = admit_decode(
             self.dstate, slots_j, first, jnp.asarray(budgets),

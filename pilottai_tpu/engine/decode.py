@@ -24,7 +24,7 @@ the ≤500 ms p50 agent-step target (BASELINE.md).
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -258,7 +258,7 @@ def decode_chunk(
         h = rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps, cfg.rms_offset)
         logits = _unembed(cfg, params, h)[:, 0]           # [B, V] fp32
 
-        sampled, sampling = sample_core(logits, sampling)
+        sampled, sampling = sample_core(logits, sampling, json_remaining=budget)
         new_budget = budget - active.astype(jnp.int32)
         hit_eos = (sampling.eos_id >= 0) & (sampled == sampling.eos_id)
         ctx_full = (pos + 1) >= (S - 1)
@@ -292,6 +292,7 @@ def sample_prefill_tokens(
     valid: jax.Array,     # [A] prompt lengths (last logit at valid-1)
     slots: jax.Array,     # [A] slot each prompt was admitted into
     sampling: SamplingState,
+    remaining: Optional[jax.Array] = None,  # [A] total generation budget
 ) -> Tuple[jax.Array, SamplingState]:
     """Sample each admitted prompt's first generated token on device,
     using (and advancing) the slot's sampling params — host-side sampling
@@ -300,15 +301,15 @@ def sample_prefill_tokens(
     last = jnp.take_along_axis(
         logits, jnp.maximum(valid - 1, 0)[:, None, None], axis=1
     )[:, 0]                                              # [A, V]
-    sub = SamplingState(
-        temperature=sampling.temperature[slots],
-        top_k=sampling.top_k[slots],
-        top_p=sampling.top_p[slots],
-        key=sampling.key[slots],
-        eos_id=sampling.eos_id[slots],
-    )
-    tokens, sub = sample_core(last, sub)
+    sub = jax.tree.map(lambda a: a[slots], sampling)
+    tokens, sub = sample_core(last, sub, json_remaining=remaining)
     del A
+    # Write back everything the sampler advanced: the PRNG keys and the
+    # JSON automaton coords (the first token is the automaton's first
+    # transition).
     return tokens, sampling._replace(
-        key=sampling.key.at[slots].set(sub.key, mode="drop")
+        key=sampling.key.at[slots].set(sub.key, mode="drop"),
+        json_state=sampling.json_state.at[slots].set(sub.json_state, mode="drop"),
+        json_stack=sampling.json_stack.at[slots].set(sub.json_stack, mode="drop"),
+        json_depth=sampling.json_depth.at[slots].set(sub.json_depth, mode="drop"),
     )

@@ -122,8 +122,14 @@ class LLMHandler:
         messages: Sequence[ChatMessage | Dict[str, Any] | str],
         tools: Optional[Sequence[ToolSpec | Dict[str, Any]]] = None,
         params: Optional[GenerationParams] = None,
+        json_mode: Optional[bool] = None,
     ) -> LLMResponse:
-        """Chat completion with retry/backoff (reference ``llm.py:38-66``)."""
+        """Chat completion with retry/backoff (reference ``llm.py:38-66``).
+
+        ``json_mode`` overrides the config/params flag — protocol call
+        sites (rules.yaml prompts demand strict JSON) set it True to get
+        grammar-constrained decoding on byte-tokenizer engines.
+        """
         msgs = [ChatMessage.coerce(m) for m in messages]
         specs = [
             t if isinstance(t, ToolSpec) else ToolSpec(**t) for t in (tools or [])
@@ -138,6 +144,8 @@ class LLMHandler:
                 seed=s.seed,
                 json_mode=s.json_mode,
             )
+        if json_mode is not None and json_mode != params.json_mode:
+            params = params.model_copy(update={"json_mode": json_mode})
 
         last_error: Optional[Exception] = None
         for attempt in range(self.config.retries + 1):

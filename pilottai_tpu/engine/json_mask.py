@@ -1,0 +1,319 @@
+"""Byte-level JSON grammar masking for constrained decoding.
+
+SURVEY.md §7 hard part #3: the agent protocol (prompts/rules.yaml) is
+strict JSON, so *well-formed-by-construction* output beats retry-parse
+loops. With the byte tokenizer the grammar automaton is a pushdown
+machine over single bytes: a ~30-state DFA for the token structure plus
+a container stack (one bit per nesting level — object vs array) packed
+into an int32.
+
+Everything is table-driven so the per-step device work is three gathers:
+
+* ``ALLOWED[state, top]``      -> [256] byte validity mask
+* ``NEXT[state, top, byte]``   -> next state
+* ``DDEPTH[state, top, byte]`` -> stack push(+1)/pop(-1)
+
+and the masking/advance run *inside* the jitted decode chunk
+(``engine/decode.py``) — no host round trip per token, which is the whole
+point on a ~100 ms-RTT remote-TPU link.
+
+Guarantees (for byte tokenizers): the generated prefix is always a
+prefix of a valid JSON document whose top level is an object or array;
+when the document closes, only EOS (or padding spaces) can follow.
+Strings are restricted to printable ASCII with standard single-char
+escapes (no \\uXXXX), which also guarantees valid UTF-8. Budget
+exhaustion mid-document is the one unavoidable failure mode — callers
+pick adequate ``max_new_tokens``.
+
+Subword tokenizers would need a token->bytes product construction; the
+engine falls back to unconstrained sampling + tolerant parsing there
+(``utils/json_utils.extract_json``).
+
+No reference counterpart: the reference hopes the remote API returns
+parseable JSON and retries (``pilott/pilott.py:603-639``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+# ------------------------------ states ------------------------------- #
+
+(
+    S_START,      # top level: '{' or '[' (or space)
+    S_OBJ_OPEN,   # after '{': key string or '}'
+    S_KEY,        # inside a key string
+    S_KEY_ESC,    # after '\' in a key
+    S_COLON,      # after key: ':'
+    S_VALUE,      # expecting a value (after ':' or array ',')
+    S_ARR,        # after '[': value or immediate ']'
+    S_STR,        # inside a value string
+    S_STR_ESC,    # after '\' in a value string
+    S_NUM_NEG,    # after '-': first digit
+    S_NUM_ZERO,   # after a leading 0: no more int digits (strict JSON)
+    S_NUM_INT,    # in 1-9... integer digits
+    S_NUM_DOT,    # after '.': first fraction digit
+    S_NUM_FRAC,   # fraction digits
+    S_NUM_ESGN,   # after e/E: sign or digit
+    S_NUM_EDIG,   # after exponent sign: first digit
+    S_NUM_EXP,    # exponent digits
+    S_AFTER,      # after a complete value: ',' or the container's closer
+    S_COMMA_OBJ,  # after ',' inside an object: next key string
+    S_T1, S_T2, S_T3,          # t-rue
+    S_F1, S_F2, S_F3, S_F4,    # f-alse
+    S_N1, S_N2, S_N3,          # n-ull
+    S_DONE,       # document closed: EOS (or padding space)
+) = range(30)
+
+N_STATES = 30
+MAX_DEPTH = 30  # stack bits in an int32, with headroom
+
+_DIGITS = [ord(c) for c in "0123456789"]
+_PRINTABLE = [b for b in range(0x20, 0x7F)]  # valid-UTF-8 by construction
+_ESCAPES = [ord(c) for c in '"\\/bfnrt']
+# No whitespace transitions: under arbitrary (e.g. random-weight) logits a
+# ws self-loop can dominate forever and emit nothing but spaces. Compact
+# JSON is equally valid and always makes progress. The one exception is
+# S_DONE, which pads with spaces only when the slot has no EOS token.
+_WS: list = []
+
+TOP_OBJ, TOP_ARR = 0, 1
+
+
+def _build_tables() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    allowed = np.zeros((N_STATES, 2, 256), np.bool_)
+    nxt = np.zeros((N_STATES, 2, 256), np.int8)
+    ddepth = np.zeros((N_STATES, 2, 256), np.int8)
+
+    def rule(state, byte, to, tops=(TOP_OBJ, TOP_ARR), dd=0):
+        for top in tops:
+            allowed[state, top, byte] = True
+            nxt[state, top, byte] = to
+            ddepth[state, top, byte] = dd
+
+    def ws_self(state):
+        for b in _WS:
+            rule(state, b, state)
+
+    # Value starters shared by S_VALUE and S_ARR.
+    def value_starts(state):
+        rule(state, ord('"'), S_STR)
+        rule(state, ord("{"), S_OBJ_OPEN, dd=+1)
+        rule(state, ord("["), S_ARR, dd=+1)
+        rule(state, ord("-"), S_NUM_NEG)
+        rule(state, ord("0"), S_NUM_ZERO)
+        for d in _DIGITS[1:]:
+            rule(state, d, S_NUM_INT)
+        rule(state, ord("t"), S_T1)
+        rule(state, ord("f"), S_F1)
+        rule(state, ord("n"), S_N1)
+        ws_self(state)
+
+    # A value just ended; ',' and closers depend on the container. `dd`
+    # for closers is applied before computing the post-pop state, which
+    # the advance step resolves via the depth (see json_advance).
+    def value_end(state):
+        rule(state, ord(","), S_COMMA_OBJ, tops=(TOP_OBJ,))
+        rule(state, ord(","), S_VALUE, tops=(TOP_ARR,))
+        rule(state, ord("}"), S_AFTER, tops=(TOP_OBJ,), dd=-1)
+        rule(state, ord("]"), S_AFTER, tops=(TOP_ARR,), dd=-1)
+        ws_self_to_after(state)
+
+    def ws_self_to_after(state):
+        for b in _WS:
+            rule(state, b, S_AFTER)
+
+    rule(S_START, ord("{"), S_OBJ_OPEN, dd=+1)
+    rule(S_START, ord("["), S_ARR, dd=+1)
+    ws_self(S_START)
+
+    rule(S_OBJ_OPEN, ord('"'), S_KEY)
+    rule(S_OBJ_OPEN, ord("}"), S_AFTER, dd=-1)
+    ws_self(S_OBJ_OPEN)
+
+    for b in _PRINTABLE:
+        rule(S_KEY, b, S_KEY)
+        rule(S_STR, b, S_STR)
+    rule(S_KEY, ord("\\"), S_KEY_ESC)
+    rule(S_KEY, ord('"'), S_COLON)
+    rule(S_STR, ord("\\"), S_STR_ESC)
+    rule(S_STR, ord('"'), S_AFTER)
+    for b in _ESCAPES:
+        rule(S_KEY_ESC, b, S_KEY)
+        rule(S_STR_ESC, b, S_STR)
+
+    rule(S_COLON, ord(":"), S_VALUE)
+    ws_self(S_COLON)
+
+    value_starts(S_VALUE)
+    value_starts(S_ARR)
+    rule(S_ARR, ord("]"), S_AFTER, tops=(TOP_ARR,), dd=-1)
+
+    rule(S_NUM_NEG, ord("0"), S_NUM_ZERO)
+    for d in _DIGITS[1:]:
+        rule(S_NUM_NEG, d, S_NUM_INT)
+    for st in (S_NUM_ZERO, S_NUM_INT):
+        rule(st, ord("."), S_NUM_DOT)
+        rule(st, ord("e"), S_NUM_ESGN)
+        rule(st, ord("E"), S_NUM_ESGN)
+        value_end(st)
+    for d in _DIGITS:
+        rule(S_NUM_INT, d, S_NUM_INT)
+        rule(S_NUM_DOT, d, S_NUM_FRAC)
+        rule(S_NUM_FRAC, d, S_NUM_FRAC)
+        rule(S_NUM_ESGN, d, S_NUM_EXP)
+        rule(S_NUM_EDIG, d, S_NUM_EXP)
+        rule(S_NUM_EXP, d, S_NUM_EXP)
+    rule(S_NUM_ESGN, ord("+"), S_NUM_EDIG)
+    rule(S_NUM_ESGN, ord("-"), S_NUM_EDIG)
+    rule(S_NUM_FRAC, ord("e"), S_NUM_ESGN)
+    rule(S_NUM_FRAC, ord("E"), S_NUM_ESGN)
+    value_end(S_NUM_FRAC)
+    value_end(S_NUM_EXP)  # no second exponent: e/E not re-allowed here
+
+    value_end(S_AFTER)
+    for b in _WS:
+        rule(S_AFTER, b, S_AFTER)
+
+    rule(S_COMMA_OBJ, ord('"'), S_KEY)
+    ws_self(S_COMMA_OBJ)
+
+    for chain, word in ((S_T1, "true"), (S_F1, "false"), (S_N1, "null")):
+        states = {
+            S_T1: [S_T1, S_T2, S_T3, S_AFTER],
+            S_F1: [S_F1, S_F2, S_F3, S_F4, S_AFTER],
+            S_N1: [S_N1, S_N2, S_N3, S_AFTER],
+        }[chain]
+        for i, ch in enumerate(word[1:]):
+            rule(states[i], ord(ch), states[i + 1])
+
+    for b in [ord(" ")]:
+        allowed[S_DONE, :, b] = True
+        nxt[S_DONE, :, b] = S_DONE  # harmless padding when EOS is disabled
+
+    return allowed, nxt, ddepth
+
+
+ALLOWED_NP, NEXT_NP, DDEPTH_NP = _build_tables()
+_OPENERS_NP = np.zeros((256,), np.bool_)
+_OPENERS_NP[[ord("{"), ord("[")]] = True
+
+# ---------------------- budget-aware forced closure -------------------- #
+# With degenerate logits (random weights) a self-loop state — digits, or
+# string content — can dominate until the budget runs out mid-document.
+# When the remaining budget approaches the shortest path to a closed
+# document, the mask collapses to that path's single next byte.
+#
+# FINISH_COST[state]: bytes needed to reach a closer-capable state (where
+# the current container's closer is legal). The shortest full close is
+# FINISH_COST[state] + depth closers.
+# FORCE_BYTE[state, top]: the byte that walks that shortest path.
+
+FINISH_COST_NP = np.zeros((N_STATES,), np.int32)
+FORCE_BYTE_NP = np.zeros((N_STATES, 2), np.int32)
+_CLOSER = {TOP_OBJ: ord("}"), TOP_ARR: ord("]")}
+
+
+def _init_force_tables() -> None:
+    cost = {
+        S_START: 1,         # '{' then an empty object closes
+        S_OBJ_OPEN: 0, S_ARR: 0, S_AFTER: 0,
+        S_NUM_ZERO: 0, S_NUM_INT: 0, S_NUM_FRAC: 0, S_NUM_EXP: 0,
+        S_STR: 1, S_STR_ESC: 2, S_KEY: 3, S_KEY_ESC: 4,
+        S_COLON: 2, S_VALUE: 1, S_COMMA_OBJ: 4,
+        S_NUM_NEG: 1, S_NUM_DOT: 1, S_NUM_ESGN: 1, S_NUM_EDIG: 1,
+        S_T1: 3, S_T2: 2, S_T3: 1,
+        S_F1: 4, S_F2: 3, S_F3: 2, S_F4: 1,
+        S_N1: 3, S_N2: 2, S_N3: 1,
+        S_DONE: 0,
+    }
+    force = {
+        S_START: ord("{"),
+        S_OBJ_OPEN: ord("}"), S_ARR: ord("]"),
+        S_STR: ord('"'), S_KEY: ord('"'), S_COLON: ord(":"),
+        S_STR_ESC: ord("n"), S_KEY_ESC: ord("n"),
+        S_VALUE: ord("0"), S_NUM_NEG: ord("0"), S_NUM_DOT: ord("0"),
+        S_NUM_ESGN: ord("0"), S_NUM_EDIG: ord("0"),
+        S_COMMA_OBJ: ord('"'),
+        S_T1: ord("r"), S_T2: ord("u"), S_T3: ord("e"),
+        S_F1: ord("a"), S_F2: ord("l"), S_F3: ord("s"), S_F4: ord("e"),
+        S_N1: ord("u"), S_N2: ord("l"), S_N3: ord("l"),
+        S_DONE: ord(" "),
+    }
+    for state in range(N_STATES):
+        FINISH_COST_NP[state] = cost[state]
+        for top in (TOP_OBJ, TOP_ARR):
+            # Closer-capable states emit their container's closer; others
+            # walk toward one.
+            FORCE_BYTE_NP[state, top] = force.get(state, _CLOSER[top])
+    # Sanity: every forced byte must be legal in its (reachable) state —
+    # S_OBJ_OPEN always has an object on top and S_ARR an array, so the
+    # crossed combinations never occur.
+    unreachable = {(S_OBJ_OPEN, TOP_ARR), (S_ARR, TOP_OBJ)}
+    for state in range(N_STATES):
+        for top in (TOP_OBJ, TOP_ARR):
+            if state == S_DONE or (state, top) in unreachable:
+                continue
+            b = FORCE_BYTE_NP[state, top]
+            assert ALLOWED_NP[state, top, b], (state, top, b)
+
+
+_init_force_tables()
+
+
+def json_allowed_bytes(state, stack, depth, remaining=None):
+    """[B] automaton coords -> [B, 256] allowed-byte mask (traced).
+
+    ``remaining`` (tokens of budget left, [B]) enables forced closure:
+    once it cannot cover the shortest path to a closed document plus a
+    small margin, the mask collapses to that path's next byte.
+    """
+    import jax.numpy as jnp
+
+    allowed = jnp.asarray(ALLOWED_NP)
+    openers = jnp.asarray(_OPENERS_NP)
+    top = jnp.where(depth > 0, (stack >> jnp.maximum(depth - 1, 0)) & 1, 0)
+    mask = allowed[state, top]                        # [B, 256]
+    # Depth cap: no new containers once the stack bits run out.
+    mask = jnp.where(
+        (depth >= MAX_DEPTH)[:, None] & openers[None, :], False, mask
+    )
+    if remaining is not None:
+        # Margin 5 > the worst single-step FINISH_COST jump (+4, e.g.
+        # S_AFTER --','--> S_COMMA_OBJ): while unforced, remaining - need
+        # can shrink by at most 5 per step, so the invariant
+        # remaining >= shortest-close is maintained and forcing always
+        # closes the document in time.
+        need = jnp.asarray(FINISH_COST_NP)[state] + depth + 5
+        forced = jnp.asarray(FORCE_BYTE_NP)[state, top]
+        onehot = jnp.arange(256)[None, :] == forced[:, None]
+        mask = jnp.where((remaining <= need)[:, None], onehot, mask)
+    return mask
+
+
+def json_advance(state, stack, depth, token):
+    """Advance per-slot automaton coords by one sampled token (traced).
+    Non-byte tokens (EOS/pad/bos) leave the coords unchanged."""
+    import jax.numpy as jnp
+
+    nxt = jnp.asarray(NEXT_NP)
+    dd = jnp.asarray(DDEPTH_NP)
+    byte = jnp.clip(token, 0, 255)
+    is_byte = token < 256
+    top = jnp.where(depth > 0, (stack >> jnp.maximum(depth - 1, 0)) & 1, 0)
+    ns = nxt[state, top, byte].astype(jnp.int32)
+    delta = dd[state, top, byte].astype(jnp.int32)
+
+    is_push = delta > 0
+    push_type = (byte == ord("[")).astype(jnp.int32)
+    new_stack = jnp.where(is_push, stack | (push_type << depth), stack)
+    new_depth = depth + delta
+    # A pop that empties the stack closes the document.
+    ns = jnp.where((delta < 0) & (new_depth <= 0), S_DONE, ns)
+
+    state = jnp.where(is_byte, ns, state)
+    stack = jnp.where(is_byte, new_stack, stack)
+    depth = jnp.where(is_byte, jnp.maximum(new_depth, 0), depth)
+    return state, stack, depth

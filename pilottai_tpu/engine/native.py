@@ -153,6 +153,10 @@ class NativeEngine(LLMBackend):
             top_p=params.top_p,
             seed=params.seed if params.seed is not None else 0,
             eos_id=self.tokenizer.eos_id,
+            # Grammar constraints need a byte-level vocab (the automaton is
+            # over bytes); subword tokenizers fall back to free sampling +
+            # tolerant parsing.
+            json_mode=params.json_mode and isinstance(self.tokenizer, ByteTokenizer),
         )
         future = self.batcher.submit(request)
         try:

@@ -23,6 +23,12 @@ class SamplingState(NamedTuple):
     top_p: jax.Array        # [B] fp32; 1.0 => disabled
     key: jax.Array          # [B, 2] uint32 per-slot PRNG keys
     eos_id: jax.Array       # [B] int32; -1 => disabled (device EOS detect)
+    # JSON grammar automaton coords (engine/json_mask.py); enabled per slot
+    # by GenerationParams.json_mode on byte tokenizers.
+    json_enabled: jax.Array  # [B] bool
+    json_state: jax.Array    # [B] int32
+    json_stack: jax.Array    # [B] int32 (container-type bit per level)
+    json_depth: jax.Array    # [B] int32
 
     @classmethod
     def create(cls, n_slots: int, seed: int = 0) -> "SamplingState":
@@ -33,6 +39,10 @@ class SamplingState(NamedTuple):
             top_p=jnp.ones((n_slots,), jnp.float32),
             key=keys,
             eos_id=jnp.full((n_slots,), -1, jnp.int32),
+            json_enabled=jnp.zeros((n_slots,), bool),
+            json_state=jnp.zeros((n_slots,), jnp.int32),
+            json_stack=jnp.zeros((n_slots,), jnp.int32),
+            json_depth=jnp.zeros((n_slots,), jnp.int32),
         )
 
 
@@ -60,14 +70,54 @@ def _mask_top_p(logits: jax.Array, p: jax.Array) -> jax.Array:
     return jnp.where(keep | (p[:, None] >= 1.0), logits, -jnp.inf)
 
 
+def _apply_json_mask(
+    logits: jax.Array,
+    state: SamplingState,
+    remaining: jax.Array | None = None,
+) -> jax.Array:
+    """Constrain logits of json-enabled slots to grammar-legal bytes.
+    ``remaining`` (budget left, [B]) enables forced document closure."""
+    from pilottai_tpu.engine.json_mask import S_DONE, json_allowed_bytes
+
+    B, V = logits.shape
+    byte_ok = json_allowed_bytes(
+        state.json_state, state.json_stack, state.json_depth, remaining
+    )                                                   # [B, 256]
+    full = jnp.zeros((B, V), bool).at[:, :256].set(byte_ok[:, :V])
+    # Document closed: force EOS when the slot has one (else pad spaces).
+    eos_ok = (state.json_state == S_DONE) & (state.eos_id >= 0)
+    eos_onehot = jax.nn.one_hot(
+        jnp.clip(state.eos_id, 0, V - 1), V, dtype=bool
+    )
+    full = jnp.where(eos_ok[:, None], eos_onehot, full)
+    masked = jnp.where(full, logits, -2.0**30)
+    return jnp.where(state.json_enabled[:, None], masked, logits)
+
+
+def _advance_json(state: SamplingState, tokens: jax.Array) -> SamplingState:
+    from pilottai_tpu.engine.json_mask import json_advance
+
+    ns, stack, depth = json_advance(
+        state.json_state, state.json_stack, state.json_depth, tokens
+    )
+    en = state.json_enabled
+    return state._replace(
+        json_state=jnp.where(en, ns, state.json_state),
+        json_stack=jnp.where(en, stack, state.json_stack),
+        json_depth=jnp.where(en, depth, state.json_depth),
+    )
+
+
 def sample_core(
     logits: jax.Array,  # [B, V] fp32
     state: SamplingState,
+    json_remaining: jax.Array | None = None,  # [B] budget incl. this token
 ) -> tuple[jax.Array, SamplingState]:
     """Sample one token per slot; greedy where temperature == 0.
 
     Plain function (no jit) so the decode chunk can inline it inside its
     step scan; ``sample_tokens`` is the standalone jitted wrapper."""
+    logits = _apply_json_mask(logits, state, json_remaining)
     greedy = jnp.argmax(logits, axis=-1)
 
     temp = jnp.maximum(state.temperature, 1e-6)[:, None]
@@ -82,8 +132,11 @@ def sample_core(
     step_keys, carry_keys = new_keys[:, 0], new_keys[:, 1]
     sampled = jax.vmap(sample_row)(step_keys, scaled)
 
-    tokens = jnp.where(state.temperature <= 0.0, greedy, sampled)
-    return tokens.astype(jnp.int32), state._replace(key=carry_keys)
+    tokens = jnp.where(state.temperature <= 0.0, greedy, sampled).astype(
+        jnp.int32
+    )
+    state = _advance_json(state._replace(key=carry_keys), tokens)
+    return tokens, state
 
 
 @partial(jax.jit, donate_argnames=("state",))
@@ -102,14 +155,19 @@ def update_slot(
     top_p: float,
     seed: int,
     eos_id: int = -1,
+    json_mode: bool = False,
 ) -> SamplingState:
     """Host-side admission: install one request's sampling params."""
-    return SamplingState(
+    return state._replace(
         temperature=state.temperature.at[slot].set(temperature),
         top_k=state.top_k.at[slot].set(top_k),
         top_p=state.top_p.at[slot].set(top_p),
         key=state.key.at[slot].set(jax.random.PRNGKey(seed)[None][0]),
         eos_id=state.eos_id.at[slot].set(eos_id),
+        json_enabled=state.json_enabled.at[slot].set(json_mode),
+        json_state=state.json_state.at[slot].set(0),
+        json_stack=state.json_stack.at[slot].set(0),
+        json_depth=state.json_depth.at[slot].set(0),
     )
 
 
@@ -122,13 +180,19 @@ def admit_sampling(
     top_p: jax.Array,        # [A] fp32
     seeds: jax.Array,        # [A] int32
     eos_id: jax.Array,       # [A] int32
+    json_mode: jax.Array,    # [A] bool — grammar-constrained decoding
 ) -> SamplingState:
     """Batched admission: install a group of requests' sampling params."""
     keys = jax.vmap(jax.random.PRNGKey)(seeds)
-    return SamplingState(
+    zeros = jnp.zeros_like(slots)
+    return state._replace(
         temperature=state.temperature.at[slots].set(temperature, mode="drop"),
         top_k=state.top_k.at[slots].set(top_k, mode="drop"),
         top_p=state.top_p.at[slots].set(top_p, mode="drop"),
         key=state.key.at[slots].set(keys, mode="drop"),
         eos_id=state.eos_id.at[slots].set(eos_id, mode="drop"),
+        json_enabled=state.json_enabled.at[slots].set(json_mode, mode="drop"),
+        json_state=state.json_state.at[slots].set(zeros, mode="drop"),
+        json_stack=state.json_stack.at[slots].set(zeros, mode="drop"),
+        json_depth=state.json_depth.at[slots].set(zeros, mode="drop"),
     )

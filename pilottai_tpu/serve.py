@@ -404,7 +404,7 @@ class Serve:
             return {"requires_decomposition": False, "complexity": task.complexity}
         prompt = self.prompts.format_prompt("task_analysis", task=task.to_prompt())
         try:
-            content = await self.manager_llm.apredict(prompt)
+            content = await self.manager_llm.apredict(prompt, json_mode=True)
             data = extract_json(content) or {}
         except Exception as exc:  # noqa: BLE001 - analysis is advisory
             self._log.warning("task analysis failed: %s", exc)
@@ -418,7 +418,7 @@ class Serve:
         """LLM decomposition into dependent subtasks (reference ``:427-458``)."""
         prompt = self.prompts.format_prompt("task_decomposition", task=task.to_prompt())
         try:
-            content = await self.manager_llm.apredict(prompt)
+            content = await self.manager_llm.apredict(prompt, json_mode=True)
             data = extract_json(content) or {}
             raw_subtasks = data.get("subtasks") or []
         except Exception as exc:  # noqa: BLE001 - fall back to simple path
@@ -616,7 +616,9 @@ class Serve:
                     agent_id=task.agent_id or "unknown",
                     result=str(result.output)[:2000],
                 )
-                evaluation = extract_json(await self.manager_llm.apredict(prompt)) or {}
+                evaluation = extract_json(
+                    await self.manager_llm.apredict(prompt, json_mode=True)
+                ) or {}
                 needs_retry = coerce_bool(evaluation.get("requires_retry", False))
                 result.metadata["orchestrator_evaluation"] = evaluation
             except Exception as exc:  # noqa: BLE001 - evaluation is advisory

@@ -50,6 +50,7 @@ def _admit(cfg, params, temps, budgets, eos=-1, seed0=10):
         jnp.zeros(A, jnp.int32), jnp.ones(A),
         jnp.arange(seed0, seed0 + A, dtype=jnp.int32),
         jnp.full((A,), eos, jnp.int32),
+        jnp.zeros((A,), bool),
     )
     first, sampling = sample_prefill_tokens(
         logits, jnp.asarray(lens), slots, sampling

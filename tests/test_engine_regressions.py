@@ -139,7 +139,7 @@ def test_first_token_sampling_honors_top_p():
         sampling = admit_sampling(
             sampling, slots, jnp.asarray([1.0]), jnp.asarray([0], jnp.int32),
             jnp.asarray([0.5]), jnp.asarray([seed], jnp.int32),
-            jnp.asarray([-1], jnp.int32),
+            jnp.asarray([-1], jnp.int32), jnp.asarray([False]),
         )
         tok, _ = sample_prefill_tokens(logits, valid, slots, sampling)
         picks.add(int(tok[0]))

@@ -1,0 +1,184 @@
+"""Grammar-constrained JSON decoding (engine/json_mask.py).
+
+SURVEY.md §7 hard part #3 / VERDICT r1 next-step #4: with random weights
+every free-form generation is garbage; under the byte-level grammar mask
+every generation must parse. These tests drive the real cpu-provider
+engine, not the mock.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from pilottai_tpu.core.config import LLMConfig
+from pilottai_tpu.engine.handler import LLMHandler
+from pilottai_tpu.engine.json_mask import (
+    ALLOWED_NP,
+    DDEPTH_NP,
+    MAX_DEPTH,
+    NEXT_NP,
+    S_DONE,
+    S_START,
+    _OPENERS_NP,
+)
+from pilottai_tpu.engine.types import GenerationParams
+
+
+def _host_walk(rng, max_steps=300):
+    """Reference host-side walk of the table automaton."""
+    state, stack, depth = S_START, 0, 0
+    out = []
+    for _ in range(max_steps):
+        if state == S_DONE:
+            return bytes(out), True
+        top = (stack >> max(depth - 1, 0)) & 1 if depth > 0 else 0
+        mask = ALLOWED_NP[state, top].copy()
+        if depth >= MAX_DEPTH:
+            mask &= ~_OPENERS_NP
+        choices = np.flatnonzero(mask)
+        assert len(choices), f"dead end in state {state}"
+        weights = np.where(np.isin(choices, [125, 93]), 10.0, 1.0)
+        weights = np.where(np.isin(choices, [123, 91]), 0.3, weights)
+        b = int(rng.choice(choices, p=weights / weights.sum()))
+        out.append(b)
+        ns = int(NEXT_NP[state, top, b])
+        dd = int(DDEPTH_NP[state, top, b])
+        if dd > 0:
+            stack |= (1 if b == 91 else 0) << depth
+        depth = max(depth + dd, 0)
+        if dd < 0 and depth == 0:
+            ns = S_DONE
+        state = ns
+    return bytes(out), False
+
+
+def test_automaton_random_walks_always_valid_json():
+    rng = np.random.default_rng(7)
+    closed = 0
+    for _ in range(500):
+        doc, done = _host_walk(rng)
+        if done:
+            json.loads(doc.decode("utf-8"))  # must not raise
+            closed += 1
+    assert closed > 400  # the closer bias terminates almost every walk
+
+
+def test_device_mask_and_advance_match_tables():
+    import jax.numpy as jnp
+
+    from pilottai_tpu.engine.json_mask import json_advance, json_allowed_bytes
+
+    rng = np.random.default_rng(3)
+    state = jnp.asarray([S_START], jnp.int32)
+    stack = jnp.asarray([0], jnp.int32)
+    depth = jnp.asarray([0], jnp.int32)
+    h_state, h_stack, h_depth = S_START, 0, 0
+    for _ in range(120):
+        if h_state == S_DONE:
+            break
+        mask = np.asarray(json_allowed_bytes(state, stack, depth))[0]
+        top = (h_stack >> max(h_depth - 1, 0)) & 1 if h_depth > 0 else 0
+        np.testing.assert_array_equal(mask, ALLOWED_NP[h_state, top])
+        b = int(rng.choice(np.flatnonzero(mask)))
+        state, stack, depth = json_advance(
+            state, stack, depth, jnp.asarray([b], jnp.int32)
+        )
+        ns = int(NEXT_NP[h_state, top, b])
+        dd = int(DDEPTH_NP[h_state, top, b])
+        if dd > 0:
+            h_stack |= (1 if b == 91 else 0) << h_depth
+        h_depth = max(h_depth + dd, 0)
+        if dd < 0 and h_depth == 0:
+            ns = S_DONE
+        h_state = ns
+        assert int(state[0]) == h_state and int(depth[0]) == h_depth
+
+
+@pytest.mark.asyncio
+async def test_cpu_engine_json_mode_always_parseable():
+    """Random-weight model + grammar mask => every reply parses. This is
+    the end-to-end contract the agent protocol relies on."""
+    handler = LLMHandler(
+        LLMConfig(
+            model_name="llama-tiny", provider="cpu",
+            engine_max_seq=256, engine_slots=4,
+        )
+    )
+    try:
+        params = GenerationParams(
+            max_new_tokens=120, temperature=1.0, json_mode=True
+        )
+        outs = await asyncio.gather(*[
+            handler.apredict(f"Respond with JSON. Case {i}.", params=params)
+            for i in range(8)
+        ])
+        for text in outs:
+            # Forced closure guarantees EVERY reply is a complete document
+            # within budget (json_mask margin invariant).
+            t = text.strip()
+            assert t.startswith(("{", "[")), f"non-JSON start: {t[:40]!r}"
+            doc = json.loads(t)
+            assert isinstance(doc, (dict, list))
+    finally:
+        await handler.stop()
+
+
+@pytest.mark.asyncio
+async def test_json_mode_respects_free_slots_in_same_batch():
+    """json and non-json requests share one decode batch; masking one slot
+    must not constrain the other."""
+    handler = LLMHandler(
+        LLMConfig(
+            model_name="llama-tiny", provider="cpu",
+            engine_max_seq=256, engine_slots=4,
+        )
+    )
+    try:
+        j, free = await asyncio.gather(
+            handler.apredict(
+                "json",
+                params=GenerationParams(
+                    max_new_tokens=80, temperature=1.0, json_mode=True
+                ),
+            ),
+            handler.apredict(
+                "free",
+                params=GenerationParams(
+                    max_new_tokens=40, temperature=30.0, seed=5
+                ),
+            ),
+        )
+        assert j.strip().startswith(("{", "["))
+        assert len(free) > 0
+    finally:
+        await handler.stop()
+
+
+@pytest.mark.asyncio
+async def test_forced_closure_tiny_budgets_always_parse():
+    """Adversarial budgets: even 3-12 token budgets must yield complete
+    documents (the forced-closure margin invariant; a review finding
+    showed +2 margin could truncate '{\"\":0')."""
+    handler = LLMHandler(
+        LLMConfig(
+            model_name="llama-tiny", provider="cpu",
+            engine_max_seq=128, engine_slots=4,
+        )
+    )
+    try:
+        outs = await asyncio.gather(*[
+            handler.apredict(
+                f"budget case {n}",
+                params=GenerationParams(
+                    max_new_tokens=n, temperature=1.0, seed=n, json_mode=True
+                ),
+            )
+            for n in range(3, 13)
+        ])
+        for n, text in zip(range(3, 13), outs):
+            doc = json.loads(text.strip())
+            assert isinstance(doc, (dict, list)), (n, text)
+    finally:
+        await handler.stop()
