@@ -424,7 +424,14 @@ class BaseAgent:
         self.conversation_history.append(
             {"prompt_tail": prompt[-200:], "response": response.content[:500]}
         )
-        return extract_json(response.content) or {}
+        data = extract_json(response.content) or {}
+        # Function-calling parity (reference ``core/agent.py:331-338``):
+        # a structured tool_call from the engine becomes the step's action
+        # when the reply JSON didn't already name one.
+        if tools and response.tool_calls and "action" not in data:
+            tc = response.tool_calls[0]
+            data = {**data, "action": tc.name, "arguments": tc.arguments}
+        return data
 
     async def _analyze_task(self, task: Task) -> Dict[str, Any]:
         prompt = self.prompts.format_prompt("task_analysis", task=task.to_prompt())
@@ -444,6 +451,10 @@ class BaseAgent:
         )
         data = await self._ask(prompt, tools=[t.to_spec() for t in candidates])
         names = data.get("selected_tools", [])
+        if not names and data.get("action"):
+            # The engine surfaced a structured tool_call instead of the
+            # selection form: treat invoking a tool as selecting it.
+            names = [data["action"]]
         chosen = [t for t in candidates if t.name in names]
         return chosen
 
@@ -463,7 +474,9 @@ class BaseAgent:
                     for i, h in enumerate(history)
                 ) or "none yet",
             )
-            plan = await self._ask(prompt)
+            plan = await self._ask(
+                prompt, tools=[t.to_spec() for t in tools] or None
+            )
             action = plan.get("action", "respond")
             complete = coerce_bool(plan.get("task_complete", False))
             if complete:

@@ -15,8 +15,10 @@ from pilottai_tpu.engine.types import (
     ChatMessage,
     GenerationParams,
     LLMResponse,
+    ToolCall,
     ToolSpec,
 )
+from pilottai_tpu.utils.json_utils import extract_json
 
 
 class LLMBackend(abc.ABC):
@@ -41,6 +43,41 @@ class LLMBackend(abc.ABC):
 
     def get_metrics(self) -> Dict[str, Any]:
         return {"backend": self.name}
+
+
+def parse_tool_calls(content: str, tool_names: Sequence[str]) -> List[ToolCall]:
+    """Extract structured tool invocations from a model reply.
+
+    Two wire forms are honored (the same the mock backend emits and the
+    reference's function-calling path consumed, ``pilott/engine/llm.py:
+    91-104`` -> ``core/agent.py:331-338``):
+
+    * ``{"tool_call": {"name": ..., "arguments": {...}}}``
+    * the step-planning form ``{"action": <tool name>, "arguments": {...}}``
+      when ``action`` names one of the offered tools.
+
+    Malformed wire data (non-dict arguments, non-string name) degrades to
+    "no tool call" — LLM output is untrusted and must never make
+    ``generate()`` itself fail.
+    """
+    data = extract_json(content)
+    if not isinstance(data, dict):
+        return []
+
+    def build(name: Any, arguments: Any) -> Optional[ToolCall]:
+        if not isinstance(name, str) or not name:
+            return None
+        if not isinstance(arguments, dict):
+            arguments = {}
+        return ToolCall(id="tc-0", name=name, arguments=arguments)
+
+    tc = data.get("tool_call")
+    call: Optional[ToolCall] = None
+    if isinstance(tc, dict):
+        call = build(tc.get("name"), tc.get("arguments"))
+    elif data.get("action") in set(tool_names):
+        call = build(data.get("action"), data.get("arguments"))
+    return [call] if call is not None else []
 
 
 def render_chat(messages: Sequence[ChatMessage]) -> str:

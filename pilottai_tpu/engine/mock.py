@@ -19,12 +19,11 @@ import re
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
-from pilottai_tpu.engine.base import LLMBackend
+from pilottai_tpu.engine.base import LLMBackend, parse_tool_calls
 from pilottai_tpu.engine.types import (
     ChatMessage,
     GenerationParams,
     LLMResponse,
-    ToolCall,
     ToolSpec,
     Usage,
 )
@@ -90,7 +89,9 @@ class MockBackend(LLMBackend):
                 content = json.dumps(payload) if isinstance(payload, dict) else str(payload)
         if self.latency:
             await asyncio.sleep(self.latency)
-        tool_calls = self._maybe_tool_calls(content)
+        tool_calls = parse_tool_calls(
+            content, [t.name for t in tools] if tools else []
+        )
         return LLMResponse(
             content=content,
             tool_calls=tool_calls,
@@ -100,17 +101,6 @@ class MockBackend(LLMBackend):
             ),
             latency=time.perf_counter() - start,
         )
-
-    @staticmethod
-    def _maybe_tool_calls(content: str) -> List[ToolCall]:
-        try:
-            data = json.loads(content)
-        except (json.JSONDecodeError, TypeError):
-            return []
-        if isinstance(data, dict) and data.get("tool_call"):
-            tc = data["tool_call"]
-            return [ToolCall(id="tc-0", name=tc.get("name", ""), arguments=tc.get("arguments", {}))]
-        return []
 
     # ------------------------------------------------------------------ #
     # Protocol detection — keyed on the JSON contract fields each

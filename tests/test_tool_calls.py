@@ -1,0 +1,153 @@
+"""Structured function calling on the native engine path (VERDICT r1 #5).
+
+The reference formats OpenAI-style tools and returns ``tool_calls``
+(``pilott/engine/llm.py:91-104``, consumed at ``core/agent.py:331-338``).
+Here the contract is tested against the REAL NativeEngine pipeline
+(tokenize -> batcher -> detokenize -> parse) with a scripted fake batcher
+standing in for the model compute, so the assertions are deterministic.
+"""
+
+import asyncio
+import json
+from concurrent.futures import Future
+
+import pytest
+
+from pilottai_tpu.core.agent import BaseAgent
+from pilottai_tpu.core.config import AgentConfig, LLMConfig
+from pilottai_tpu.core.task import Task
+from pilottai_tpu.engine.base import parse_tool_calls
+from pilottai_tpu.engine.handler import LLMHandler
+from pilottai_tpu.engine.native import NativeEngine
+from pilottai_tpu.engine.types import ChatMessage, ToolSpec
+from pilottai_tpu.tools.tool import Tool
+
+
+def test_parse_tool_calls_wire_forms():
+    calls = parse_tool_calls(
+        '{"tool_call": {"name": "search", "arguments": {"q": "tpu"}}}',
+        ["search", "fetch"],
+    )
+    assert len(calls) == 1 and calls[0].name == "search"
+    assert calls[0].arguments == {"q": "tpu"}
+
+    calls = parse_tool_calls(
+        '{"action": "fetch", "arguments": {"url": "x"}, "task_complete": false}',
+        ["search", "fetch"],
+    )
+    assert len(calls) == 1 and calls[0].name == "fetch"
+
+    # An action that is not an offered tool is NOT a tool call.
+    assert parse_tool_calls('{"action": "respond"}', ["search"]) == []
+    assert parse_tool_calls("not json at all", ["search"]) == []
+
+
+def test_parse_tool_calls_malformed_wire_data_degrades():
+    # LLM output is untrusted: bad shapes must yield [] (or argument-less
+    # calls), never raise into generate() (review finding).
+    assert parse_tool_calls('{"tool_call": {"name": 7}}', ["t"]) == []
+    assert parse_tool_calls('{"tool_call": "search"}', ["search"]) == []
+    assert parse_tool_calls('{"tool_call": {"arguments": {}}}', ["t"]) == []
+    calls = parse_tool_calls(
+        '{"tool_call": {"name": "t", "arguments": "q=x"}}', ["t"]
+    )
+    assert len(calls) == 1 and calls[0].arguments == {}
+    calls = parse_tool_calls('{"action": "t", "arguments": [1, 2]}', ["t"])
+    assert len(calls) == 1 and calls[0].arguments == {}
+
+
+class _ScriptedBatcher:
+    """Stands in for ContinuousBatcher: resolves each request with the
+    next scripted reply's bytes. Everything around it (prompt rendering,
+    tokenization, tool_call parsing) is the real native path."""
+
+    def __init__(self, replies):
+        self.replies = list(replies)
+        self.prompts = []
+
+    def submit(self, request):
+        self.prompts.append(bytes(
+            i for i in request.prompt_ids if 0 <= i < 256
+        ).decode("utf-8", "replace"))
+        fut: Future = Future()
+        fut.set_result(list(self.replies.pop(0).encode("utf-8")))
+        return fut
+
+    def stop(self) -> None:
+        pass
+
+    def get_metrics(self):
+        return {}
+
+
+def _engine(replies) -> NativeEngine:
+    engine = NativeEngine(
+        LLMConfig(model_name="llama-tiny", provider="cpu"), platform="cpu"
+    )
+    engine.batcher = _ScriptedBatcher(replies)  # skip device bring-up
+    return engine
+
+
+@pytest.mark.asyncio
+async def test_native_engine_emits_tool_calls():
+    engine = _engine(
+        ['{"tool_call": {"name": "lookup", "arguments": {"key": "a"}}}']
+    )
+    resp = await engine.generate(
+        [ChatMessage(role="user", content="find a")],
+        tools=[ToolSpec(name="lookup", description="kv lookup")],
+    )
+    assert [tc.name for tc in resp.tool_calls] == ["lookup"]
+    assert resp.tool_calls[0].arguments == {"key": "a"}
+    # The tool inventory and invocation convention reach the prompt.
+    assert "lookup" in engine.batcher.prompts[0]
+    assert "tool_call" in engine.batcher.prompts[0]
+
+
+@pytest.mark.asyncio
+async def test_native_engine_no_tools_no_tool_calls():
+    engine = _engine(['{"tool_call": {"name": "lookup", "arguments": {}}}'])
+    resp = await engine.generate([ChatMessage(role="user", content="hi")])
+    assert resp.tool_calls == []
+
+
+@pytest.mark.asyncio
+async def test_agent_step_loop_executes_native_tool_call():
+    """Full agent plan/act loop over the native path: a tool_call reply
+    must actually run the tool (reference ``core/agent.py:331-338``)."""
+    seen = {}
+
+    def lookup(key: str) -> str:
+        seen["key"] = key
+        return f"value-of-{key}"
+
+    engine = _engine([
+        json.dumps({"understanding": "u", "approach": "a",
+                    "estimated_steps": 1, "risks": []}),
+        json.dumps({"selected_tools": ["lookup"], "reasoning": "need it"}),
+        # Step 1 answers with the function-calling wire form only — no
+        # "action" key — so the step MUST come from response.tool_calls.
+        json.dumps({"tool_call": {"name": "lookup",
+                                  "arguments": {"key": "alpha"}},
+                    "task_complete": False}),
+        json.dumps({"task_complete": True, "action": "respond",
+                    "arguments": {}, "reasoning": "done"}),
+        json.dumps({"success": True, "quality": 0.9, "issues": [],
+                    "suggestions": []}),
+    ])
+    agent = BaseAgent(
+        config=AgentConfig(role="worker", max_iterations=4),
+        llm=LLMHandler(LLMConfig(provider="cpu"), backend=engine),
+        tools=[Tool(name="lookup", function=lookup,
+                    description="kv lookup",
+                    parameters={"properties": {"key": {"type": "string"}}})],
+    )
+    await agent.start()
+    try:
+        result = await agent.execute_task(Task(description="look up alpha"))
+        assert result.success
+        assert seen == {"key": "alpha"}
+        assert result.metadata["steps"][0]["action"] == "lookup"
+        assert result.metadata["steps"][0]["result"] == "value-of-alpha"
+    finally:
+        await agent.stop()
