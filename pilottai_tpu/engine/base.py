@@ -72,11 +72,12 @@ def parse_tool_calls(content: str, tool_names: Sequence[str]) -> List[ToolCall]:
         return ToolCall(id="tc-0", name=name, arguments=arguments)
 
     tc = data.get("tool_call")
+    action = data.get("action")
     call: Optional[ToolCall] = None
     if isinstance(tc, dict):
         call = build(tc.get("name"), tc.get("arguments"))
-    elif data.get("action") in set(tool_names):
-        call = build(data.get("action"), data.get("arguments"))
+    elif isinstance(action, str) and action in tool_names:
+        call = build(action, data.get("arguments"))
     return [call] if call is not None else []
 
 

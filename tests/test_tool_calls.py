@@ -151,3 +151,9 @@ async def test_agent_step_loop_executes_native_tool_call():
         assert result.metadata["steps"][0]["result"] == "value-of-alpha"
     finally:
         await agent.stop()
+
+
+def test_parse_tool_calls_unhashable_action():
+    # {"action": [...]} raised TypeError through generate() (review finding).
+    assert parse_tool_calls('{"action": ["lookup"]}', ["lookup"]) == []
+    assert parse_tool_calls('{"action": {"n": 1}}', ["lookup"]) == []
