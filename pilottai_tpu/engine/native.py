@@ -29,7 +29,12 @@ from pilottai_tpu.engine.types import (
 )
 from pilottai_tpu.models.common import init_params, param_logical_axes
 from pilottai_tpu.models.registry import get_model_config
-from pilottai_tpu.parallel.mesh import MeshConfig, best_mesh_config, create_mesh
+from pilottai_tpu.parallel.mesh import (
+    MeshConfig,
+    best_mesh_config,
+    create_mesh,
+    initialize_distributed,
+)
 from pilottai_tpu.parallel.sharding import shard_params
 from pilottai_tpu.utils.logging import get_logger
 
@@ -74,6 +79,9 @@ class NativeEngine(LLMBackend):
 
     def _start_blocking(self) -> None:
         t0 = time.perf_counter()
+        # Multi-host bring-up over DCN when JAX_COORDINATOR_ADDRESS et al
+        # are set; a no-op for single-process serving.
+        initialize_distributed()
         devices = (
             jax.local_devices(backend="cpu") if self.platform == "cpu" else jax.devices()
         )

@@ -91,12 +91,20 @@ def initialize_distributed(
     """
     if num_processes in (None, 1) and "JAX_COORDINATOR_ADDRESS" not in os.environ:
         return
+    # Only double-initialization is ignorable. A genuine bring-up failure
+    # (unreachable coordinator, wrong world size) must be LOUD — swallowing
+    # it would let each host proceed with a local-only mesh and silently
+    # inconsistent sharding.
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None and is_init():
+        return
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
-    except RuntimeError:
-        # Already initialized.
-        pass
+    except RuntimeError as exc:
+        if "already initialized" in str(exc).lower():
+            return
+        raise

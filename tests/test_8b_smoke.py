@@ -58,8 +58,14 @@ def test_flagship_model_compiles_sharded(model, mesh_cfg):
         .compile()
     )
     # The compiled executable sees the full sharded graph: per-device
-    # argument shapes must actually be partitioned, not replicated.
+    # parameter shapes must actually be partitioned, not replicated.
     flops = compiled.cost_analysis().get("flops", 0.0)
     assert flops > 0
-    out_sharding = compiled.output_shardings[0]
-    assert out_sharding is not None
+    param_shardings = compiled.input_shardings[0][0]
+    partitioned = 0
+    for leaf_sharding, leaf in zip(
+        jax.tree.leaves(param_shardings), jax.tree.leaves(ap)
+    ):
+        if leaf_sharding.shard_shape(leaf.shape) != leaf.shape:
+            partitioned += 1
+    assert partitioned > 0, "every parameter came out replicated"
