@@ -42,15 +42,13 @@ import numpy as np
 
 from pilottai_tpu.engine.decode import (
     DecodeState,
-    admit_decode,
+    admit_group,
     decode_chunk,
     release_decode,
-    sample_prefill_tokens,
 )
-from pilottai_tpu.engine.sampling import SamplingState, admit_sampling
+from pilottai_tpu.engine.sampling import SamplingState
 from pilottai_tpu.models.common import ModelConfig
-from pilottai_tpu.models.transformer import forward_prefill
-from pilottai_tpu.ops.kvcache import KVCache, free_slots, write_prompts
+from pilottai_tpu.ops.kvcache import KVCache, free_slots
 from pilottai_tpu.ops.pallas.decode_attention import decode_shapes_ok
 from pilottai_tpu.utils.logging import get_logger
 from pilottai_tpu.utils.metrics import global_metrics
@@ -108,6 +106,7 @@ class ContinuousBatcher:
         admit_batch: int = 8,
         use_pallas: Optional[bool] = None,
         on_tpu: Optional[bool] = None,
+        mesh: Optional[Any] = None,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -140,14 +139,16 @@ class ContinuousBatcher:
                 )
             )
         self.use_pallas = use_pallas
+        # Multi-chip serving mesh: prefill's flash kernel runs per-shard
+        # under shard_map (ops/pallas/flash_attention.py). One device →
+        # plain single-chip dispatch inside _full_seq_block.
+        self.flash_mesh = (
+            mesh if mesh is not None and mesh.devices.size > 1 else None
+        )
         self._log = get_logger("engine.batcher")
 
-        self.cache = KVCache.create(
-            cfg.n_layers, n_slots, self.max_seq_len, cfg.n_kv_heads, cfg.head_dim,
-            dtype=cache_dtype,
-        )
-        self.sampling = SamplingState.create(n_slots)
-        self.dstate = DecodeState.create(n_slots)
+        self.cache_dtype = cache_dtype
+        self._rebuild_device_state()
         self._slots: List[Optional[_Slot]] = [None] * n_slots
         # Admission generation per slot: chunk results are stamped with the
         # generation vector at dispatch, so a chunk dispatched before a slot
@@ -261,6 +262,13 @@ class ContinuousBatcher:
             b *= 2
         return min(b, self.max_seq_len)
 
+    def _decode_bucket(self, n: int) -> int:
+        """Prefix-bound bucket for a decode chunk: the prefill bucket
+        ladder with a 128 floor (so tiny bounds don't churn recompiles and
+        executable variants stay O(log S)). Sharing the ladder means
+        warmup's prefill sweep compiles every decode variant too."""
+        return max(self._bucket(n), min(128, self.max_seq_len))
+
     def _free_slot_indices(self) -> List[int]:
         return [i for i, s in enumerate(self._slots) if s is None]
 
@@ -312,6 +320,15 @@ class ContinuousBatcher:
                         self._slots[idx] = None
                         if not req.future.done():
                             req.future.set_exception(exc)
+                # admit_group donates cache/dstate/sampling: a dispatch
+                # that failed mid-flight may have consumed them. If so the
+                # engine state is gone with it — fail in-flight work loudly
+                # and rebuild fresh state so the engine stays serviceable
+                # (silently keeping deleted buffers would crash the next
+                # chunk and kill every request anyway, without recovery).
+                if self.cache.lengths.is_deleted():
+                    self._fail_occupied_slots(exc)
+                    self._rebuild_device_state()
 
     def _prefill_group(self, group: List[Tuple[int, GenRequest]]) -> None:
         A = self.admit_batch
@@ -340,27 +357,18 @@ class ContinuousBatcher:
             budgets[row] = req.max_new_tokens - 1
 
         positions = np.broadcast_to(np.arange(T, dtype=np.int32)[None], (A, T))
-        lens_j = jnp.asarray(lens)
-        slots_j = jnp.asarray(slots)
         with global_metrics.timer("engine.prefill_latency"):
-            logits, ks, vs = forward_prefill(
-                self.params, self.cfg, jnp.asarray(tokens),
-                jnp.asarray(positions), lens_j, use_flash=self.on_tpu,
+            # One fused dispatch for the whole admission (prefill + cache
+            # write + sampler + first token + decode install) — five
+            # separate dispatches each paid tunnel latency.
+            self.cache, self.dstate, self.sampling, first = admit_group(
+                self.params, self.cfg, self.cache, self.dstate,
+                self.sampling, jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(lens), jnp.asarray(slots), jnp.asarray(temps),
+                jnp.asarray(topks), jnp.asarray(topps), jnp.asarray(seeds),
+                jnp.asarray(eos), jnp.asarray(jsonm), jnp.asarray(budgets),
+                use_flash=self.on_tpu, flash_mesh=self.flash_mesh,
             )
-        self.cache = self._write_prompts(self.cache, slots_j, ks, vs, lens_j)
-        self.sampling = admit_sampling(
-            self.sampling, slots_j, jnp.asarray(temps), jnp.asarray(topks),
-            jnp.asarray(topps), jnp.asarray(seeds), jnp.asarray(eos),
-            jnp.asarray(jsonm),
-        )
-        first, self.sampling = sample_prefill_tokens(
-            logits, lens_j, slots_j, self.sampling,
-            remaining=jnp.asarray(budgets) + 1,  # total incl. this token
-        )
-        self.dstate = admit_decode(
-            self.dstate, slots_j, first, jnp.asarray(budgets),
-            jnp.asarray(lens > 0),
-        )
         try:
             first.copy_to_host_async()
         except AttributeError:
@@ -375,10 +383,6 @@ class ContinuousBatcher:
                 ([(idx, self._gen[idx]) for idx, _ in group], first)
             )
         global_metrics.inc("engine.admitted", len(group))
-
-    _write_prompts = staticmethod(
-        jax.jit(write_prompts, donate_argnums=(0,))
-    )
 
     def _fold_first_tokens(self, groups, hosts: List[np.ndarray]) -> None:
         """Fold prefill-sampled first tokens into their slots (lock held).
@@ -452,11 +456,11 @@ class ContinuousBatcher:
                 return True
         return False
 
-    def _dispatch_chunk(self):
+    def _dispatch_chunk(self, prefix_bound: int):
         with global_metrics.timer("engine.chunk_dispatch_latency"):
             toks, valid, self.cache, self.dstate, self.sampling = decode_chunk(
                 self.params, self.cfg, self.cache, self.dstate, self.sampling,
-                self.chunk_size, self.use_pallas,
+                self.chunk_size, self.use_pallas, prefix_bound=prefix_bound,
             )
         # Start the D2H transfer as soon as the chunk finishes computing,
         # so the blocking read one pipeline-cycle later is a cache hit, not
@@ -530,6 +534,19 @@ class ContinuousBatcher:
             self._wake.set()
         self._log.info("reader stopped")
 
+    def _rebuild_device_state(self) -> None:
+        """(Re)create cache/sampling/decode state — at construction, and
+        after a failed donated dispatch consumed the previous buffers
+        (device thread only; failure callers must fail the occupants
+        first)."""
+        self.cache = KVCache.create(
+            self.cfg.n_layers, self.n_slots, self.max_seq_len,
+            self.cfg.n_kv_heads, self.cfg.head_dim,
+            dtype=self.cache_dtype,
+        )
+        self.sampling = SamplingState.create(self.n_slots)
+        self.dstate = DecodeState.create(self.n_slots)
+
     def _fail_occupied_slots(self, exc: Exception) -> None:
         """Fail every in-flight request and reset slot bookkeeping after an
         unrecoverable device/transfer error (either thread)."""
@@ -550,15 +567,30 @@ class ContinuousBatcher:
         )
         while not self._stop.is_set():
             try:
+                # Self-heal after any donated dispatch (decode_chunk too,
+                # not just admission) failed mid-flight and consumed the
+                # state buffers; the except arm below already failed the
+                # occupants on the way here.
+                if self.cache.lengths.is_deleted():
+                    self._rebuild_device_state()
                 self._admit()
                 with self._lock:
                     useful = self._chunk_useful()
                     if useful:
+                        # Upper bound on any live slot's cache length at
+                        # chunk start (device lengths ≤ prompt + already-
+                        # dispatched decode tokens), taken BEFORE this
+                        # chunk's own tokens are counted.
+                        bound = max(
+                            s.prompt_len + s.dispatched
+                            for s in self._slots
+                            if s is not None
+                        )
                         for s in self._slots:
                             if s is not None:
                                 s.dispatched += self.chunk_size
                 if useful:
-                    item = self._dispatch_chunk()
+                    item = self._dispatch_chunk(self._decode_bucket(bound))
                     while not self._stop.is_set():
                         try:
                             self._results.put(item, timeout=0.5)

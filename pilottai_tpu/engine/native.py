@@ -122,6 +122,7 @@ class NativeEngine(LLMBackend):
             cache_dtype=self.model_cfg.dtype,
             chunk_size=self.config.engine_chunk,
             on_tpu=(self.platform != "cpu" and devices[0].platform == "tpu"),
+            mesh=self.mesh,
         )
         self.batcher.start()
         self.batcher.warmup()

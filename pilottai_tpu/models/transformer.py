@@ -28,6 +28,7 @@ from pilottai_tpu.ops.attention import (
     flash_enabled,
     flash_shapes_ok,
 )
+from pilottai_tpu.ops.pallas.flash_attention import flash_sharding_ok
 from pilottai_tpu.ops.kvcache import KVCache
 from pilottai_tpu.parallel.sharding import with_logical_constraint
 
@@ -109,12 +110,20 @@ def _full_seq_block(
     valid: Optional[jax.Array] = None,      # [B]
     ring_mesh: Any = None,                  # Mesh → ring attention over 'seq'
     allow_flash: bool = True,               # False when running off-TPU
+    flash_mesh: Any = None,                 # Mesh → shard_map'd flash (TP/DP)
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One transformer block over a full sequence (shared by prefill and
     the training forward). Returns (x, k, v)."""
     h = rms_norm(x, lp["ln1"]["scale"], cfg.rms_eps, cfg.rms_offset)
     q, k, v = _qkv(cfg, lp["attn"], h, sin, cos)
     T = q.shape[1]
+    use_flash = (
+        positions is not None
+        and valid is not None
+        and allow_flash
+        and flash_enabled()
+        and flash_shapes_ok(T, T, head_dim=cfg.head_dim, itemsize=q.dtype.itemsize)
+    )
     if ring_mesh is not None and positions is not None and valid is not None:
         # Context parallelism: K/V rotate around the 'seq' ring (ICI);
         # differentiable, so the training path uses it directly.
@@ -124,20 +133,25 @@ def _full_seq_block(
             q, k, v, positions, valid, window,
             scale=qscale, softcap=cfg.attn_softcap, mesh=ring_mesh,
         )
-    # Pallas flash kernel on single-chip TPU (multi-chip TP shards heads;
-    # the kernel isn't shard_map-wrapped yet, so XLA keeps that path).
-    elif (
-        positions is not None
-        and valid is not None
-        and allow_flash
-        and flash_enabled()
-        and flash_shapes_ok(T, T, head_dim=cfg.head_dim, itemsize=q.dtype.itemsize)
-        and len(jax.devices()) == 1
-    ):
+    # Pallas flash kernel (fwd + custom-VJP bwd). Single chip calls it
+    # directly; on a mesh it runs per-shard under shard_map (batch over
+    # data/fsdp, heads over model) when the shapes divide.
+    elif use_flash and len(jax.devices()) == 1:
         from pilottai_tpu.ops.pallas.flash_attention import flash_attention
 
         attn = flash_attention(
             q, k, v, positions, positions, valid, window,
+            scale=qscale, softcap=cfg.attn_softcap,
+        )
+    elif use_flash and flash_mesh is not None and flash_sharding_ok(
+        flash_mesh, q.shape[0], cfg.n_heads, cfg.n_kv_heads
+    ):
+        from pilottai_tpu.ops.pallas.flash_attention import (
+            flash_attention_sharded,
+        )
+
+        attn = flash_attention_sharded(
+            flash_mesh, q, k, v, positions, positions, valid, window,
             scale=qscale, softcap=cfg.attn_softcap,
         )
     else:
@@ -165,7 +179,7 @@ def _full_seq_block(
 # Prefill
 # --------------------------------------------------------------------- #
 
-@partial(jax.jit, static_argnames=("cfg", "use_flash"))
+@partial(jax.jit, static_argnames=("cfg", "use_flash", "flash_mesh"))
 def forward_prefill(
     params: Dict[str, Any],
     cfg: ModelConfig,
@@ -176,6 +190,7 @@ def forward_prefill(
                              # provider on a machine whose DEFAULT backend
                              # is a TPU) must pass False — flash_enabled()
                              # only sees the default backend
+    flash_mesh: Any = None,  # static Mesh → shard_map'd flash on multi-chip
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Full-prompt forward. Returns (logits [B, T, V] fp32, k, v) where
     k/v are [L, B, T, K, H] ready to insert into a KVCache."""
@@ -201,6 +216,7 @@ def forward_prefill(
         x, k, v, _ = _full_seq_block(
             cfg, qscale, x, lp, window, sin, cos, ipos, jpos, base_mask,
             positions=positions, valid=valid, allow_flash=use_flash,
+            flash_mesh=flash_mesh,
         )
         return x, (k, v)
 
@@ -295,7 +311,7 @@ def forward_decode(
 # Training forward
 # --------------------------------------------------------------------- #
 
-@partial(jax.jit, static_argnames=("cfg", "remat", "ring_mesh"))
+@partial(jax.jit, static_argnames=("cfg", "remat", "ring_mesh", "flash_mesh"))
 def forward_train(
     params: Dict[str, Any],
     cfg: ModelConfig,
@@ -304,6 +320,7 @@ def forward_train(
     valid: jax.Array,       # [B] true lengths
     remat: bool = True,
     ring_mesh: Any = None,  # static Mesh → ring attention over the seq axis
+    flash_mesh: Any = None,  # static Mesh → shard_map'd flash (no seq shard)
 ) -> Tuple[jax.Array, jax.Array]:
     """Full-sequence forward for training: (logits, moe_aux_loss), no KV
     outputs. moe_aux_loss is the mean load-balancing term over layers
@@ -329,11 +346,14 @@ def forward_train(
     )
 
     def block(x, lp, window):
+        # positions/valid always flow in; _full_seq_block's dispatch picks
+        # ring (seq-sharded mesh) > flash kernel (TPU, shapes fit; direct
+        # on one chip, shard_map'd via flash_mesh on many) > XLA dense.
         x, _, _, aux = _full_seq_block(
             cfg, qscale, x, lp, window, sin, cos, ipos, jpos, base_mask,
-            positions=positions if ring_mesh is not None else None,
-            valid=valid if ring_mesh is not None else None,
+            positions=positions, valid=valid,
             ring_mesh=ring_mesh,
+            flash_mesh=flash_mesh if ring_mesh is None else None,
         )
         return x, aux
 

@@ -1,4 +1,4 @@
-"""Flash attention (online softmax) as a Pallas TPU kernel.
+"""Flash attention (online softmax) as Pallas TPU kernels — fwd AND bwd.
 
 Replaces the O(T·S)-memory XLA attention (``ops/attention.py``) for large
 prefills: logits are never materialized; each (batch, head, q-block) grid
@@ -7,8 +7,27 @@ fp32. Matmuls hit the MXU in bf16; masking (causal from absolute
 positions, per-layer sliding window, valid-length) is computed in-kernel
 so no [B, T, S] mask array ever exists in HBM.
 
+The op carries a ``jax.custom_vjp``: the forward kernel also emits the
+log-sum-exp rows, and two backward kernels recompute probabilities
+blockwise (the standard flash backward) —
+
+* ``dq``: grid (B, N, T/bq), K/V resident, accumulate dq per q-block;
+* ``dk/dv``: grid (B, K, S/bk, T/bq) with the q-block dim innermost, so
+  the kv-block outputs stay resident across q steps and accumulate
+  in-place (Mosaic's revisited-output reduction pattern); the G query
+  heads of each kv head are processed in-cell, so dk/dv come out already
+  group-summed.
+
+so training runs through the kernel instead of silently falling back to
+XLA attention (VERDICT.md Weak #4 / next-step 8).
+
 Fully-masked KV blocks (beyond the causal horizon or the valid length)
 are skipped with ``lax.cond`` — for causal prefill that halves the work.
+
+Multi-chip: ``flash_attention_sharded`` wraps the kernel in ``shard_map``
+(batch over data/fsdp, heads over model — attention is embarrassingly
+parallel across both), so TP meshes keep the fast path instead of
+dropping to XLA dense.
 
 No reference counterpart: the reference computes no attention at all
 (SURVEY.md §2.13); this is the serving engine's hot op.
@@ -17,15 +36,21 @@ No reference counterpart: the reference computes no attention at all
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
 
 NEG_INF = -2.0**30
 
+
+# --------------------------------------------------------------------- #
+# Forward kernel
+# --------------------------------------------------------------------- #
 
 def _flash_kernel(
     window_ref,   # SMEM (1,) int32 (scalar prefetch) — sliding window; 0 = global
@@ -36,6 +61,9 @@ def _flash_kernel(
     k_ref,        # VMEM (1, 1, S, H)
     v_ref,        # VMEM (1, 1, S, H)
     o_ref,        # VMEM (1, 1, bq, H)
+    lse_ref,      # VMEM (1, 1, bq, 1) fp32 — log-sum-exp rows (for the VJP;
+                  # trailing singleton keeps the last two block dims
+                  # Mosaic-tileable: (bq, 1) vs array dims (T, 1))
     *,
     scale: float,
     softcap: float,
@@ -106,36 +134,23 @@ def _flash_kernel(
     out = acc / jnp.maximum(l, 1e-30)
     out = jnp.where(l > 0.0, out, 0.0)                        # fully-masked rows
     o_ref[0, 0, :, :] = out.astype(o_ref.dtype)
+    lse = jnp.where(
+        l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF
+    )                                                         # [bq, 1]
+    lse_ref[0, 0, :, :] = lse
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("scale", "softcap", "block_q", "block_k", "interpret"),
-)
-def flash_attention(
-    q: jax.Array,          # [B, T, N, H]
-    k: jax.Array,          # [B, S, K, H]
-    v: jax.Array,          # [B, S, K, H]
-    q_positions: jax.Array,   # [B, T] absolute positions
-    kv_positions: jax.Array,  # [B, S] absolute positions
-    valid: jax.Array,         # [B] valid kv length (sequence index bound)
-    window: jax.Array,        # scalar int32; 0 = global attention
-    scale: Optional[float] = None,
-    softcap: float = 0.0,
-    block_q: int = 128,
-    block_k: int = 128,
-    interpret: bool = False,
-) -> jax.Array:
-    """Causal GQA flash attention. Mask semantics match
-    ``models/transformer.py`` prefill: attend iff kv_pos <= q_pos, kv index
-    < valid, and (window == 0 or q_pos - kv_pos < window)."""
+def _fwd_impl(
+    q, k, v, q_positions, kv_positions, valid, window,
+    scale, softcap, block_q, block_k, interpret,
+) -> Tuple[jax.Array, jax.Array]:
+    """Runs the forward kernel. Returns (o [B,T,N,H], lse [B,N,T] fp32)."""
     B, T, N, H = q.shape
     _, S, K, _ = k.shape
     assert N % K == 0
     G = N // K
     assert T % block_q == 0, f"T={T} not divisible by block_q={block_q}"
     assert S % block_k == 0, f"S={S} not divisible by block_k={block_k}"
-    scale = scale if scale is not None else H ** -0.5
 
     window = jnp.asarray(window, jnp.int32).reshape(1)
     valid = jnp.asarray(valid, jnp.int32).reshape(B)
@@ -161,14 +176,420 @@ def flash_attention(
             pl.BlockSpec((1, 1, S, H), lambda b, n, i, *_: (b, n // G, 0, 0)),
             pl.BlockSpec((1, 1, S, H), lambda b, n, i, *_: (b, n // G, 0, 0)),
         ],
+        out_specs=(
+            pl.BlockSpec((1, 1, block_q, H), lambda b, n, i, *_: (b, n, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, n, i, *_: (b, n, i, 0)),
+        ),
+    )
+    o_t, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct(q_t.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, N, T, 1), jnp.float32),
+        ),
+        interpret=interpret,
+    )(window, valid, qpos, kpos, q_t, k_t, v_t)
+    return o_t.transpose(0, 2, 1, 3), lse                    # o [B,T,N,H]; lse [B,N,T,1]
+
+
+# --------------------------------------------------------------------- #
+# Backward kernels
+# --------------------------------------------------------------------- #
+
+def _bwd_dq_kernel(
+    window_ref,   # SMEM (1,)
+    valid_ref,    # SMEM (B,)
+    qpos_ref,     # VMEM (1, 1, bq)
+    kpos_ref,     # VMEM (1, 1, S)
+    q_ref,        # VMEM (1, 1, bq, H)
+    k_ref,        # VMEM (1, 1, S, H)
+    v_ref,        # VMEM (1, 1, S, H)
+    do_ref,       # VMEM (1, 1, bq, H)
+    lse_ref,      # VMEM (1, 1, bq, 1) fp32
+    delta_ref,    # VMEM (1, 1, bq, 1) fp32 — rowsum(dO * O)
+    dq_ref,       # VMEM (1, 1, bq, H)
+    *,
+    scale: float,
+    softcap: float,
+    block_k: int,
+):
+    bq, H = q_ref.shape[2], q_ref.shape[3]
+    S = k_ref.shape[2]
+    n_kb = S // block_k
+
+    q = q_ref[0, 0, :, :]
+    do = do_ref[0, 0, :, :].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, :]                                 # [bq, 1]
+    delta = delta_ref[0, 0, :, :]                             # [bq, 1]
+    qpos = qpos_ref[0, 0, :].reshape(bq, 1)
+    window = window_ref[0]
+    valid = valid_ref[pl.program_id(0)]
+    qpos_max = jnp.max(qpos)
+
+    def body(kb, dq_acc):
+        j0 = kb * block_k
+        kpos = kpos_ref[0, 0, pl.ds(j0, block_k)].reshape(1, block_k)
+        jidx = j0 + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        block_live = (jnp.min(kpos) <= qpos_max) & (j0 < valid)
+        block_live &= (window <= 0) | ((jnp.min(qpos) - jnp.max(kpos)) < window)
+
+        def attend(dq_acc):
+            k = k_ref[0, 0, pl.ds(j0, block_k), :]
+            v = v_ref[0, 0, pl.ds(j0, block_k), :]
+            s = jax.lax.dot_general(
+                q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                         # [bq, bk]
+            if softcap > 0.0:
+                t = jnp.tanh(s / softcap)
+                s_c = t * softcap
+            else:
+                s_c = s
+            mask = (kpos <= qpos) & (jidx < valid)
+            mask &= (window <= 0) | ((qpos - kpos) < window)
+            p = jnp.where(mask, jnp.exp(s_c - lse), 0.0)      # true softmax rows
+            dp = jax.lax.dot_general(
+                do, v.astype(jnp.float32),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                                 # [bq, bk]
+            ds = p * (dp - delta)
+            if softcap > 0.0:
+                ds = ds * (1.0 - t * t)
+            return dq_acc + jax.lax.dot_general(
+                ds.astype(k.dtype), k,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+
+        return jax.lax.cond(block_live, attend, lambda a: a, dq_acc)
+
+    dq = jax.lax.fori_loop(0, n_kb, body, jnp.zeros((bq, H), jnp.float32))
+    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    window_ref,   # SMEM (1,)
+    valid_ref,    # SMEM (B,)
+    qpos_ref,     # VMEM (1, 1, bq)
+    kpos_ref,     # VMEM (1, 1, bk)
+    q_ref,        # VMEM (1, G, bq, H) — all G query heads of this kv head
+    k_ref,        # VMEM (1, 1, bk, H)
+    v_ref,        # VMEM (1, 1, bk, H)
+    do_ref,       # VMEM (1, G, bq, H)
+    lse_ref,      # VMEM (1, G, bq, 1) fp32
+    delta_ref,    # VMEM (1, G, bq, 1) fp32
+    dk_ref,       # VMEM (1, 1, bk, H) fp32 — accumulated across q blocks
+    dv_ref,       # VMEM (1, 1, bk, H) fp32
+    *,
+    scale: float,
+    softcap: float,
+):
+    G = q_ref.shape[1]
+    bq = q_ref.shape[2]
+    bk = k_ref.shape[2]
+    i = pl.program_id(3)  # q-block index — innermost, outputs revisited
+
+    @pl.when(i == 0)
+    def _init():
+        dk_ref[...] = jnp.zeros_like(dk_ref)
+        dv_ref[...] = jnp.zeros_like(dv_ref)
+
+    qpos = qpos_ref[0, 0, :].reshape(bq, 1)
+    kpos = kpos_ref[0, 0, :].reshape(1, bk)
+    window = window_ref[0]
+    valid = valid_ref[pl.program_id(0)]
+    j0 = pl.program_id(2) * bk
+    jidx = j0 + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+
+    block_live = (jnp.min(kpos) <= jnp.max(qpos)) & (j0 < valid)
+    block_live &= (window <= 0) | ((jnp.min(qpos) - jnp.max(kpos)) < window)
+
+    @pl.when(block_live)
+    def _body():
+        kk = k_ref[0, 0, :, :]                                # [bk, H]
+        vv = v_ref[0, 0, :, :]
+        mask = (kpos <= qpos) & (jidx < valid)
+        mask &= (window <= 0) | ((qpos - kpos) < window)
+        dk_acc = jnp.zeros((bk, kk.shape[1]), jnp.float32)
+        dv_acc = jnp.zeros_like(dk_acc)
+        for g in range(G):                                    # static unroll
+            qg = q_ref[0, g, :, :]                            # [bq, H]
+            dog = do_ref[0, g, :, :].astype(jnp.float32)
+            lse = lse_ref[0, g, :, :]                         # [bq, 1]
+            delta = delta_ref[0, g, :, :]                     # [bq, 1]
+            s = jax.lax.dot_general(
+                qg, kk, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                         # [bq, bk]
+            if softcap > 0.0:
+                t = jnp.tanh(s / softcap)
+                s_c = t * softcap
+            else:
+                s_c = s
+            p = jnp.where(mask, jnp.exp(s_c - lse), 0.0)
+            # dv += p^T @ dO
+            dv_acc += jax.lax.dot_general(
+                p.astype(vv.dtype), dog.astype(vv.dtype),
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                dog, vv.astype(jnp.float32),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta)
+            if softcap > 0.0:
+                ds = ds * (1.0 - t * t)
+            # dk += ds^T @ q * scale
+            dk_acc += jax.lax.dot_general(
+                ds.astype(qg.dtype), qg,
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+        dk_ref[0, 0, :, :] += dk_acc
+        dv_ref[0, 0, :, :] += dv_acc
+
+
+def _bwd_impl(
+    q, k, v, q_positions, kv_positions, valid, window, o, lse, do,
+    scale, softcap, block_q, block_k, interpret,
+):
+    B, T, N, H = q.shape
+    _, S, K, _ = k.shape
+    G = N // K
+
+    window = jnp.asarray(window, jnp.int32).reshape(1)
+    valid = jnp.asarray(valid, jnp.int32).reshape(B)
+    qpos = jnp.asarray(q_positions, jnp.int32)[:, None, :]
+    kpos = jnp.asarray(kv_positions, jnp.int32)[:, None, :]
+
+    q_t = q.transpose(0, 2, 1, 3)                            # [B, N, T, H]
+    k_t = k.transpose(0, 2, 1, 3)                            # [B, K, S, H]
+    v_t = v.transpose(0, 2, 1, 3)
+    do_t = do.transpose(0, 2, 1, 3)
+    # delta = rowsum(dO * O), fp32 — [B, N, T, 1]
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1)[..., None]
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, softcap=softcap, block_k=block_k
+    )
+    dq_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, N, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q), lambda b, n, i, *_: (b, 0, i)),
+            pl.BlockSpec((1, 1, S), lambda b, n, i, *_: (b, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, H), lambda b, n, i, *_: (b, n, i, 0)),
+            pl.BlockSpec((1, 1, S, H), lambda b, n, i, *_: (b, n // G, 0, 0)),
+            pl.BlockSpec((1, 1, S, H), lambda b, n, i, *_: (b, n // G, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, H), lambda b, n, i, *_: (b, n, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, n, i, *_: (b, n, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, n, i, *_: (b, n, i, 0)),
+        ],
         out_specs=pl.BlockSpec(
             (1, 1, block_q, H), lambda b, n, i, *_: (b, n, i, 0)
         ),
     )
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
+    dq_t = pl.pallas_call(
+        dq_kernel,
+        grid_spec=dq_spec,
         out_shape=jax.ShapeDtypeStruct(q_t.shape, q.dtype),
         interpret=interpret,
-    )(window, valid, qpos, kpos, q_t, k_t, v_t)
-    return out.transpose(0, 2, 1, 3)                         # back to [B, T, N, H]
+    )(window, valid, qpos, kpos, q_t, k_t, v_t, do_t, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, softcap=softcap
+    )
+    # q-block dim innermost: dk/dv blocks are revisited and accumulate.
+    dkv_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, K, S // block_k, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q), lambda b, h, j, i, *_: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_k), lambda b, h, j, i, *_: (b, 0, j)),
+            pl.BlockSpec(
+                (1, G, block_q, H), lambda b, h, j, i, *_: (b, h, i, 0)
+            ),
+            pl.BlockSpec((1, 1, block_k, H), lambda b, h, j, i, *_: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, H), lambda b, h, j, i, *_: (b, h, j, 0)),
+            pl.BlockSpec(
+                (1, G, block_q, H), lambda b, h, j, i, *_: (b, h, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, G, block_q, 1), lambda b, h, j, i, *_: (b, h, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, G, block_q, 1), lambda b, h, j, i, *_: (b, h, i, 0)
+            ),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, block_k, H), lambda b, h, j, i, *_: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, H), lambda b, h, j, i, *_: (b, h, j, 0)),
+        ),
+    )
+    dk_t, dv_t = pl.pallas_call(
+        dkv_kernel,
+        grid_spec=dkv_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct(k_t.shape, jnp.float32),
+            jax.ShapeDtypeStruct(v_t.shape, jnp.float32),
+        ),
+        interpret=interpret,
+    )(window, valid, qpos, kpos, q_t, k_t, v_t, do_t, lse, delta)
+
+    dq = dq_t.transpose(0, 2, 1, 3)
+    dk = dk_t.transpose(0, 2, 1, 3).astype(k.dtype)
+    dv = dv_t.transpose(0, 2, 1, 3).astype(v.dtype)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------- #
+# custom_vjp wiring
+# --------------------------------------------------------------------- #
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _flash(scale, softcap, block_q, block_k, interpret,
+           q, k, v, q_positions, kv_positions, valid, window):
+    o, _ = _fwd_impl(
+        q, k, v, q_positions, kv_positions, valid, window,
+        scale, softcap, block_q, block_k, interpret,
+    )
+    return o
+
+
+def _flash_fwd_rule(scale, softcap, block_q, block_k, interpret,
+                    q, k, v, q_positions, kv_positions, valid, window):
+    o, lse = _fwd_impl(
+        q, k, v, q_positions, kv_positions, valid, window,
+        scale, softcap, block_q, block_k, interpret,
+    )
+    return o, (q, k, v, q_positions, kv_positions, valid, window, o, lse)
+
+
+def _flash_bwd_rule(scale, softcap, block_q, block_k, interpret, res, do):
+    q, k, v, q_positions, kv_positions, valid, window, o, lse = res
+    dq, dk, dv = _bwd_impl(
+        q, k, v, q_positions, kv_positions, valid, window, o, lse, do,
+        scale, softcap, block_q, block_k, interpret,
+    )
+
+    def f0(x):
+        return np.zeros(jnp.shape(x), dtype=jax.dtypes.float0)
+
+    return (dq, dk, dv, f0(q_positions), f0(kv_positions), f0(valid), f0(window))
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "softcap", "block_q", "block_k", "interpret"),
+)
+def flash_attention(
+    q: jax.Array,          # [B, T, N, H]
+    k: jax.Array,          # [B, S, K, H]
+    v: jax.Array,          # [B, S, K, H]
+    q_positions: jax.Array,   # [B, T] absolute positions
+    kv_positions: jax.Array,  # [B, S] absolute positions
+    valid: jax.Array,         # [B] valid kv length (sequence index bound)
+    window: jax.Array,        # scalar int32; 0 = global attention
+    scale: Optional[float] = None,
+    softcap: float = 0.0,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Causal GQA flash attention, differentiable in (q, k, v). Mask
+    semantics match ``models/transformer.py`` prefill: attend iff
+    kv_pos <= q_pos, kv index < valid, and (window == 0 or
+    q_pos - kv_pos < window)."""
+    H = q.shape[-1]
+    scale = scale if scale is not None else H ** -0.5
+    return _flash(
+        scale, softcap, block_q, block_k, interpret,
+        q, k, v, q_positions, kv_positions, valid, window,
+    )
+
+
+# --------------------------------------------------------------------- #
+# Multi-chip dispatch (shard_map)
+# --------------------------------------------------------------------- #
+
+def flash_sharding_ok(
+    mesh: Mesh,
+    B: int,
+    n_heads: int,
+    n_kv_heads: int,
+    batch_axes: Sequence[str] = ("data", "fsdp"),
+    head_axis: str = "model",
+    seq_axis: str = "seq",
+) -> bool:
+    """True when the kernel can run per-shard with no cross-device work:
+    batch divides the data axes, both head counts divide the TP axis, and
+    the sequence axis is unsharded (sequence parallelism goes through
+    ``parallel/ring_attention.py`` instead)."""
+    shape = dict(mesh.shape)
+    db = 1
+    for a in batch_axes:
+        db *= shape.get(a, 1)
+    tp = shape.get(head_axis, 1)
+    if shape.get(seq_axis, 1) != 1:
+        return False
+    return B % db == 0 and n_heads % tp == 0 and n_kv_heads % tp == 0
+
+
+def flash_attention_sharded(
+    mesh: Mesh,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_positions: jax.Array,
+    kv_positions: jax.Array,
+    valid: jax.Array,
+    window: jax.Array,
+    scale: Optional[float] = None,
+    softcap: float = 0.0,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+    batch_axes: Sequence[str] = ("data", "fsdp"),
+    head_axis: str = "model",
+) -> jax.Array:
+    """The flash kernel under ``shard_map``: batch shards over the data
+    axes, heads over the TP axis. Attention is independent across both, so
+    there are no collectives — each chip runs the single-chip kernel on
+    its shard and TP meshes keep the fast path (VERDICT.md Weak #4).
+    Differentiable: shard_map transposes through the kernel's custom VJP.
+    """
+    H = q.shape[-1]
+    scale = scale if scale is not None else H ** -0.5
+    present = [a for a in batch_axes if a in mesh.axis_names]
+    bspec = tuple(present) if present else None
+    fn = functools.partial(
+        flash_attention,
+        scale=scale, softcap=softcap,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    head = head_axis if head_axis in mesh.axis_names else None
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(
+            P(bspec, None, head, None),   # q
+            P(bspec, None, head, None),   # k
+            P(bspec, None, head, None),   # v
+            P(bspec, None),               # q_positions
+            P(bspec, None),               # kv_positions
+            P(bspec),                     # valid
+            P(),                          # window (replicated scalar)
+        ),
+        out_specs=P(bspec, None, head, None),
+        check_vma=False,
+    )(q, k, v, q_positions, kv_positions, valid,
+      jnp.asarray(window, jnp.int32))

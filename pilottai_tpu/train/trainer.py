@@ -147,6 +147,15 @@ class Trainer:
             if tc.context_parallel and self.mesh.shape.get("seq", 1) > 1
             else None
         )
+        # No sequence sharding → the Pallas flash kernel (fwd + bwd) runs
+        # per-shard under shard_map on TPU meshes; ring attention owns the
+        # seq-sharded case. _full_seq_block falls back to XLA dense when
+        # off-TPU or shapes don't divide.
+        flash_mesh = (
+            self.mesh
+            if ring_mesh is None and self.mesh.devices.size > 1
+            else None
+        )
 
         def train_step(params, opt_state, tokens, valid):
             B, T = tokens.shape
@@ -162,6 +171,7 @@ class Trainer:
                 logits, moe_aux = forward_train(
                     compute_p, cfg, tokens, positions, valid,
                     remat=tc.remat, ring_mesh=ring_mesh,
+                    flash_mesh=flash_mesh,
                 )
                 lm_loss = next_token_loss(logits, tokens, valid)
                 return lm_loss + tc.moe_aux_weight * moe_aux, (lm_loss, moe_aux)

@@ -181,3 +181,40 @@ def test_pallas_decode_attention_interpret_matches_dense():
         np.testing.assert_allclose(
             np.asarray(acc), np.asarray(acc_r), rtol=1e-3, atol=1e-3
         )
+
+
+def test_prefix_bound_parity():
+    """A chunk reading only the first ``bound`` cache columns must produce
+    bit-identical tokens when every live slot's length fits the bound —
+    the contract the batcher's _decode_bucket relies on (the cache is 128
+    wide here, prompts are 17/33 long, bound 64 covers both)."""
+    cfg = get_model_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    cache, dstate, sampling = _admit(cfg, params, temps=30.0, budgets=[20, 20, 0, 0])
+    ref_cache = KVCache(
+        layers=tuple((k.copy(), v.copy()) for k, v in cache.layers),
+        lengths=cache.lengths.copy(),
+    )
+    ref_sampling = SamplingState(*[a.copy() for a in sampling])
+    ref_dstate = DecodeState(*[a.copy() for a in dstate])
+
+    t_full, v_full, cache, dstate, _ = decode_chunk(
+        params, cfg, cache, dstate, sampling, 8, use_pallas=False
+    )
+    t_b, v_b, bcache, bdstate, _ = decode_chunk(
+        params, cfg, ref_cache, ref_dstate, ref_sampling, 8,
+        use_pallas=False, prefix_bound=64,
+    )
+    np.testing.assert_array_equal(np.asarray(t_full), np.asarray(t_b))
+    np.testing.assert_array_equal(np.asarray(v_full), np.asarray(v_b))
+    np.testing.assert_array_equal(
+        np.asarray(cache.lengths), np.asarray(bcache.lengths)
+    )
+    # Written cache contents agree wherever tokens landed.
+    for (k_f, v_f), (k_p, v_p) in zip(cache.layers, bcache.layers):
+        np.testing.assert_allclose(
+            np.asarray(k_f), np.asarray(k_p), atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(v_f), np.asarray(v_p), atol=1e-6
+        )

@@ -189,3 +189,44 @@ def test_shard_params_accepts_bare_none_leaf():
     logical = {"w": ("embed", "mlp"), "b": None}  # bare None = replicated
     placed = shard_params(params, logical, mesh)
     assert placed["b"].sharding.is_fully_replicated
+
+
+def test_donated_admit_failure_rebuilds_state():
+    """admit_group donates cache/dstate/sampling; a dispatch failure that
+    consumed them must not leave the engine pointing at deleted buffers —
+    in-flight work fails loudly, state is rebuilt, and the engine serves
+    the next request (code-review finding, round 2)."""
+    import pilottai_tpu.engine.batcher as bmod
+
+    batcher, cfg = _tiny_batcher()
+    real_admit = bmod.admit_group
+
+    def poison(params, cfg_, cache, dstate, sampling, *a, **k):
+        # Simulate the donated buffers being consumed before the failure.
+        for k_, v_ in cache.layers:
+            k_.delete()
+            v_.delete()
+        cache.lengths.delete()
+        raise RuntimeError("tunnel dropped mid-dispatch")
+
+    bmod.admit_group = poison
+    try:
+        batcher.start()
+        req = GenRequest(prompt_ids=[1, 2, 3], max_new_tokens=4)
+        fut = batcher.submit(req)
+        with pytest.raises(RuntimeError, match="tunnel dropped"):
+            fut.result(timeout=30)
+        # State was rebuilt with live buffers.
+        import time as _time
+
+        deadline = _time.monotonic() + 10
+        while batcher.cache.lengths.is_deleted():
+            assert _time.monotonic() < deadline
+        # With the real admission path back, the engine still serves.
+        bmod.admit_group = real_admit
+        req2 = GenRequest(prompt_ids=[1, 2, 3], max_new_tokens=3)
+        out = batcher.submit(req2).result(timeout=60)
+        assert len(out) == 3
+    finally:
+        bmod.admit_group = real_admit
+        batcher.stop()

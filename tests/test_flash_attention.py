@@ -106,3 +106,142 @@ def test_dispatch_gates(monkeypatch):
     assert not flash_shapes_ok(64, 64)          # below one block
     assert flash_shapes_ok(8192, 8192, head_dim=128, itemsize=2)
     assert not flash_shapes_ok(16384, 16384, head_dim=128, itemsize=2)  # VMEM
+
+
+# --------------------------------------------------------------------- #
+# Backward pass (custom VJP, Pallas bwd kernels)
+# --------------------------------------------------------------------- #
+
+@pytest.mark.parametrize(
+    "window,softcap",
+    [(0, 0.0), (64, 0.0), (0, 50.0), (64, 30.0)],
+)
+def test_flash_grad_matches_reference(window, softcap):
+    """d(loss)/d(q,k,v) through the Pallas bwd kernels == XLA autodiff of
+    the dense reference. Loss sums only valid rows (rows past valid hold
+    garbage in both implementations)."""
+    q, k, v, ps = _setup(T=256)
+    valid = jnp.asarray([256, 180], jnp.int32)
+    scale = q.shape[-1] ** -0.5
+    T = q.shape[1]
+    row_ok = (jnp.arange(T)[None, :] < valid[:, None]).astype(jnp.float32)
+    # Non-uniform weights so dO varies per element.
+    w = jnp.asarray(
+        np.random.default_rng(3).normal(size=q.shape), jnp.float32
+    ) * row_ok[:, :, None, None]
+
+    def loss_flash(q, k, v):
+        o = flash_attention(
+            q, k, v, ps, ps, valid, jnp.int32(window),
+            scale=scale, softcap=softcap, interpret=True,
+        )
+        return jnp.sum(o * w)
+
+    def loss_ref(q, k, v):
+        o = _reference(q, k, v, ps, valid, window, softcap, scale)
+        return jnp.sum(o * w)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for got, ref, name in zip(g_flash, g_ref, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(
+            np.asarray(ref), np.asarray(got), atol=3e-4, rtol=3e-4,
+            err_msg=name,
+        )
+
+
+def test_flash_grad_gqa():
+    q, k, v, ps = _setup(T=128, N=8, K=2)
+    valid = jnp.full((2,), 128, jnp.int32)
+    scale = q.shape[-1] ** -0.5
+
+    def loss_flash(q, k, v):
+        o = flash_attention(
+            q, k, v, ps, ps, valid, jnp.int32(0), scale=scale, interpret=True
+        )
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(_reference(q, k, v, ps, valid, 0, 0.0, scale)))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for got, ref, name in zip(g_flash, g_ref, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(
+            np.asarray(ref), np.asarray(got), atol=3e-4, rtol=3e-4,
+            err_msg=name,
+        )
+
+
+# --------------------------------------------------------------------- #
+# Multi-chip dispatch (shard_map over the 8-device CPU mesh)
+# --------------------------------------------------------------------- #
+
+def _tp_mesh():
+    from pilottai_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    return create_mesh(MeshConfig(data=2, fsdp=2, model=2, seq=1))
+
+
+def test_flash_sharded_matches_single():
+    from pilottai_tpu.ops.pallas.flash_attention import (
+        flash_attention_sharded,
+        flash_sharding_ok,
+    )
+
+    mesh = _tp_mesh()
+    q, k, v, ps = _setup(B=4, T=128, N=4, K=2)
+    valid = jnp.asarray([128, 90, 50, 128], jnp.int32)
+    scale = q.shape[-1] ** -0.5
+    assert flash_sharding_ok(mesh, 4, 4, 2)
+
+    ref = flash_attention(
+        q, k, v, ps, ps, valid, jnp.int32(0), scale=scale, interpret=True
+    )
+    got = flash_attention_sharded(
+        mesh, q, k, v, ps, ps, valid, jnp.int32(0),
+        scale=scale, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(got), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_flash_sharded_grad():
+    """shard_map transposes through the kernel's custom VJP — TP training
+    keeps the Pallas path end to end."""
+    from pilottai_tpu.ops.pallas.flash_attention import flash_attention_sharded
+
+    mesh = _tp_mesh()
+    q, k, v, ps = _setup(B=4, T=128, N=4, K=2)
+    valid = jnp.full((4,), 128, jnp.int32)
+    scale = q.shape[-1] ** -0.5
+
+    def loss_sharded(q, k, v):
+        o = flash_attention_sharded(
+            mesh, q, k, v, ps, ps, valid, jnp.int32(0),
+            scale=scale, interpret=True,
+        )
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(_reference(q, k, v, ps, valid, 0, 0.0, scale)))
+
+    g_s = jax.grad(loss_sharded, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for got, ref, name in zip(g_s, g_r, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(
+            np.asarray(ref), np.asarray(got), atol=3e-4, rtol=3e-4,
+            err_msg=name,
+        )
+
+def test_flash_sharding_gates():
+    from pilottai_tpu.ops.pallas.flash_attention import flash_sharding_ok
+    from pilottai_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    mesh = _tp_mesh()
+    assert flash_sharding_ok(mesh, 8, 8, 2)
+    assert not flash_sharding_ok(mesh, 3, 8, 2)    # batch not divisible
+    assert not flash_sharding_ok(mesh, 8, 8, 1)    # kv heads < TP degree
+    sp = create_mesh(MeshConfig(data=1, fsdp=1, model=1, seq=8))
+    assert not flash_sharding_ok(sp, 8, 8, 2)      # seq-sharded -> ring path
