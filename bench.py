@@ -48,12 +48,12 @@ def pick_config():
         provider="tpu" if on_accel else "cpu",
         engine_slots=min(CONCURRENCY, 32),
         engine_max_seq=512,
-        # Swept on v5e (chunk ∈ {8, 12, 16, 24}): 8 wins both p50 and
-        # steps/s — finer chunk boundaries shrink the completion-read →
-        # slot-readmission dead window more than the extra dispatches cost
-        # (dispatch enqueue is ~1 ms; the old 100 ms-per-sync assumption
-        # died with the fused admission path).
-        engine_chunk=8,
+        # Swept on v5e (chunk ∈ {8, 12, 16, 24} × {bf16, int8}): int8
+        # weight-only + chunk 12 wins (p50 430 ms, 71 steps/s measured) —
+        # int8 halves the decode weight stream (models/quant.py), and 12
+        # balances chunk-boundary dead time against per-chunk overhead.
+        engine_chunk=12,
+        quantize="int8" if on_accel else None,
         dtype="bfloat16" if on_accel else "float32",
     )
 

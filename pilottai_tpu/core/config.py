@@ -98,6 +98,9 @@ class LLMConfig(BaseModel):
     # Engine placement / serving shape
     mesh_shape: Optional[Dict[str, int]] = None  # e.g. {"data": 1, "model": 8}
     dtype: str = "bfloat16"
+    # Weight-only quantization for serving ("int8" or None). Halves the
+    # per-token HBM weight stream that bounds decode (models/quant.py).
+    quantize: Optional[str] = None
     engine_slots: int = Field(default=8, ge=1)       # continuous-batching slots
     engine_max_seq: Optional[int] = None             # KV length cap (default model max)
     engine_chunk: int = Field(default=16, ge=1)      # decode tokens per dispatch

@@ -111,6 +111,18 @@ class NativeEngine(LLMBackend):
             params = shard_params(
                 params, param_logical_axes(self.model_cfg), self.mesh
             )
+        if self.config.quantize == "int8":
+            from pilottai_tpu.models.quant import quantize_params
+
+            # Weight-only int8 on device: halves the decode weight stream
+            # AND the params' HBM footprint (originals freed after this).
+            params = quantize_params(params, dtype=self.model_cfg.dtype)
+            self._log.info("quantized matmul weights to int8 (weight-only)")
+        elif self.config.quantize:
+            raise ValueError(
+                f"unknown quantize mode {self.config.quantize!r}; "
+                "supported: 'int8'"
+            )
         max_seq = self.config.engine_max_seq or min(self.model_cfg.max_seq_len, 2048)
         # Placement flows from the params' NamedShardings; jit propagates
         # them through the cache and activations, no mesh context needed.

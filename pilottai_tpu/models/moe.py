@@ -22,6 +22,8 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
+from pilottai_tpu.models.quant import dequant
+
 from pilottai_tpu.parallel.sharding import with_logical_constraint
 
 
@@ -56,10 +58,10 @@ def moe_mlp(
 
     # All experts, all tokens; expert axis sharded -> each device computes
     # its local experts only.
-    gate = activation(jnp.einsum("bte,xef->btxf", x, p["wg"]))
-    up = jnp.einsum("bte,xef->btxf", x, p["wu"])
+    gate = activation(jnp.einsum("bte,xef->btxf", x, dequant(p["wg"])))
+    up = jnp.einsum("bte,xef->btxf", x, dequant(p["wu"]))
     h = gate * up
     h = with_logical_constraint(h, ("batch", "seq", "expert", None))
-    y = jnp.einsum("btxf,xfe->btxe", h, p["wd"])              # [B, T, X, E]
+    y = jnp.einsum("btxf,xfe->btxe", h, dequant(p["wd"]))              # [B, T, X, E]
     out = jnp.einsum("btxe,btx->bte", y, combine.astype(y.dtype))
     return out, aux_loss

@@ -29,6 +29,7 @@ from pilottai_tpu.ops.attention import (
     flash_shapes_ok,
 )
 from pilottai_tpu.ops.pallas.flash_attention import flash_sharding_ok
+from pilottai_tpu.models.quant import dequant
 from pilottai_tpu.ops.kvcache import KVCache
 from pilottai_tpu.parallel.sharding import with_logical_constraint
 
@@ -52,9 +53,9 @@ def _mlp(
 
         return moe_mlp(cfg, lp["moe"], x, lambda h: _activation(cfg, h))
     p = lp["mlp"]
-    gate = _activation(cfg, x @ p["wg"])
-    up = x @ p["wu"]
-    return (gate * up) @ p["wd"], jnp.zeros((), jnp.float32)
+    gate = _activation(cfg, x @ dequant(p["wg"]))
+    up = x @ dequant(p["wu"])
+    return (gate * up) @ dequant(p["wd"]), jnp.zeros((), jnp.float32)
 
 
 def _qkv(
@@ -65,9 +66,9 @@ def _qkv(
     cos: jax.Array,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     B, T, _ = x.shape
-    q = (x @ p["wq"]).reshape(B, T, cfg.n_heads, cfg.head_dim)
-    k = (x @ p["wk"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
-    v = (x @ p["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    q = (x @ dequant(p["wq"])).reshape(B, T, cfg.n_heads, cfg.head_dim)
+    k = (x @ dequant(p["wk"])).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    v = (x @ dequant(p["wv"])).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
     q = apply_rope(q, sin, cos)
     k = apply_rope(k, sin, cos)
     return q, k, v
@@ -75,7 +76,7 @@ def _qkv(
 
 def _attn_out(cfg: ModelConfig, p: Dict[str, Any], attn: jax.Array) -> jax.Array:
     B, T = attn.shape[:2]
-    return attn.reshape(B, T, cfg.q_dim) @ p["wo"]
+    return attn.reshape(B, T, cfg.q_dim) @ dequant(p["wo"])
 
 
 def _embed(cfg: ModelConfig, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
@@ -86,7 +87,11 @@ def _embed(cfg: ModelConfig, params: Dict[str, Any], tokens: jax.Array) -> jax.A
 
 
 def _unembed(cfg: ModelConfig, params: Dict[str, Any], x: jax.Array) -> jax.Array:
-    head = params["lm_head"] if "lm_head" in params else params["embed"].T
+    head = (
+        dequant(params["lm_head"])
+        if "lm_head" in params
+        else params["embed"].T
+    )
     logits = jnp.einsum(
         "...e,ev->...v", x, head, preferred_element_type=jnp.float32
     )
