@@ -104,6 +104,13 @@ class LLMConfig(BaseModel):
     engine_slots: int = Field(default=8, ge=1)       # continuous-batching slots
     engine_max_seq: Optional[int] = None             # KV length cap (default model max)
     engine_chunk: int = Field(default=16, ge=1)      # decode tokens per dispatch
+    # Paged KV cache (ops/paged.py): None = auto (paged when the per-slot
+    # capacity is ≥ 4096 — that is where dense slots × max_seq reservation
+    # stops fitting HBM). Pool size in pages; None = the HBM a dense
+    # min(max_seq, 2048) cache would use.
+    engine_paged_kv: Optional[bool] = None
+    engine_kv_pages: Optional[int] = None
+    engine_page_size: int = Field(default=128, ge=8)
     seed: int = 0                                    # param init seed when no checkpoint
 
 

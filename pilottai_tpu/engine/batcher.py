@@ -49,6 +49,7 @@ from pilottai_tpu.engine.decode import (
 from pilottai_tpu.engine.sampling import SamplingState
 from pilottai_tpu.models.common import ModelConfig
 from pilottai_tpu.ops.kvcache import KVCache, free_slots
+from pilottai_tpu.ops.paged import PageAllocator, PagedKVCache
 from pilottai_tpu.ops.pallas.decode_attention import decode_shapes_ok
 from pilottai_tpu.utils.logging import get_logger
 from pilottai_tpu.utils.metrics import global_metrics
@@ -107,6 +108,9 @@ class ContinuousBatcher:
         use_pallas: Optional[bool] = None,
         on_tpu: Optional[bool] = None,
         mesh: Optional[Any] = None,
+        paged: bool = False,
+        page_size: int = 128,
+        num_pages: Optional[int] = None,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -148,6 +152,35 @@ class ContinuousBatcher:
         self._log = get_logger("engine.batcher")
 
         self.cache_dtype = cache_dtype
+        # Paged KV: shared page pool + host-side block table/allocator
+        # (ops/paged.py). Slots reserve only the pages their prompt+budget
+        # needs, so long per-slot capacity doesn't multiply HBM by slots.
+        self.paged = paged
+        self.page_size = page_size
+        if paged:
+            # Default pool: the HBM a dense cache would spend on
+            # min(max_seq, 2048)-wide slots (+ the scratch page).
+            self.num_pages = num_pages or (
+                n_slots * min(self.max_seq_len, 2048) // page_size + 1
+            )
+            # The pool must at least hold one full-capacity request, or
+            # admission can never make progress (degenerate configs like a
+            # page bigger than the whole pool would otherwise clamp
+            # max_seq to 0 and hang every request with no error).
+            min_pages = -(-min(self.max_seq_len, 2 * page_size) // page_size)
+            if self.num_pages - 1 < min_pages:
+                raise ValueError(
+                    f"paged KV pool of {self.num_pages} pages x {page_size} "
+                    f"can't hold a single request; raise engine_kv_pages "
+                    f"or lower engine_page_size"
+                )
+            # A single request can never need more pages than the pool
+            # holds — without this clamp an oversized request blocks
+            # admission forever (its can_allocate is never true).
+            usable = (self.num_pages - 1) * page_size
+            if usable < self.max_seq_len:
+                self.max_seq_len = usable
+            self.max_pages_per_slot = -(-self.max_seq_len // page_size)
         self._rebuild_device_state()
         self._slots: List[Optional[_Slot]] = [None] * n_slots
         # Admission generation per slot: chunk results are stamped with the
@@ -155,6 +188,9 @@ class ContinuousBatcher:
         # was re-admitted can never fold tokens into the new occupant.
         self._gen: List[int] = [0] * n_slots
         self._pending: "queue.Queue[GenRequest]" = queue.Queue()
+        # Device-thread FIFO the pending queue drains into (page-gated
+        # admission peeks at the head without losing submission order).
+        self._backlog: deque = deque()
         self._release: List[int] = []  # slots to force-stop at next admission
         # (group_slots, first_tokens device array) awaiting lazy host read
         self._first_reads: deque = deque()
@@ -196,12 +232,23 @@ class ContinuousBatcher:
         if self._reader is not None:
             self._reader.join(timeout=60)
             self._reader = None
+        # Quiesce the device: chunks dispatched right before stop may still
+        # be executing, and tearing the process down mid-computation
+        # crashes the backend's thread pool at exit.
+        try:
+            if not self.cache.lengths.is_deleted():
+                jax.block_until_ready(self.cache.lengths)
+        except Exception:  # noqa: BLE001 — best-effort quiesce
+            pass
         # Fail any stranded requests.
+        stranded = list(self._backlog)
+        self._backlog.clear()
         while True:
             try:
-                req = self._pending.get_nowait()
+                stranded.append(self._pending.get_nowait())
             except queue.Empty:
                 break
+        for req in stranded:
             if not req.future.done():
                 req.future.set_exception(RuntimeError("engine stopped"))
         for slot in self._slots:
@@ -282,33 +329,73 @@ class ContinuousBatcher:
         with self._lock:
             released = list(self._release)
             self._release.clear()
-            free = self._free_slot_indices()
-            groups: List[List[Tuple[int, GenRequest]]] = []
-            while free:
-                group: List[Tuple[int, GenRequest]] = []
-                while free and len(group) < self.admit_batch:
-                    try:
-                        req = self._pending.get_nowait()
-                    except queue.Empty:
-                        break
-                    if req.cancelled or req.future.cancelled():
-                        continue
-                    group.append((free.pop(0), req))
-                if not group:
-                    break
-                groups.append(group)
-            # Only this thread allocates slots, so the picks stay valid
-            # after the lock drops; occupied entries land in _prefill_group.
 
         if released:
             # Fixed-size release vector (padded with OOB indices) so the
             # jitted release path compiles exactly once. Must precede the
-            # prompt writes below when a released slot is being reused.
+            # prompt writes below when a released slot is being reused —
+            # and page release must precede allocation so a completing
+            # wave's pages fund the next wave's admissions.
             rel = np.full((self.n_slots,), self.n_slots, np.int32)
             rel[: len(released)] = released[: self.n_slots]
             rel_j = jnp.asarray(rel)
             self.dstate = release_decode(self.dstate, rel_j)
             self.cache = free_slots(self.cache, rel_j)
+            if self.alloc is not None:
+                for idx in released:
+                    self.alloc.release(idx)
+
+        # Drain the thread-safe submission queue into the device thread's
+        # FIFO backlog (page-gated admission needs to peek at the head
+        # without losing order).
+        while True:
+            try:
+                self._backlog.append(self._pending.get_nowait())
+            except queue.Empty:
+                break
+
+        with self._lock:
+            # A slot completed AFTER the release snapshot above is not yet
+            # admissible: its release ops (decode stop, page free) run
+            # next cycle, and admitting into it now would let that stale
+            # release wipe the new occupant. One cycle of patience.
+            not_yet = set(self._release)
+            free = [i for i in self._free_slot_indices() if i not in not_yet]
+            groups: List[List[Tuple[int, GenRequest]]] = []
+            blocked = False
+            while free and not blocked:
+                group: List[Tuple[int, GenRequest]] = []
+                while free and self._backlog and len(group) < self.admit_batch:
+                    req = self._backlog[0]
+                    if req.cancelled or req.future.cancelled():
+                        self._backlog.popleft()
+                        continue
+                    if self.alloc is not None:
+                        # Clamp to slot capacity: decode stops at
+                        # ctx-full anyway, so the cache never holds more
+                        # (an unclamped huge max_new_tokens would make
+                        # can_allocate permanently false and deadlock
+                        # the FIFO head).
+                        need = min(
+                            len(req.prompt_ids) + req.max_new_tokens,
+                            self.max_seq_len,
+                        )
+                        if not self.alloc.can_allocate(need):
+                            # Head-of-line waits for pages (FIFO fairness);
+                            # completions will free them.
+                            blocked = True
+                            break
+                    self._backlog.popleft()
+                    idx = free.pop(0)
+                    if self.alloc is not None:
+                        ok = self.alloc.allocate(idx, need)
+                        assert ok, "can_allocate/allocate disagree"
+                    group.append((idx, req))
+                if not group:
+                    break
+                groups.append(group)
+            # Only this thread allocates slots, so the picks stay valid
+            # after the lock drops; occupied entries land in _prefill_group.
 
         for group in groups:
             try:
@@ -320,6 +407,12 @@ class ContinuousBatcher:
                         self._slots[idx] = None
                         if not req.future.done():
                             req.future.set_exception(exc)
+                if self.alloc is not None:
+                    # Reclaim the group's KV pages — leaking them here
+                    # permanently shrinks the pool AND trips allocate()'s
+                    # held-pages invariant when the slot is reused.
+                    for idx, _ in group:
+                        self.alloc.release(idx)
                 # admit_group donates cache/dstate/sampling: a dispatch
                 # that failed mid-flight may have consumed them. If so the
                 # engine state is gone with it — fail in-flight work loudly
@@ -357,6 +450,14 @@ class ContinuousBatcher:
             budgets[row] = req.max_new_tokens - 1
 
         positions = np.broadcast_to(np.arange(T, dtype=np.int32)[None], (A, T))
+        page_rows = None
+        if self.alloc is not None:
+            pr = np.full(
+                (A, self.max_pages_per_slot), self.alloc.sentinel, np.int32
+            )
+            for row, (idx, _) in enumerate(group):
+                pr[row] = self.alloc.table[idx]
+            page_rows = jnp.asarray(pr)
         with global_metrics.timer("engine.prefill_latency"):
             # One fused dispatch for the whole admission (prefill + cache
             # write + sampler + first token + decode install) — five
@@ -368,6 +469,7 @@ class ContinuousBatcher:
                 jnp.asarray(topks), jnp.asarray(topps), jnp.asarray(seeds),
                 jnp.asarray(eos), jnp.asarray(jsonm), jnp.asarray(budgets),
                 use_flash=self.on_tpu, flash_mesh=self.flash_mesh,
+                page_rows=page_rows,
             )
         try:
             first.copy_to_host_async()
@@ -457,10 +559,14 @@ class ContinuousBatcher:
         return False
 
     def _dispatch_chunk(self, prefix_bound: int):
+        table = (
+            jnp.asarray(self.alloc.table) if self.alloc is not None else None
+        )
         with global_metrics.timer("engine.chunk_dispatch_latency"):
             toks, valid, self.cache, self.dstate, self.sampling = decode_chunk(
                 self.params, self.cfg, self.cache, self.dstate, self.sampling,
                 self.chunk_size, self.use_pallas, prefix_bound=prefix_bound,
+                table=table,
             )
         # Start the D2H transfer as soon as the chunk finishes computing,
         # so the blocking read one pipeline-cycle later is a cache hit, not
@@ -539,11 +645,23 @@ class ContinuousBatcher:
         after a failed donated dispatch consumed the previous buffers
         (device thread only; failure callers must fail the occupants
         first)."""
-        self.cache = KVCache.create(
-            self.cfg.n_layers, self.n_slots, self.max_seq_len,
-            self.cfg.n_kv_heads, self.cfg.head_dim,
-            dtype=self.cache_dtype,
-        )
+        if self.paged:
+            self.cache = PagedKVCache.create(
+                self.cfg.n_layers, self.n_slots, self.num_pages,
+                self.page_size, self.cfg.n_kv_heads, self.cfg.head_dim,
+                dtype=self.cache_dtype,
+            )
+            self.alloc = PageAllocator(
+                self.num_pages, self.page_size, self.n_slots,
+                self.max_pages_per_slot,
+            )
+        else:
+            self.cache = KVCache.create(
+                self.cfg.n_layers, self.n_slots, self.max_seq_len,
+                self.cfg.n_kv_heads, self.cfg.head_dim,
+                dtype=self.cache_dtype,
+            )
+            self.alloc = None
         self.sampling = SamplingState.create(self.n_slots)
         self.dstate = DecodeState.create(self.n_slots)
 
@@ -619,7 +737,12 @@ class ContinuousBatcher:
         return {
             "slots_total": self.n_slots,
             "slots_active": sum(s is not None for s in self._slots),
-            "pending": self._pending.qsize(),
+            "pending": self._pending.qsize() + len(self._backlog),
+            **(
+                {"kv_pages_free": self.alloc.free_pages,
+                 "kv_pages_total": self.num_pages - 1}
+                if self.alloc is not None else {}
+            ),
             "decode_steps": global_metrics.get("engine.decode_steps"),
             "completed": global_metrics.get("engine.completed"),
         }

@@ -40,7 +40,15 @@ from pilottai_tpu.models.transformer import (
     forward_prefill,
 )
 from pilottai_tpu.ops.kvcache import KVCache, write_chunk_rows, write_prompts
+from pilottai_tpu.ops.paged import (
+    PagedKVCache,
+    gather_pages,
+    install_lengths,
+    write_chunk_rows_paged,
+    write_prompts_paged,
+)
 from pilottai_tpu.ops.pallas.decode_attention import decode_attention
+from pilottai_tpu.ops.pallas.paged_attention import paged_decode_attention
 
 NEG_INF = -2.0**30
 
@@ -182,6 +190,7 @@ def decode_chunk(
     n_steps: int,
     use_pallas: bool = True,
     prefix_bound: Optional[int] = None,
+    table: Optional[jax.Array] = None,  # [B, max_pages] — paged cache only
 ) -> Tuple[jax.Array, jax.Array, KVCache, DecodeState, SamplingState]:
     """Run ``n_steps`` decode steps for every slot in one dispatch.
 
@@ -200,17 +209,39 @@ def decode_chunk(
     bound to powers of two so compile variants stay O(log S).
     """
     B = dstate.tokens.shape[0]
-    S = cache.max_len
-    Sb = S if prefix_bound is None else max(1, min(prefix_bound, S))
-    # Bounded read-only views for the prefix attention (writes at chunk end
-    # still land in the full panels).
-    prefix_panels = tuple(
-        (
-            jax.lax.slice_in_dim(k_, 0, Sb, axis=2),
-            jax.lax.slice_in_dim(v_, 0, Sb, axis=2),
+    paged = isinstance(cache, PagedKVCache)
+    if paged:
+        assert table is not None, "paged decode needs the block table"
+        P = cache.page_size
+        S = table.shape[1] * P               # per-slot capacity
+        Sb = S if prefix_bound is None else max(1, min(prefix_bound, S))
+        n_blocks = -(-Sb // P)
+        if use_pallas:
+            prefix_panels = cache.layers     # pools; kernel reads via table
+        else:
+            # XLA fallback: materialize bounded dense panels ONCE per
+            # chunk (pool contents are frozen during the scan — decode
+            # K/V goes to the ring until chunk end), then run the same
+            # dense prefix attention as the unpaged path.
+            prefix_panels = tuple(
+                (
+                    gather_pages(k_, table, n_blocks),
+                    gather_pages(v_, table, n_blocks),
+                )
+                for (k_, v_) in cache.layers
+            )
+    else:
+        S = cache.max_len
+        Sb = S if prefix_bound is None else max(1, min(prefix_bound, S))
+        # Bounded read-only views for the prefix attention (writes at chunk
+        # end still land in the full panels).
+        prefix_panels = tuple(
+            (
+                jax.lax.slice_in_dim(k_, 0, Sb, axis=2),
+                jax.lax.slice_in_dim(v_, 0, Sb, axis=2),
+            )
+            for (k_, v_) in cache.layers
         )
-        for (k_, v_) in cache.layers
-    )
     start = cache.lengths                    # [B] frozen during the chunk
     windows = cfg.window_sizes()
     qscale = cfg.query_scale if cfg.query_scale is not None else cfg.head_dim**-0.5
@@ -249,7 +280,13 @@ def decode_chunk(
             )
 
             qf = q[:, 0]                                  # [B, N, H]
-            if use_pallas:
+            if paged and use_pallas:
+                acc_p, m_p, l_p = paged_decode_attention(
+                    qf, layer_k, layer_v, table, prefix_last,
+                    q_positions=pos, n_blocks=n_blocks,
+                    scale=qscale, softcap=cfg.attn_softcap, window=window,
+                )
+            elif use_pallas and not paged:
                 acc_p, m_p, l_p = decode_attention(
                     qf, layer_k, layer_v, prefix_last, q_positions=pos,
                     scale=qscale, softcap=cfg.attn_softcap, window=window,
@@ -306,9 +343,15 @@ def decode_chunk(
         jax.lax.scan(step, carry0, jnp.arange(n_steps))
     )
 
-    cache = write_chunk_rows(
-        cache, [r[0] for r in rings], [r[1] for r in rings], start, offset
-    )
+    if paged:
+        cache = write_chunk_rows_paged(
+            cache, table, [r[0] for r in rings], [r[1] for r in rings],
+            start, offset,
+        )
+    else:
+        cache = write_chunk_rows(
+            cache, [r[0] for r in rings], [r[1] for r in rings], start, offset
+        )
     dstate = DecodeState(tokens=tokens, done=done, budget=budget)
     return out_toks, out_valid, cache, dstate, sampling
 
@@ -337,6 +380,7 @@ def admit_group(
     budgets: jax.Array,    # [A] max_new_tokens - 1
     use_flash: bool = True,
     flash_mesh: Any = None,
+    page_rows: Optional[jax.Array] = None,  # [A, max_pages] — paged cache
 ):
     """The whole admission path — prefill forward, batched cache write,
     sampler install, on-device first-token sample, decode-state install —
@@ -349,7 +393,12 @@ def admit_group(
         params, cfg, tokens, positions, lens,
         use_flash=use_flash, flash_mesh=flash_mesh,
     )
-    cache = write_prompts(cache, slots, ks, vs, lens)
+    if isinstance(cache, PagedKVCache):
+        assert page_rows is not None, "paged admission needs page rows"
+        cache = write_prompts_paged(cache, page_rows, ks, vs, lens)
+        cache = install_lengths(cache, slots, lens)
+    else:
+        cache = write_prompts(cache, slots, ks, vs, lens)
     sampling = admit_sampling(
         sampling, slots, temps, topks, topps, seeds, eos, jsonm
     )

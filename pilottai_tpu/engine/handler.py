@@ -111,9 +111,11 @@ class LLMHandler:
             self._started = True
 
     async def stop(self) -> None:
-        if self._started:
-            await self.backend.stop()
-            self._started = False
+        # Unconditional: the backend may have started itself lazily on the
+        # first generate() without flipping _started — gating on the flag
+        # leaked live device threads past stop() (crash at process exit).
+        await self.backend.stop()
+        self._started = False
 
     # ------------------------------------------------------------------ #
 

@@ -126,6 +126,9 @@ class NativeEngine(LLMBackend):
         max_seq = self.config.engine_max_seq or min(self.model_cfg.max_seq_len, 2048)
         # Placement flows from the params' NamedShardings; jit propagates
         # them through the cache and activations, no mesh context needed.
+        paged = self.config.engine_paged_kv
+        if paged is None:
+            paged = max_seq >= 4096
         self.batcher = ContinuousBatcher(
             self.model_cfg,
             params,
@@ -135,6 +138,9 @@ class NativeEngine(LLMBackend):
             chunk_size=self.config.engine_chunk,
             on_tpu=(self.platform != "cpu" and devices[0].platform == "tpu"),
             mesh=self.mesh,
+            paged=paged,
+            page_size=self.config.engine_page_size,
+            num_pages=self.config.engine_kv_pages,
         )
         self.batcher.start()
         self.batcher.warmup()

@@ -18,9 +18,10 @@ stale bytes past it are masked at attention time, so freeing a slot is a
 single scalar write. Admission/eviction happen on the host between device
 chunks; the device only ever sees full, fixed-shape arrays.
 
-New TPU-native surface (the reference has no KV anything). A paged
-(block-table) variant for long ragged contexts is planned but NOT yet
-implemented; this dense cache is the only one in-tree.
+New TPU-native surface (the reference has no KV anything). This dense
+cache is the default for short contexts; long ragged contexts use the
+paged (block-table) cache in ``ops/paged.py`` with the Pallas kernel in
+``ops/pallas/paged_attention.py`` (``LLMConfig.engine_paged_kv``).
 """
 
 from __future__ import annotations

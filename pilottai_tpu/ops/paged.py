@@ -1,0 +1,255 @@
+"""Paged KV cache: block-table indirection over a shared page pool.
+
+The dense cache (``ops/kvcache.py``) reserves ``slots × max_seq`` HBM
+whether contexts use it or not — at 32 slots × 8 K context × 16 layers
+that is more HBM than a v5e has. Here each layer owns one page pool
+``[K, num_pages, P, H]`` (K-major, so a page is a contiguous ``[P, H]``
+panel per kv-head) and slots map positions to pages through a block
+table; a slot holding 300 tokens pins 3 pages, not an 8 K row.
+
+Division of labor:
+
+* **Allocation is host-side** (``PageAllocator``): a free-list push/pop
+  per admission/completion. The block table is a small host numpy array
+  passed into each device dispatch (8 KB for 32×64 — sub-ms H2D), so
+  the device carries no allocator state and admission backpressure is
+  just "not enough free pages → request stays pending".
+* **Pages are allocated for prompt + full generation budget up front**,
+  so no mid-decode growth path exists; completion frees them all.
+* Device ops here mirror the dense API: batched prompt scatter, ring
+  scatter at chunk end, gather-based prefix attention reads (the Pallas
+  paged-attention kernel in ``ops/pallas/paged_attention.py`` replaces
+  the gather on TPU).
+
+Design follows the ragged/paged attention literature cited in PAPERS.md;
+closes VERDICT.md next-step 7 (the docstring-only "paged variant" of
+round 1). No reference counterpart (the reference has no KV anything —
+it calls a remote API, ``pilott/engine/llm.py:59``).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class PagedKVCache(NamedTuple):
+    # per-layer (k_pool, v_pool), each [K, num_pages, P, H]. The LAST page
+    # (index num_pages - 1) is a scratch page: scatter targets for dropped
+    # writes and gather source for unallocated table slots — never handed
+    # to the allocator.
+    layers: Tuple[Tuple[jax.Array, jax.Array], ...]
+    lengths: jax.Array  # [B] int32 — valid tokens per slot
+
+    @property
+    def n_layers(self) -> int:
+        return len(self.layers)
+
+    @property
+    def n_kv_heads(self) -> int:
+        return self.layers[0][0].shape[0]
+
+    @property
+    def num_pages(self) -> int:
+        return self.layers[0][0].shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.layers[0][0].shape[2]
+
+    @property
+    def head_dim(self) -> int:
+        return self.layers[0][0].shape[3]
+
+    @property
+    def n_slots(self) -> int:
+        return self.lengths.shape[0]
+
+    @classmethod
+    def create(
+        cls,
+        n_layers: int,
+        n_slots: int,
+        num_pages: int,
+        page_size: int,
+        n_kv_heads: int,
+        head_dim: int,
+        dtype=jnp.bfloat16,
+    ) -> "PagedKVCache":
+        shape = (n_kv_heads, num_pages, page_size, head_dim)
+        layers = tuple(
+            (jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype))
+            for _ in range(n_layers)
+        )
+        return cls(
+            layers=layers, lengths=jnp.zeros((n_slots,), dtype=jnp.int32)
+        )
+
+
+class PageAllocator:
+    """Host-side free-list + block table (single-threaded: the device
+    thread owns admission and completion bookkeeping)."""
+
+    def __init__(self, num_pages: int, page_size: int, n_slots: int,
+                 max_pages_per_slot: int) -> None:
+        # Page num_pages - 1 is the device scratch page; never allocate it.
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.sentinel = num_pages - 1
+        self.free: List[int] = list(range(num_pages - 1))
+        self.table = np.full((n_slots, max_pages_per_slot), self.sentinel,
+                             np.int32)
+        self._held: List[List[int]] = [[] for _ in range(n_slots)]
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return -(-max(n_tokens, 1) // self.page_size)
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        n = self.pages_needed(n_tokens)
+        return n <= len(self.free) and n <= self.table.shape[1]
+
+    def allocate(self, slot: int, n_tokens: int) -> bool:
+        """Reserve pages covering n_tokens for a fresh slot. False (and no
+        change) when the pool can't cover it — caller leaves the request
+        pending."""
+        n = self.pages_needed(n_tokens)
+        if n > len(self.free) or n > self.table.shape[1]:
+            return False
+        assert not self._held[slot], f"slot {slot} still holds pages"
+        got = [self.free.pop() for _ in range(n)]
+        self._held[slot] = got
+        self.table[slot, :] = self.sentinel
+        self.table[slot, : n] = got
+        return True
+
+    def release(self, slot: int) -> None:
+        self.free.extend(self._held[slot])
+        self._held[slot] = []
+        self.table[slot, :] = self.sentinel
+
+    @property
+    def free_pages(self) -> int:
+        return len(self.free)
+
+
+def write_prompts_paged(
+    cache: PagedKVCache,
+    table: jax.Array,     # [A, max_pages] int32 — page rows of the admitted
+                          # slots (sentinel where unallocated)
+    ks: jax.Array,        # [L, A, T, K, H]
+    vs: jax.Array,
+    lengths: jax.Array,   # [A] int32; <= 0 marks a padding row
+) -> PagedKVCache:
+    """Scatter freshly prefilled prompts into their slots' pages. T (the
+    prefill bucket) need not be page-aligned; positions past ``lengths``
+    land on allocated-but-masked space or on the sentinel scratch page."""
+    L, A, T, K, H = ks.shape
+    P = cache.page_size
+    n_blocks = -(-T // P)
+    Tp = n_blocks * P
+    pos = jnp.arange(Tp)                                     # [Tp]
+    blk = pos // P
+    # Page id per (row, position); sentinel when the position is beyond
+    # the row's valid length or its allocation.
+    pages = jnp.take_along_axis(
+        table, jnp.broadcast_to(blk[None, :], (A, Tp)), axis=1
+    )                                                        # [A, Tp]
+    live = pos[None, :] < lengths[:, None]                   # [A, Tp]
+    pages = jnp.where(live, pages, cache.num_pages - 1)
+    off = jnp.broadcast_to((pos % P)[None, :], (A, Tp))
+    pages_f = pages.reshape(-1)                              # [A*Tp]
+    off_f = off.reshape(-1)
+
+    new_layers = []
+    for li, (kp, vp) in enumerate(cache.layers):
+        # [A, T, K, H] -> pad T to Tp -> [K, A*Tp, H]
+        k_new = ks[li]
+        v_new = vs[li]
+        if Tp != T:
+            pad = ((0, 0), (0, Tp - T), (0, 0), (0, 0))
+            k_new = jnp.pad(k_new, pad)
+            v_new = jnp.pad(v_new, pad)
+        k_new = k_new.transpose(2, 0, 1, 3).reshape(K, A * Tp, H)
+        v_new = v_new.transpose(2, 0, 1, 3).reshape(K, A * Tp, H)
+        kp = kp.at[:, pages_f, off_f].set(k_new, mode="drop")
+        vp = vp.at[:, pages_f, off_f].set(v_new, mode="drop")
+        new_layers.append((kp, vp))
+    return cache._replace(layers=tuple(new_layers))
+
+
+def install_lengths(
+    cache: PagedKVCache,
+    slots: jax.Array,    # [A] int32 (OOB rows dropped)
+    lengths: jax.Array,  # [A]
+) -> PagedKVCache:
+    return cache._replace(
+        lengths=cache.lengths.at[slots].set(
+            jnp.maximum(lengths, 0), mode="drop"
+        )
+    )
+
+
+def write_chunk_rows_paged(
+    cache: PagedKVCache,
+    table: jax.Array,     # [B, max_pages] int32 — full block table
+    ring_ks: Sequence[jax.Array],  # per layer [B, K, n, H]
+    ring_vs: Sequence[jax.Array],
+    start: jax.Array,     # [B]
+    accepted: jax.Array,  # [B]
+) -> PagedKVCache:
+    """Chunk-end scatter of the decode ring into pages (paged counterpart
+    of ``ops/kvcache.py:write_chunk_rows``)."""
+    B = cache.n_slots
+    P = cache.page_size
+    n = ring_ks[0].shape[2]
+    j = jnp.arange(n)[None, :]
+    pos = start[:, None] + j                                 # [B, n]
+    max_pos = table.shape[1] * P - 1
+    blk = jnp.minimum(pos, max_pos) // P
+    pages = jnp.take_along_axis(table, blk, axis=1)          # [B, n]
+    pages = jnp.where(j < accepted[:, None], pages, cache.num_pages - 1)
+    pages_f = pages.reshape(-1)                              # [B*n]
+    off_f = (pos % P).reshape(-1)
+
+    new_layers = []
+    for (kp, vp), rk, rv in zip(cache.layers, ring_ks, ring_vs):
+        k_new = rk.transpose(1, 0, 2, 3).reshape(
+            cache.n_kv_heads, B * n, cache.head_dim
+        )
+        v_new = rv.transpose(1, 0, 2, 3).reshape(
+            cache.n_kv_heads, B * n, cache.head_dim
+        )
+        kp = kp.at[:, pages_f, off_f].set(k_new, mode="drop")
+        vp = vp.at[:, pages_f, off_f].set(v_new, mode="drop")
+        new_layers.append((kp, vp))
+    new_lengths = cache.lengths + jnp.minimum(accepted, n)
+    return cache._replace(layers=tuple(new_layers), lengths=new_lengths)
+
+
+def gather_pages(
+    pool: jax.Array,      # [K, num_pages, P, H]
+    table: jax.Array,     # [B, max_pages]
+    n_blocks: int,        # static — bucketed ceil(bound / P)
+) -> jax.Array:
+    """XLA fallback read: materialize the first ``n_blocks`` pages of each
+    slot as dense [B, K, n_blocks*P, H] panels (CPU tests / off-TPU).
+    Sentinel entries gather scratch-page garbage — masked by lengths at
+    attention time exactly like the dense cache's stale bytes."""
+    K, _, P, H = pool.shape
+    B = table.shape[0]
+    idx = table[:, :n_blocks]                                # [B, nb]
+    g = pool[:, idx]                                         # [K, B, nb, P, H]
+    return g.transpose(1, 0, 2, 3, 4).reshape(B, K, n_blocks * P, H)
+
+
+__all__ = [
+    "PagedKVCache",
+    "PageAllocator",
+    "write_prompts_paged",
+    "write_chunk_rows_paged",
+    "install_lengths",
+    "gather_pages",
+]

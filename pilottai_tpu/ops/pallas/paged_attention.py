@@ -1,0 +1,185 @@
+"""Paged decode attention as a Pallas TPU kernel.
+
+The paged cache (``ops/paged.py``) stores K/V in a shared page pool with
+block-table indirection; this kernel reads ONLY the pages a slot
+actually occupies. The trick is scalar-prefetched index maps: the block
+table lands in SMEM before the grid runs, and each (slot, page-slot)
+grid cell's BlockSpec *computes its pool coordinates from the table* —
+pages stream HBM→VMEM directly by id, no dense [B, S, H] gather ever
+exists.
+
+Grid is (B, bounded-page-count) with the page dim innermost; the
+(acc, m, l) online-softmax outputs map to the same block for every page
+step, so they stay VMEM-resident and accumulate across pages (the same
+revisited-output reduction the flash backward uses). Cells whose page
+slot is unallocated or fully past the valid length clamp their DMA to
+the scratch page and skip compute with ``pl.when``.
+
+Returns unnormalized (acc, m, l) stats — the fused decode chunk
+(``engine/decode.py``) combines them with the in-chunk ring attention,
+same contract as ``decode_attention(return_stats=True)``.
+
+Design follows the ragged paged attention literature cited in PAPERS.md.
+No reference counterpart; VERDICT.md next-step 7.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -2.0**30
+
+
+def _paged_kernel(
+    table_ref,  # SMEM (B, max_pages) int32 (scalar prefetch)
+    last_ref,   # SMEM (B,) int32 — max valid key index per slot
+    qpos_ref,   # SMEM (B,) int32 — query absolute position (sliding window)
+    q_ref,      # VMEM (1, K, G, H)
+    k_ref,      # VMEM (K, 1, P, H) — one page, all kv heads
+    v_ref,      # VMEM (K, 1, P, H)
+    acc_ref,    # VMEM (1, K, G, H) fp32 — revisited across the page dim
+    m_ref,      # VMEM (1, K, G, 1) fp32
+    l_ref,      # VMEM (1, K, G, 1) fp32
+    *,
+    scale: float,
+    softcap: float,
+    window: int,
+    page_size: int,
+    sentinel: int,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    last = last_ref[b]
+    qpos = qpos_ref[b]
+    page = table_ref[b, j]
+    j0 = j * page_size
+    live = (page != sentinel) & (j0 <= last)
+    if window > 0:
+        live &= (qpos - (j0 + page_size - 1)) < window
+
+    @pl.when(live)
+    def _attend():
+        q = q_ref[0]                                          # [K, G, H]
+        k = k_ref[:, 0]                                       # [K, P, H]
+        v = v_ref[:, 0]
+        s = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale                                             # [K, G, P]
+        if softcap > 0.0:
+            s = jnp.tanh(s / softcap) * softcap
+        col = j0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        mask = col <= last
+        if window > 0:
+            mask &= (qpos - col) < window
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[0, :, :, :]                            # [K, G, 1]
+        l_prev = l_ref[0, :, :, :]
+        acc_prev = acc_ref[0]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)            # [K, G, 1]
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.where(m_new > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
+        corr = jnp.where(
+            m_prev > NEG_INF / 2, jnp.exp(m_prev - m_new), 0.0
+        )
+        l_ref[0, :, :, :] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )                                                     # [K, G, H]
+        acc_ref[0] = acc_prev * corr + pv
+        m_ref[0, :, :, :] = m_new
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_blocks", "scale", "softcap", "window", "interpret"
+    ),
+)
+def paged_decode_attention(
+    q: jax.Array,        # [B, N, H] current-token queries
+    k_pool: jax.Array,   # [K, num_pages, P, H]
+    v_pool: jax.Array,
+    table: jax.Array,    # [B, max_pages] int32 (sentinel = num_pages - 1)
+    last_valid: jax.Array,   # [B] int32 — keys at s <= last_valid[b] attend
+    q_positions: Optional[jax.Array] = None,  # [B]; defaults to last_valid
+    n_blocks: int = 0,   # static — page slots to visit (bounded by host)
+    scale: Optional[float] = None,
+    softcap: float = 0.0,
+    window: int = 0,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Ragged paged GQA decode attention. Returns unnormalized
+    ``(acc [B,N,H] fp32, m [B,N], l [B,N])`` online-softmax stats over
+    each slot's first ``n_blocks`` pages."""
+    B, N, H = q.shape
+    K, num_pages, P, _ = k_pool.shape
+    assert N % K == 0
+    G = N // K
+    assert 1 <= n_blocks <= table.shape[1]
+    scale = scale if scale is not None else H ** -0.5
+    sentinel = num_pages - 1
+
+    qg = q.reshape(B, K, G, H)
+    last_valid = jnp.asarray(last_valid, jnp.int32).reshape(B)
+    if q_positions is None:
+        q_positions = last_valid
+    q_positions = jnp.asarray(q_positions, jnp.int32).reshape(B)
+    table = jnp.asarray(table, jnp.int32)
+
+    kernel = functools.partial(
+        _paged_kernel,
+        scale=scale, softcap=softcap, window=window,
+        page_size=P, sentinel=sentinel,
+    )
+
+    def page_map(b, j, table_ref, last_ref, qpos_ref):
+        # Clamp sentinel to a real page id: the DMA must target valid
+        # memory; the kernel's `live` predicate skips the compute.
+        return (0, jnp.minimum(table_ref[b, j], sentinel), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,  # table, last, qpos in SMEM
+        grid=(B, n_blocks),
+        in_specs=[
+            pl.BlockSpec((1, K, G, H), lambda b, j, *_: (b, 0, 0, 0)),
+            pl.BlockSpec((K, 1, P, H), page_map),
+            pl.BlockSpec((K, 1, P, H), page_map),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, K, G, H), lambda b, j, *_: (b, 0, 0, 0)),
+            pl.BlockSpec((1, K, G, 1), lambda b, j, *_: (b, 0, 0, 0)),
+            pl.BlockSpec((1, K, G, 1), lambda b, j, *_: (b, 0, 0, 0)),
+        ),
+    )
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((B, K, G, H), jnp.float32),
+            jax.ShapeDtypeStruct((B, K, G, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B, K, G, 1), jnp.float32),
+        ),
+        interpret=interpret,
+    )(table, last_valid, q_positions, qg, k_pool, v_pool)
+    return acc.reshape(B, N, H), m.reshape(B, N), l.reshape(B, N)
+
+
+__all__ = ["paged_decode_attention"]

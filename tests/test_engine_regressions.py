@@ -150,18 +150,25 @@ def test_first_token_sampling_honors_top_p():
 async def test_concurrent_start_single_batcher():
     from pilottai_tpu.engine.native import NativeEngine
 
+    import threading
+
     engine = NativeEngine(
         LLMConfig(model_name="llama-tiny", provider="cpu", engine_max_seq=128),
         platform="cpu",
     )
+    # Count only threads this test creates — a prior test's device loop may
+    # still be winding down (stop() joins, but daemon threads can linger).
+    before = {
+        t for t in threading.enumerate() if t.name == "pilottai-device-loop"
+    }
     try:
         await asyncio.gather(engine.start(), engine.start(), engine.start())
         assert engine.batcher is not None
-        threads = [
-            t for t in __import__("threading").enumerate()
+        after = {
+            t for t in threading.enumerate()
             if t.name == "pilottai-device-loop"
-        ]
-        assert len(threads) == 1
+        }
+        assert len(after - before) == 1
     finally:
         await engine.stop()
 
@@ -230,3 +237,28 @@ def test_donated_admit_failure_rebuilds_state():
     finally:
         bmod.admit_group = real_admit
         batcher.stop()
+
+
+@pytest.mark.asyncio
+async def test_stop_after_lazy_start_kills_device_threads():
+    """generate() starts the backend lazily without flipping the handler's
+    _started flag; stop() must still stop the backend, or live device
+    threads outlast the handler and crash the process at exit (verify
+    finding, round 2)."""
+    import threading
+
+    h = LLMHandler(LLMConfig(
+        model_name="llama-tiny", provider="cpu", engine_slots=2,
+        engine_max_seq=64, engine_chunk=4, dtype="float32",
+    ))
+    before = {
+        t for t in threading.enumerate() if t.name == "pilottai-device-loop"
+    }
+    # No explicit start(): the engine boots inside the first generate.
+    await h.apredict("hello", params=GenerationParams(max_new_tokens=3))
+    await h.stop()
+    after = {
+        t for t in threading.enumerate()
+        if t.name == "pilottai-device-loop" and t.is_alive() and t not in before
+    }
+    assert not after, f"device threads leaked past stop(): {after}"
