@@ -127,21 +127,29 @@ class ContinuousBatcher:
             on_tpu = jax.default_backend() == "tpu"
         self.on_tpu = on_tpu
         if use_pallas is None:
-            # Measured on v5e: with the cache read-only inside the chunk
-            # scan, XLA's dense attention beats the Pallas prefix kernel at
-            # both S=512 and S=2048 — the kernel stays available for A/B
-            # via PILOTTAI_DECODE_PALLAS=1.
-            import os
+            if paged:
+                # The paged kernel is the point of paging on TPU: its VMEM
+                # need is one page (K*P*H), and the XLA fallback gathers
+                # dense slots×bound panels per layer — the footprint the
+                # paged cache exists to avoid.
+                use_pallas = self.on_tpu
+            else:
+                # Dense mode. Measured on v5e: with the cache read-only
+                # inside the chunk scan, XLA's dense attention beats the
+                # Pallas prefix kernel at both S=512 and S=2048 — the
+                # kernel stays available for A/B via
+                # PILOTTAI_DECODE_PALLAS=1.
+                import os
 
-            use_pallas = (
-                os.environ.get("PILOTTAI_DECODE_PALLAS", "").lower()
-                in ("1", "true", "yes")
-                and self.on_tpu
-                and decode_shapes_ok(
-                    self.max_seq_len, cfg.head_dim,
-                    jnp.dtype(cache_dtype).itemsize,
+                use_pallas = (
+                    os.environ.get("PILOTTAI_DECODE_PALLAS", "").lower()
+                    in ("1", "true", "yes")
+                    and self.on_tpu
+                    and decode_shapes_ok(
+                        self.max_seq_len, cfg.head_dim,
+                        jnp.dtype(cache_dtype).itemsize,
+                    )
                 )
-            )
         self.use_pallas = use_pallas
         # Multi-chip serving mesh: prefill's flash kernel runs per-shard
         # under shard_map (ops/pallas/flash_attention.py). One device →

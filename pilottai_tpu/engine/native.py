@@ -105,18 +105,42 @@ class NativeEngine(LLMBackend):
                 dtype=self.model_cfg.dtype,
             )
         else:
-            params = init_params(
-                self.model_cfg, jax.random.PRNGKey(self.config.seed)
-            )
-            params = shard_params(
-                params, param_logical_axes(self.model_cfg), self.mesh
-            )
+            # Random init. Single chip + int8: quantize leaf-by-leaf at
+            # generation time — a full bf16 8B tree alone would overflow a
+            # 16 GB chip before quantize_params could shrink it. Multi-
+            # chip: init dense and shard first (per-chip shards fit), then
+            # the quantize pass below shrinks the sharded leaves.
+            single = len(devices) == 1
+            if single:
+                # Eager init ops follow the DEFAULT backend, which is not
+                # necessarily this engine's (a cpu-provider engine on a
+                # TPU host must not land its params on the TPU) — pin the
+                # device for the whole init.
+                with jax.default_device(devices[0]):
+                    params = init_params(
+                        self.model_cfg, jax.random.PRNGKey(self.config.seed),
+                        quantize=(self.config.quantize == "int8"),
+                    )
+                # Commit (default_device arrays are uncommitted and jit
+                # would migrate them back to the default backend).
+                params = jax.device_put(params, devices[0])
+            else:
+                params = init_params(
+                    self.model_cfg, jax.random.PRNGKey(self.config.seed)
+                )
+                params = shard_params(
+                    params, param_logical_axes(self.model_cfg), self.mesh
+                )
         if self.config.quantize == "int8":
             from pilottai_tpu.models.quant import quantize_params
 
             # Weight-only int8 on device: halves the decode weight stream
-            # AND the params' HBM footprint (originals freed after this).
-            params = quantize_params(params, dtype=self.model_cfg.dtype)
+            # AND the params' HBM footprint (already-quantized leaves from
+            # the init path pass through untouched; donation keeps the 8B
+            # tree from being double-resident).
+            params = quantize_params(
+                params, dtype=self.model_cfg.dtype, donate=True
+            )
             self._log.info("quantized matmul weights to int8 (weight-only)")
         elif self.config.quantize:
             raise ValueError(

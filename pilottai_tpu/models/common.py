@@ -9,6 +9,7 @@ subclasses, so the single ``transformer.py`` forward stays scan-friendly.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -84,20 +85,55 @@ class ModelConfig:
 
 
 def init_params(
-    cfg: ModelConfig, key: jax.Array, dtype: Optional[Any] = None
+    cfg: ModelConfig, key: jax.Array, dtype: Optional[Any] = None,
+    quantize: bool = False,
 ) -> Dict[str, Any]:
     """Random-init a parameter pytree with stacked layers.
 
     Layer params carry a leading [L] axis so the forward pass can
     ``lax.scan`` over depth — compile time stays O(1) in n_layers, which
     matters on TPU where the first jit is the slow step.
+
+    ``quantize=True`` emits matmul weights directly as int8 ``QTensor``s
+    (models/quant.py), quantizing each leaf eagerly as it is generated —
+    the bf16 intermediate frees leaf by leaf, so an 8B model peaks at
+    ~(int8 tree + one layer-stack leaf) instead of the full bf16 tree
+    plus the int8 copy. That is what lets llama3-8b random-init fit a
+    single 16 GB v5e. Norms, embeds, and the MoE router stay dense,
+    matching ``quantize_params``.
     """
     dtype = dtype or cfg.dtype
     E, F, V, L = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.n_layers
     keys = jax.random.split(key, 8)
 
-    def normal(k, shape, fan_in):
+    def dense(k, shape, fan_in):
         return (jax.random.normal(k, shape, dtype=jnp.float32) * fan_in**-0.5).astype(dtype)
+
+    def normal(k, shape, fan_in):
+        if not (quantize and len(shape) >= 3):
+            return dense(k, shape, fan_in)
+        # Generate + quantize one leading (layer) slice per fused dispatch:
+        # eager whole-leaf generation keeps multiple fp32 intermediates of
+        # the biggest MLP leaf alive at once (~15 GB for 8B) — per-slice,
+        # the transient is a few hundred MB and the int8 result is all
+        # that accumulates.
+        from pilottai_tpu.models.quant import QTensor, quantize_array
+
+        @functools.partial(jax.jit, static_argnames=("shp", "fi"))
+        def gen_chunk(k, shp, fi):
+            w = (
+                jax.random.normal(k, shp, dtype=jnp.float32) * fi**-0.5
+            ).astype(dtype)
+            return quantize_array(w, dtype)
+
+        chunks = [
+            gen_chunk(kk, shape[1:], fan_in)
+            for kk in jax.random.split(k, shape[0])
+        ]
+        return QTensor(
+            q=jnp.stack([c.q for c in chunks]),
+            s=jnp.stack([c.s for c in chunks]),
+        )
 
     layers: Dict[str, Any] = {
         "ln1": {"scale": jnp.zeros((L, E), dtype) if cfg.rms_offset else jnp.ones((L, E), dtype)},
@@ -112,7 +148,9 @@ def init_params(
     if cfg.n_experts > 0:
         X = cfg.n_experts
         layers["moe"] = {
-            "router": normal(jax.random.fold_in(keys[4], 7), (L, E, X), E),
+            # Router stays dense even under quantize — its logits pick
+            # which experts run (see quantize_params).
+            "router": dense(jax.random.fold_in(keys[4], 7), (L, E, X), E),
             "wg": normal(keys[4], (L, X, E, F), E),
             "wu": normal(keys[5], (L, X, E, F), E),
             "wd": normal(keys[6], (L, X, F, E), F),

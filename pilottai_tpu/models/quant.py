@@ -23,6 +23,7 @@ agent-step target (BASELINE.md).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, NamedTuple
 
 import jax
@@ -56,15 +57,22 @@ def quantize_array(w: jax.Array, dtype=jnp.bfloat16) -> QTensor:
     return QTensor(q=q.astype(jnp.int8), s=scale.astype(dtype))
 
 
-def quantize_params(params: Any, dtype=jnp.bfloat16) -> Any:
+def quantize_params(params: Any, dtype=jnp.bfloat16, donate: bool = False) -> Any:
     """Quantize every stacked matmul weight (ndim >= 3 under ``layers``,
     plus an untied ``lm_head``). Embeds/norms stay dense. Runs under jit
     so the int8 tensors are produced on device and the full-precision
-    originals can be freed."""
+    originals can be freed.
+
+    ``donate=True`` consumes the input tree: untouched leaves (norms,
+    embeds, already-quantized QTensors) alias through instead of being
+    copied — without this the pass-through copy of an 8B tree doubles
+    HBM and OOMs a v5e. The caller's reference becomes invalid."""
 
     from jax.tree_util import tree_map_with_path
 
     def _quant_leaf(path, a):
+        if isinstance(a, QTensor):  # already quantized (init-time path)
+            return a
         keys = {getattr(k, "key", None) for k in path}
         # Norm scales are 2D-stacked (skip by ndim); the MoE router stays
         # dense — its logits drive top-k expert selection, the one matmul
@@ -74,11 +82,14 @@ def quantize_params(params: Any, dtype=jnp.bfloat16) -> Any:
             return a
         return quantize_array(a, dtype)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def _quant(p):
         out = dict(p)
-        out["layers"] = tree_map_with_path(_quant_leaf, p["layers"])
-        if "lm_head" in p:
+        out["layers"] = tree_map_with_path(
+            _quant_leaf, p["layers"],
+            is_leaf=lambda x: isinstance(x, QTensor),
+        )
+        if "lm_head" in p and not isinstance(p["lm_head"], QTensor):
             out["lm_head"] = quantize_array(p["lm_head"], dtype)
         return out
 

@@ -93,3 +93,28 @@ def test_engine_serves_int8():
 
     out = asyncio.run(main())
     assert isinstance(out, str) and len(out) > 0
+
+
+def test_init_params_direct_int8():
+    """init_params(quantize=True) emits QTensor matmul weights directly
+    (per-layer-slice generation — the path that lets llama3-8b random-init
+    fit one 16 GB chip), and quantize_params passes them through
+    untouched instead of double-quantizing."""
+    cfg = get_model_config("llama-tiny")
+    qp = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32,
+                     quantize=True)
+    lp = qp["layers"]
+    assert isinstance(lp["attn"]["wq"], QTensor)
+    assert isinstance(lp["mlp"]["wd"], QTensor)
+    assert lp["attn"]["wq"].q.shape == (cfg.n_layers, cfg.hidden_size, cfg.q_dim)
+    assert lp["attn"]["wq"].s.shape == (cfg.n_layers, 1, cfg.q_dim)
+    assert not isinstance(lp["ln1"]["scale"], QTensor)
+    again = quantize_params(qp, dtype=jnp.float32)
+    assert isinstance(again["layers"]["attn"]["wq"], QTensor)
+    assert not isinstance(again["layers"]["attn"]["wq"].q, QTensor)
+
+    tokens = jnp.ones((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8)[None], (1, 8)).astype(jnp.int32)
+    lq, _, _ = forward_prefill(qp, cfg, tokens, pos,
+                               jnp.full((1,), 8, jnp.int32), use_flash=False)
+    assert not bool(jnp.isnan(lq).any())
