@@ -1,0 +1,151 @@
+"""Live metrics endpoint: the real replacement for the reference's
+static marketing SPA (``/root/reference/interface/src`` shows hardcoded
+stats like "10x Faster Development", ``Performance.js:8-20``; SURVEY.md
+§2.19 notes a real metrics dashboard would supersede it).
+
+Stdlib-only (http.server on a daemon thread), two routes:
+
+* ``/metrics.json`` — the live ``global_metrics`` snapshot (counters,
+  gauges, histogram summaries) merged with the bound component's
+  ``get_metrics()`` (a ``Serve``, an ``LLMHandler`` — anything with that
+  method).
+* ``/`` — a self-refreshing HTML table over the same JSON.
+
+Read-only and unauthenticated by design: bind to localhost (the default)
+and port-forward, the same operational posture as a debug/metrics port.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from pilottai_tpu.utils.logging import get_logger
+from pilottai_tpu.utils.metrics import global_metrics
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>pilottai-tpu metrics</title>
+<style>
+ body { font-family: ui-monospace, monospace; margin: 2rem; }
+ table { border-collapse: collapse; margin-bottom: 1.5rem; }
+ td, th { border: 1px solid #999; padding: 0.25rem 0.6rem; text-align: left; }
+ caption { font-weight: bold; text-align: left; padding: 0.3rem 0; }
+</style></head>
+<body>
+<h1>pilottai-tpu metrics</h1>
+<p id="ts"></p>
+<div id="root">loading…</div>
+<script>
+function table(title, obj) {
+  if (!obj || !Object.keys(obj).length) return null;
+  // DOM construction with textContent — metric names and component
+  // values are data, never markup (task/agent names are user-controlled).
+  const t = document.createElement("table");
+  const cap = document.createElement("caption");
+  cap.textContent = title;
+  t.appendChild(cap);
+  for (const [k, v] of Object.entries(obj)) {
+    const tr = document.createElement("tr");
+    const td1 = document.createElement("td");
+    const td2 = document.createElement("td");
+    td1.textContent = k;
+    td2.textContent = typeof v === "object" ? JSON.stringify(v) : String(v);
+    tr.appendChild(td1); tr.appendChild(td2);
+    t.appendChild(tr);
+  }
+  return t;
+}
+async function refresh() {
+  const r = await fetch("metrics.json");
+  const m = await r.json();
+  document.getElementById("ts").textContent =
+    "uptime " + (m.uptime_s || 0).toFixed(1) + " s — refreshes every 2 s";
+  const root = document.getElementById("root");
+  root.replaceChildren();
+  for (const t of [table("component", m.component),
+                   table("counters", m.counters),
+                   table("gauges", m.gauges),
+                   table("histograms", m.histograms)]) {
+    if (t) root.appendChild(t);
+  }
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+class MetricsDashboard:
+    """Serve live metrics over HTTP. ``source`` is any object exposing
+    ``get_metrics() -> dict`` (Serve, LLMHandler, ContinuousBatcher...);
+    ``port=0`` picks a free port (read it back from ``.port``)."""
+
+    def __init__(
+        self,
+        source: Optional[Any] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ) -> None:
+        self.source = source
+        self._log = get_logger("utils.dashboard")
+        dashboard = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route to our logger
+                dashboard._log.debug(fmt % args)
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                if self.path.split("?")[0] in ("/metrics.json", "/metrics"):
+                    body = json.dumps(
+                        dashboard.snapshot(), default=str
+                    ).encode()
+                    ctype = "application/json"
+                elif self.path.split("?")[0] == "/":
+                    body = _PAGE.encode()
+                    ctype = "text/html; charset=utf-8"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def snapshot(self) -> dict:
+        snap = global_metrics.snapshot()
+        if self.source is not None:
+            try:
+                snap["component"] = self.source.get_metrics()
+            except Exception as exc:  # noqa: BLE001 — metrics must not raise
+                snap["component"] = {"error": str(exc)}
+        return snap
+
+    def start(self) -> "MetricsDashboard":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever,
+                name="pilottai-dashboard",
+                daemon=True,
+            )
+            self._thread.start()
+            self._log.info(
+                "metrics dashboard at http://%s:%d/", self.host, self.port
+            )
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._server.shutdown()
+            self._thread.join(timeout=10)
+            self._thread = None
+        self._server.server_close()
+
+
+__all__ = ["MetricsDashboard"]

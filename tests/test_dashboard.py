@@ -1,0 +1,58 @@
+"""Live metrics dashboard (utils/dashboard.py) — supersedes the
+reference's static marketing stats (SURVEY.md §2.19)."""
+
+import json
+import urllib.error
+import urllib.request
+
+from pilottai_tpu.utils.dashboard import MetricsDashboard
+from pilottai_tpu.utils.metrics import global_metrics
+
+
+class _FakeServe:
+    def get_metrics(self):
+        return {"tasks_completed": 7, "agents": 2}
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.headers.get_content_type(), r.read()
+
+
+def test_dashboard_serves_metrics_json_and_html():
+    global_metrics.inc("dash.test_counter", 3)
+    global_metrics.observe("dash.test_hist", 0.5)
+    d = MetricsDashboard(source=_FakeServe(), port=0).start()
+    try:
+        status, ctype, body = _get(f"http://127.0.0.1:{d.port}/metrics.json")
+        assert status == 200 and ctype == "application/json"
+        m = json.loads(body)
+        assert m["counters"]["dash.test_counter"] >= 3
+        assert "dash.test_hist" in m["histograms"]
+        assert m["component"] == {"tasks_completed": 7, "agents": 2}
+
+        status, ctype, body = _get(f"http://127.0.0.1:{d.port}/")
+        assert status == 200 and ctype == "text/html"
+        assert b"pilottai-tpu metrics" in body
+
+        try:
+            _get(f"http://127.0.0.1:{d.port}/nope")
+            raise AssertionError("404 expected")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        d.stop()
+
+
+def test_dashboard_source_errors_do_not_break_endpoint():
+    class Bad:
+        def get_metrics(self):
+            raise RuntimeError("boom")
+
+    d = MetricsDashboard(source=Bad(), port=0).start()
+    try:
+        _, _, body = _get(f"http://127.0.0.1:{d.port}/metrics.json")
+        m = json.loads(body)
+        assert "error" in m["component"]
+    finally:
+        d.stop()
