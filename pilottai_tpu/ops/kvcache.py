@@ -35,6 +35,11 @@ import jax.numpy as jnp
 class KVCache(NamedTuple):
     layers: Tuple[Tuple[jax.Array, jax.Array], ...]  # per-layer (k, v) [B, K, S, H]
     lengths: jax.Array                               # [B] int32 — valid entries
+    # Per-layer (k_scale, v_scale) [B, K, S] when the panels are int8
+    # (symmetric per-token-per-head); None for full-precision panels.
+    # Decode is HBM-bound and the cache is ~1/3 of its traffic at short
+    # contexts — int8 halves that for ~1e-3 relative attention error.
+    scales: Optional[Tuple[Tuple[jax.Array, jax.Array], ...]] = None
 
     @property
     def n_layers(self) -> int:
