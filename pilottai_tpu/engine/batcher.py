@@ -405,7 +405,7 @@ class ContinuousBatcher:
             # Only this thread allocates slots, so the picks stay valid
             # after the lock drops; occupied entries land in _prefill_group.
 
-        for group in groups:
+        for gi, group in enumerate(groups):
             try:
                 self._prefill_group(group)
             except Exception as exc:  # noqa: BLE001 — fail these requests only
@@ -430,6 +430,16 @@ class ContinuousBatcher:
                 if self.cache.lengths.is_deleted():
                     self._fail_occupied_slots(exc)
                     self._rebuild_device_state()
+                    # Later groups in this wave were page-allocated in the
+                    # OLD allocator; their table rows mean nothing in the
+                    # fresh one (prefill would scatter every prompt to the
+                    # scratch page and "complete" with garbage). Requeue
+                    # them at the backlog head, in order, so they re-admit
+                    # with fresh allocations next cycle.
+                    for later in reversed(groups[gi + 1:]):
+                        for _, later_req in reversed(later):
+                            self._backlog.appendleft(later_req)
+                    break
 
     def _prefill_group(self, group: List[Tuple[int, GenRequest]]) -> None:
         A = self.admit_batch

@@ -26,7 +26,7 @@ paged (block-table) cache in ``ops/paged.py`` with the Pallas kernel in
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -116,7 +116,7 @@ def write_prompts(
         new_lengths = jax.lax.dynamic_update_slice(
             new_lengths, jnp.maximum(lengths[a], 0)[None], (safe_slots[a],)
         )
-    return KVCache(layers=tuple(new_layers), lengths=new_lengths)
+    return cache._replace(layers=tuple(new_layers), lengths=new_lengths)
 
 
 def write_chunk_rows(
@@ -145,7 +145,7 @@ def write_chunk_rows(
         v = v.at[bidx, :, pos].set(rv.transpose(0, 2, 1, 3), mode="drop")
         new_layers.append((k, v))
     new_lengths = jnp.minimum(cache.lengths + accepted, S)
-    return KVCache(layers=tuple(new_layers), lengths=new_lengths)
+    return cache._replace(layers=tuple(new_layers), lengths=new_lengths)
 
 
 def free_slots(cache: KVCache, slots: jax.Array) -> KVCache:

@@ -225,7 +225,13 @@ def write_chunk_rows_paged(
         kp = kp.at[:, pages_f, off_f].set(k_new, mode="drop")
         vp = vp.at[:, pages_f, off_f].set(v_new, mode="drop")
         new_layers.append((kp, vp))
-    new_lengths = cache.lengths + jnp.minimum(accepted, n)
+    # Clamp to allocated slot capacity (parity with the dense path's min
+    # against S): decode's ctx_full/budget invariants should keep lengths
+    # in range on their own, but a length past allocation would claim
+    # tokens that were actually routed to the scratch page.
+    new_lengths = jnp.minimum(
+        cache.lengths + jnp.minimum(accepted, n), table.shape[1] * P
+    )
     return cache._replace(layers=tuple(new_layers), lengths=new_lengths)
 
 

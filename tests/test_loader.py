@@ -1,0 +1,274 @@
+"""HF checkpoint loader tests (VERDICT r2 missing #3 / next-step 7+9).
+
+``models/loader.py`` is the only path by which real Llama/Gemma weights
+enter the system (reference's provider onboarding:
+``pilott/engine/llm.py:129-151``); until now no test touched it. These
+tests write a tiny synthetic HF-layout safetensors checkpoint in-test
+(no network), load it back, and assert:
+
+* forward parity with the source pytree on one device;
+* sharded load onto the 8-device mesh keeps the logical shardings and
+  the same logits;
+* ``quantize_params(donate=True)`` on the *sharded* loaded tree — the
+  exact 8B-on-mesh path — still serves;
+* the gemma2 name overrides (pre/post feedforward norms) map correctly;
+* the Embedder really uses checkpoint-derived weights (fails if the
+  loader silently fell back to random init — keeps BASELINE config #2's
+  "Gemma-2B encoder" claim honest).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pilottai_tpu.models.common import init_params, param_logical_axes
+from pilottai_tpu.models.loader import load_hf_checkpoint
+from pilottai_tpu.models.registry import get_model_config
+from pilottai_tpu.models.transformer import forward_prefill
+
+
+def _to_hf_layout(cfg, params):
+    """Convert our stacked pytree to HF per-layer tensors (the inverse of
+    load_hf_checkpoint's mapping)."""
+    tensors = {
+        "model.embed_tokens.weight": np.asarray(params["embed"], np.float32),
+        "model.norm.weight": np.asarray(
+            params["final_norm"]["scale"], np.float32
+        ),
+    }
+    layers = params["layers"]
+    gemma2 = cfg.family == "gemma2"
+    for i in range(cfg.n_layers):
+        pre = f"model.layers.{i}."
+        tensors[pre + "input_layernorm.weight"] = np.asarray(
+            layers["ln1"]["scale"][i], np.float32
+        )
+        if gemma2:
+            tensors[pre + "post_attention_layernorm.weight"] = np.asarray(
+                layers["ln1_post"]["scale"][i], np.float32
+            )
+            tensors[pre + "pre_feedforward_layernorm.weight"] = np.asarray(
+                layers["ln2"]["scale"][i], np.float32
+            )
+            tensors[pre + "post_feedforward_layernorm.weight"] = np.asarray(
+                layers["ln2_post"]["scale"][i], np.float32
+            )
+        else:
+            tensors[pre + "post_attention_layernorm.weight"] = np.asarray(
+                layers["ln2"]["scale"][i], np.float32
+            )
+        for ours, hf in (
+            ("wq", "self_attn.q_proj"), ("wk", "self_attn.k_proj"),
+            ("wv", "self_attn.v_proj"), ("wo", "self_attn.o_proj"),
+        ):
+            # ours [in,out] -> HF [out,in]. ascontiguousarray matters:
+            # safetensors 0.8.0 silently serializes the base buffer of a
+            # non-contiguous view (shape says transposed, bytes are not).
+            tensors[pre + hf + ".weight"] = np.ascontiguousarray(
+                np.asarray(layers["attn"][ours][i], np.float32).T
+            )
+        for ours, hf in (
+            ("wg", "mlp.gate_proj"), ("wu", "mlp.up_proj"),
+            ("wd", "mlp.down_proj"),
+        ):
+            tensors[pre + hf + ".weight"] = np.ascontiguousarray(
+                np.asarray(layers["mlp"][ours][i], np.float32).T
+            )
+    if not cfg.tie_embeddings:
+        tensors["lm_head.weight"] = np.ascontiguousarray(
+            np.asarray(params["lm_head"], np.float32).T
+        )
+    return tensors
+
+
+def _write_checkpoint(tmp_path, cfg, params, sharded_files=1):
+    from safetensors.numpy import save_file
+
+    tensors = _to_hf_layout(cfg, params)
+    if sharded_files == 1:
+        save_file(tensors, str(tmp_path / "model.safetensors"))
+    else:
+        # Multi-shard layout with an index file, like every real >2GB HF
+        # checkpoint ships.
+        names = sorted(tensors)
+        per = -(-len(names) // sharded_files)
+        weight_map = {}
+        for s in range(sharded_files):
+            fname = f"model-{s + 1:05d}-of-{sharded_files:05d}.safetensors"
+            chunk = {n: tensors[n] for n in names[s * per: (s + 1) * per]}
+            save_file(chunk, str(tmp_path / fname))
+            for n in chunk:
+                weight_map[n] = fname
+        (tmp_path / "model.safetensors.index.json").write_text(
+            json.dumps({"weight_map": weight_map})
+        )
+    return tmp_path
+
+
+@pytest.fixture(scope="module")
+def llama_ckpt(tmp_path_factory):
+    cfg = get_model_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(7), dtype=jnp.float32)
+    path = _write_checkpoint(
+        tmp_path_factory.mktemp("llama_ckpt"), cfg, params, sharded_files=2
+    )
+    return cfg, params, path
+
+
+def _logits(cfg, params, seed=0):
+    B, T = 2, 16
+    rng = np.random.default_rng(seed)
+    tokens = jnp.asarray(rng.integers(2, cfg.vocab_size, (B, T)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(T)[None], (B, T)).astype(jnp.int32)
+    valid = jnp.asarray([T, T - 5], jnp.int32)
+    out, _, _ = forward_prefill(
+        params, cfg, tokens, positions, valid, use_flash=False
+    )
+    return np.asarray(out)
+
+
+def test_loader_roundtrip_forward_parity(llama_ckpt):
+    cfg, src, path = llama_ckpt
+    loaded = load_hf_checkpoint(cfg, path, dtype=jnp.float32)
+    np.testing.assert_allclose(
+        _logits(cfg, loaded), _logits(cfg, src), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_loader_sharded_mesh_parity(llama_ckpt):
+    from pilottai_tpu.parallel.mesh import MeshConfig, create_mesh
+    from pilottai_tpu.parallel.sharding import named_sharding
+
+    cfg, src, path = llama_ckpt
+    mesh = create_mesh(MeshConfig(model=2, fsdp=2, data=2))
+    loaded = load_hf_checkpoint(cfg, path, mesh=mesh, dtype=jnp.float32)
+    # Every leaf carries the logical sharding the axes table prescribes.
+    axes = param_logical_axes(cfg)
+
+    def check(ax, leaf):
+        assert leaf.sharding == named_sharding(mesh, ax), (
+            f"leaf sharded {leaf.sharding} want {named_sharding(mesh, ax)}"
+        )
+
+    jax.tree.map(
+        check, axes, loaded,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x
+        ),
+    )
+    np.testing.assert_allclose(
+        _logits(cfg, loaded), _logits(cfg, src), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_loader_sharded_then_quantized_serves(llama_ckpt):
+    """The 8B production path in miniature: load sharded, quantize the
+    sharded tree with donation, and run prefill — never exercised before
+    (VERDICT r2 Weak #6)."""
+    from pilottai_tpu.models.quant import quantize_params
+    from pilottai_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    cfg, src, path = llama_ckpt
+    mesh = create_mesh(MeshConfig(model=4), jax.devices()[:4])
+    loaded = load_hf_checkpoint(cfg, path, mesh=mesh, dtype=jnp.float32)
+    quant = quantize_params(loaded, dtype=jnp.float32, donate=True)
+    # int8 carries ~0.4% relative error; compare coarsely but meaningfully.
+    got, want = _logits(cfg, quant), _logits(cfg, src)
+    assert np.mean(np.abs(got - want)) < 0.05 * (np.std(want) + 1e-6)
+
+
+def test_loader_gemma2_name_overrides(tmp_path):
+    """gemma2 checkpoints use pre/post_feedforward_layernorm names; the
+    loader's override table must land them on ln2/ln2_post (a silent
+    mis-mapping would produce a 'working' model with wrong norms)."""
+    cfg = get_model_config("gemma-tiny")
+    src = init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    # Make the four norm families distinguishable: random, not all-zeros
+    # (gemma rms_offset init is zeros — any permutation would "match").
+    k = jax.random.PRNGKey(11)
+    for i, group in enumerate(("ln1", "ln2", "ln1_post", "ln2_post")):
+        src["layers"][group]["scale"] = 0.1 * jax.random.normal(
+            jax.random.fold_in(k, i),
+            src["layers"][group]["scale"].shape,
+            dtype=jnp.float32,
+        )
+    path = _write_checkpoint(tmp_path, cfg, src)
+    loaded = load_hf_checkpoint(cfg, path, dtype=jnp.float32)
+    for group in ("ln1", "ln2", "ln1_post", "ln2_post"):
+        np.testing.assert_allclose(
+            np.asarray(loaded["layers"][group]["scale"]),
+            np.asarray(src["layers"][group]["scale"]),
+            rtol=1e-6,
+            err_msg=f"norm group {group} mis-mapped",
+        )
+    np.testing.assert_allclose(
+        _logits(cfg, loaded), _logits(cfg, src), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_embedder_uses_checkpoint_weights(llama_ckpt):
+    """BASELINE config #2 honesty check: an Embedder given a checkpoint
+    must produce checkpoint-derived embeddings — this fails if the loader
+    path silently falls back to random init."""
+    from pilottai_tpu.memory.embedder import Embedder, _encode_batch
+
+    cfg, src, path = llama_ckpt
+    emb = Embedder("llama-tiny", checkpoint_path=str(path))
+    texts = ["semantic memory check", "a different sentence"]
+    got = emb.encode(texts)
+
+    # Ground truth: same encode pipeline, source params directly.
+    ids = [emb.tokenizer.encode(t)[: emb.max_len] for t in texts]
+    T = emb._bucket(max(len(i) for i in ids))
+    batch = np.zeros((len(ids), T), np.int32)
+    valid = np.zeros((len(ids),), np.int32)
+    for row, seq in enumerate(ids):
+        batch[row, : len(seq)] = seq
+        valid[row] = len(seq)
+    want = np.asarray(_encode_batch(
+        src, emb.cfg, jnp.asarray(batch), jnp.asarray(valid)
+    ))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    # And it is NOT the random-init encoder's output.
+    rand = Embedder("llama-tiny", seed=5)
+    rand_out = rand.encode(texts)
+    assert not np.allclose(got, rand_out, atol=1e-3)
+
+
+def test_engine_serves_from_checkpoint(llama_ckpt):
+    """End-to-end: NativeEngine boots from checkpoint_path (the native.py
+    branch no test previously entered) and generates."""
+    import asyncio
+
+    from pilottai_tpu.core.config import LLMConfig
+    from pilottai_tpu.engine.handler import LLMHandler
+    from pilottai_tpu.engine.types import ChatMessage, GenerationParams
+
+    cfg, _, path = llama_ckpt
+
+    async def run():
+        handler = LLMHandler(LLMConfig(
+            model_name="llama-tiny",
+            provider="cpu",
+            checkpoint_path=str(path),
+            engine_slots=2,
+            engine_max_seq=128,
+            engine_chunk=4,
+            dtype="float32",
+        ))
+        await handler.start()
+        try:
+            resp = await handler.generate_response(
+                [ChatMessage(role="user", content="hello from a checkpoint")],
+                params=GenerationParams(max_new_tokens=6, temperature=0.0),
+            )
+            return resp
+        finally:
+            await handler.stop()
+
+    resp = asyncio.run(run())
+    assert resp.usage.completion_tokens >= 1
