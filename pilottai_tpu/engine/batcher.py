@@ -64,8 +64,9 @@ class GenRequest:
     top_p: float = 1.0
     seed: int = 0
     eos_id: int = -1
-    # Grammar-constrained JSON decoding (byte tokenizers only; the engine
-    # gates it — engine/json_mask.py).
+    # Grammar-constrained JSON decoding (engine/json_mask.py): byte
+    # automaton for byte tokenizers, token→byte product for subword ones
+    # (the batcher's json_tables).
     json_mode: bool = False
     stop_ids: List[int] = field(default_factory=list)
     future: Future = field(default_factory=Future)
@@ -111,6 +112,7 @@ class ContinuousBatcher:
         paged: bool = False,
         page_size: int = 128,
         num_pages: Optional[int] = None,
+        json_tables: Optional[Tuple[Any, Any]] = None,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -158,6 +160,13 @@ class ContinuousBatcher:
             mesh if mesh is not None and mesh.devices.size > 1 else None
         )
         self._log = get_logger("engine.batcher")
+        # Subword JSON grammar tables (token_bytes [V, L], token_len [V])
+        # from json_mask.token_byte_table — None for byte tokenizers,
+        # whose 256-entry byte mask is cheaper.
+        self.json_tables = (
+            tuple(jnp.asarray(t) for t in json_tables)
+            if json_tables is not None else None
+        )
 
         self.cache_dtype = cache_dtype
         # Paged KV: shared page pool + host-side block table/allocator
@@ -468,6 +477,14 @@ class ContinuousBatcher:
             budgets[row] = req.max_new_tokens - 1
 
         positions = np.broadcast_to(np.arange(T, dtype=np.int32)[None], (A, T))
+        # Bake the token tables into this dispatch only when the group
+        # actually constrains: with a 128k-vocab the B x V x L automaton
+        # simulation is pure waste for non-JSON traffic. Two jit variants
+        # total (with/without), both cached after first use.
+        group_json = (
+            self.json_tables
+            if any(req.json_mode for _, req in group) else None
+        )
         page_rows = None
         if self.alloc is not None:
             pr = np.full(
@@ -487,7 +504,7 @@ class ContinuousBatcher:
                 jnp.asarray(topks), jnp.asarray(topps), jnp.asarray(seeds),
                 jnp.asarray(eos), jnp.asarray(jsonm), jnp.asarray(budgets),
                 use_flash=self.on_tpu, flash_mesh=self.flash_mesh,
-                page_rows=page_rows,
+                page_rows=page_rows, json_tables=group_json,
             )
         try:
             first.copy_to_host_async()
@@ -580,11 +597,22 @@ class ContinuousBatcher:
         table = (
             jnp.asarray(self.alloc.table) if self.alloc is not None else None
         )
+        # Token-mask tables ride along only while a live slot constrains
+        # (see _prefill_group). Lock-free read is safe: slots are INSTALLED
+        # on this thread (so a constraining slot is always seen), and the
+        # reader only clears them (worst case: tables ride one extra
+        # chunk).
+        chunk_json = (
+            self.json_tables
+            if any(
+                s is not None and s.request.json_mode for s in self._slots
+            ) else None
+        )
         with global_metrics.timer("engine.chunk_dispatch_latency"):
             toks, valid, self.cache, self.dstate, self.sampling = decode_chunk(
                 self.params, self.cfg, self.cache, self.dstate, self.sampling,
                 self.chunk_size, self.use_pallas, prefix_bound=prefix_bound,
-                table=table,
+                table=table, json_tables=chunk_json,
             )
         # Start the D2H transfer as soon as the chunk finishes computing,
         # so the blocking read one pipeline-cycle later is a cache hit, not

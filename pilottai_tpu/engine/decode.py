@@ -191,6 +191,8 @@ def decode_chunk(
     use_pallas: bool = True,
     prefix_bound: Optional[int] = None,
     table: Optional[jax.Array] = None,  # [B, max_pages] — paged cache only
+    json_tables: Optional[Tuple[jax.Array, jax.Array]] = None,
+    # ^ (token_bytes [Vt, L], token_len [Vt]) — subword JSON grammar mask
 ) -> Tuple[jax.Array, jax.Array, KVCache, DecodeState, SamplingState]:
     """Run ``n_steps`` decode steps for every slot in one dispatch.
 
@@ -322,7 +324,10 @@ def decode_chunk(
         h = rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps, cfg.rms_offset)
         logits = _unembed(cfg, params, h)[:, 0]           # [B, V] fp32
 
-        sampled, sampling = sample_core(logits, sampling, json_remaining=budget)
+        sampled, sampling = sample_core(
+            logits, sampling, json_remaining=budget,
+            json_token_tables=json_tables,
+        )
         new_budget = budget - active.astype(jnp.int32)
         hit_eos = (sampling.eos_id >= 0) & (sampled == sampling.eos_id)
         ctx_full = (pos + 1) >= (S - 1)
@@ -381,6 +386,7 @@ def admit_group(
     use_flash: bool = True,
     flash_mesh: Any = None,
     page_rows: Optional[jax.Array] = None,  # [A, max_pages] — paged cache
+    json_tables: Optional[Tuple[jax.Array, jax.Array]] = None,
 ):
     """The whole admission path — prefill forward, batched cache write,
     sampler install, on-device first-token sample, decode-state install —
@@ -403,7 +409,8 @@ def admit_group(
         sampling, slots, temps, topks, topps, seeds, eos, jsonm
     )
     first, sampling = sample_prefill_tokens(
-        logits, lens, slots, sampling, remaining=budgets + 1
+        logits, lens, slots, sampling, remaining=budgets + 1,
+        json_tables=json_tables,
     )
     dstate = admit_decode(dstate, slots, first, budgets, lens > 0)
     return cache, dstate, sampling, first
@@ -416,6 +423,7 @@ def sample_prefill_tokens(
     slots: jax.Array,     # [A] slot each prompt was admitted into
     sampling: SamplingState,
     remaining: Optional[jax.Array] = None,  # [A] total generation budget
+    json_tables: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> Tuple[jax.Array, SamplingState]:
     """Sample each admitted prompt's first generated token on device,
     using (and advancing) the slot's sampling params — host-side sampling
@@ -425,7 +433,9 @@ def sample_prefill_tokens(
         logits, jnp.maximum(valid - 1, 0)[:, None, None], axis=1
     )[:, 0]                                              # [A, V]
     sub = jax.tree.map(lambda a: a[slots], sampling)
-    tokens, sub = sample_core(last, sub, json_remaining=remaining)
+    tokens, sub = sample_core(
+        last, sub, json_remaining=remaining, json_token_tables=json_tables
+    )
     del A
     # Write back everything the sampler advanced: the PRNG keys and the
     # JSON automaton coords (the first token is the automaton's first

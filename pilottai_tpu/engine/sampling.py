@@ -74,32 +74,71 @@ def _apply_json_mask(
     logits: jax.Array,
     state: SamplingState,
     remaining: jax.Array | None = None,
+    token_tables: tuple[jax.Array, jax.Array] | None = None,
 ) -> jax.Array:
-    """Constrain logits of json-enabled slots to grammar-legal bytes.
-    ``remaining`` (budget left, [B]) enables forced document closure."""
-    from pilottai_tpu.engine.json_mask import S_DONE, json_allowed_bytes
+    """Constrain logits of json-enabled slots to grammar-legal tokens.
+    ``remaining`` (budget left, [B]) enables forced document closure.
+    ``token_tables`` = (token_bytes [Vt, L], token_len [Vt]) switches from
+    the byte automaton to the token→byte product (subword vocabs)."""
+    from pilottai_tpu.engine.json_mask import (
+        S_DONE,
+        json_allowed_bytes,
+        json_allowed_tokens,
+    )
 
     B, V = logits.shape
-    byte_ok = json_allowed_bytes(
-        state.json_state, state.json_stack, state.json_depth, remaining
-    )                                                   # [B, 256]
-    full = jnp.zeros((B, V), bool).at[:, :256].set(byte_ok[:, :V])
+    if token_tables is not None:
+        tb, tl = token_tables
+        tok_ok = json_allowed_tokens(
+            state.json_state, state.json_stack, state.json_depth,
+            tb, tl, remaining,
+        )                                               # [B, Vt]
+        full = jnp.zeros((B, V), bool).at[:, : tb.shape[0]].set(
+            tok_ok[:, :V]
+        )
+    else:
+        byte_ok = json_allowed_bytes(
+            state.json_state, state.json_stack, state.json_depth, remaining
+        )                                               # [B, 256]
+        full = jnp.zeros((B, V), bool).at[:, :256].set(byte_ok[:, :V])
     # Document closed: force EOS when the slot has one (else pad spaces).
     eos_ok = (state.json_state == S_DONE) & (state.eos_id >= 0)
     eos_onehot = jax.nn.one_hot(
         jnp.clip(state.eos_id, 0, V - 1), V, dtype=bool
     )
     full = jnp.where(eos_ok[:, None], eos_onehot, full)
+    # Empty-mask fallback (token mode under an infeasible budget / odd
+    # vocab): an all-False row would argmax to pad-token garbage forever.
+    # Degrade the way the byte path's budget-exhaustion does: end the
+    # generation (EOS) when the slot has one, else sample unconstrained.
+    empty = ~full.any(axis=-1)
+    full = jnp.where(
+        (empty & (state.eos_id >= 0))[:, None], eos_onehot, full
+    )
+    full = full | (empty & (state.eos_id < 0))[:, None]
     masked = jnp.where(full, logits, -2.0**30)
     return jnp.where(state.json_enabled[:, None], masked, logits)
 
 
-def _advance_json(state: SamplingState, tokens: jax.Array) -> SamplingState:
-    from pilottai_tpu.engine.json_mask import json_advance
-
-    ns, stack, depth = json_advance(
-        state.json_state, state.json_stack, state.json_depth, tokens
+def _advance_json(
+    state: SamplingState,
+    tokens: jax.Array,
+    token_tables: tuple[jax.Array, jax.Array] | None = None,
+) -> SamplingState:
+    from pilottai_tpu.engine.json_mask import (
+        json_advance,
+        json_advance_tokens,
     )
+
+    if token_tables is not None:
+        ns, stack, depth = json_advance_tokens(
+            state.json_state, state.json_stack, state.json_depth, tokens,
+            *token_tables,
+        )
+    else:
+        ns, stack, depth = json_advance(
+            state.json_state, state.json_stack, state.json_depth, tokens
+        )
     en = state.json_enabled
     return state._replace(
         json_state=jnp.where(en, ns, state.json_state),
@@ -112,12 +151,13 @@ def sample_core(
     logits: jax.Array,  # [B, V] fp32
     state: SamplingState,
     json_remaining: jax.Array | None = None,  # [B] budget incl. this token
+    json_token_tables: tuple[jax.Array, jax.Array] | None = None,
 ) -> tuple[jax.Array, SamplingState]:
     """Sample one token per slot; greedy where temperature == 0.
 
     Plain function (no jit) so the decode chunk can inline it inside its
     step scan; ``sample_tokens`` is the standalone jitted wrapper."""
-    logits = _apply_json_mask(logits, state, json_remaining)
+    logits = _apply_json_mask(logits, state, json_remaining, json_token_tables)
     greedy = jnp.argmax(logits, axis=-1)
 
     temp = jnp.maximum(state.temperature, 1e-6)[:, None]
@@ -135,7 +175,9 @@ def sample_core(
     tokens = jnp.where(state.temperature <= 0.0, greedy, sampled).astype(
         jnp.int32
     )
-    state = _advance_json(state._replace(key=carry_keys), tokens)
+    state = _advance_json(
+        state._replace(key=carry_keys), tokens, json_token_tables
+    )
     return tokens, state
 
 

@@ -32,6 +32,13 @@ class Tokenizer(abc.ABC):
     @abc.abstractmethod
     def decode(self, ids: Sequence[int]) -> str: ...
 
+    def token_bytes(self, i: int) -> Optional[bytes]:
+        """The exact byte string token ``i`` contributes to decoded text,
+        or None when it has none / it can't be derived (specials, partial
+        UTF-8 pieces). Powers the JSON grammar mask's token→byte product
+        (engine/json_mask.py:token_byte_table)."""
+        return None
+
 
 class ByteTokenizer(Tokenizer):
     """Byte-level tokenizer: ids 0..255 are raw bytes; specials follow.
@@ -57,6 +64,9 @@ class ByteTokenizer(Tokenizer):
         data = bytes(i for i in ids if 0 <= i < self.BYTE_VOCAB)
         return data.decode("utf-8", errors="replace")
 
+    def token_bytes(self, i: int) -> Optional[bytes]:
+        return bytes([i]) if 0 <= i < self.BYTE_VOCAB else None
+
 
 class HFTokenizer(Tokenizer):
     """Local Hugging Face tokenizer wrapper (no downloads)."""
@@ -75,6 +85,23 @@ class HFTokenizer(Tokenizer):
         self.pad_id = self._tok.pad_token_id or 0
         self.bos_id = self._tok.bos_token_id or 1
         self.eos_id = self._tok.eos_token_id or 2
+        self._special_ids = set(self._tok.all_special_ids or [])
+        # Anchor for token_bytes: a plain ascii token with an unambiguous
+        # decode (see token_bytes). Candidates cover code/text vocabs;
+        # without one the derivation would LIE for word-initial pieces
+        # (decode-alone strips SentencePiece space markers), so we give up
+        # and token_bytes returns None for everything — the engine then
+        # falls back to unconstrained sampling rather than masking against
+        # wrong byte strings.
+        self._anchor = None
+        for cand in (")", "0", "a", "."):
+            aid = self._tok.encode(cand, add_special_tokens=False)
+            if len(aid) == 1:
+                self._anchor = (
+                    aid[0],
+                    self._tok.decode([aid[0]], skip_special_tokens=False),
+                )
+                break
 
     def encode(self, text: str, add_bos: bool = True) -> List[int]:
         ids = self._tok.encode(text, add_special_tokens=False)
@@ -82,6 +109,28 @@ class HFTokenizer(Tokenizer):
 
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    def token_bytes(self, i: int) -> Optional[bytes]:
+        """Derive token i's decoded byte string by anchored difference:
+        decode(anchor + token) minus decode(anchor). The anchor sidesteps
+        leading-space normalization (SentencePiece strips a word-initial
+        marker at text start, so decoding the token alone would lie about
+        its bytes). Tokens that aren't self-contained text (specials,
+        partial UTF-8 sequences → U+FFFD) return None — the JSON grammar
+        only emits printable ASCII, so excluding them costs nothing."""
+        if i in self._special_ids or self._anchor is None:
+            return None
+        anchor, anchor_text = self._anchor
+        joined = self._tok.decode([anchor, i], skip_special_tokens=False)
+        if not joined.startswith(anchor_text):
+            return None
+        piece = joined[len(anchor_text):]
+        if not piece or "�" in piece:
+            return None
+        try:
+            return piece.encode("ascii")
+        except UnicodeEncodeError:
+            return None
 
 
 def load_tokenizer(path: Optional[str] = None) -> Tokenizer:

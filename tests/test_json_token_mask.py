@@ -1,0 +1,297 @@
+"""Subword JSON grammar masking: the token→byte product construction
+(VERDICT r2 missing #2 / next-step 5).
+
+The byte automaton (tests/test_json_mask.py) only constrains byte
+tokenizers; real checkpoints use subword vocabs. These tests build a
+small synthetic multi-byte BPE-style vocab and assert:
+
+* token-level advance == byte-level advance over the same text;
+* masked sampling with ADVERSARIAL (random) logits produces 100%%
+  parseable JSON for every seed, including multi-byte tokens that cross
+  container boundaries;
+* budget feasibility: documents always close within max_new_tokens;
+* the engine end-to-end serves json_mode with a subword tokenizer
+  (native.py no longer gates on ByteTokenizer).
+"""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pilottai_tpu.engine.json_mask import (
+    json_advance,
+    json_advance_tokens,
+    json_allowed_tokens,
+    token_byte_table,
+)
+from pilottai_tpu.engine.sampling import SamplingState, sample_core, update_slot
+from pilottai_tpu.engine.tokenizer import Tokenizer
+
+
+class TinyBPE(Tokenizer):
+    """Synthetic subword tokenizer: all printable ASCII single chars plus
+    multi-byte merges chosen to cross JSON structure boundaries."""
+
+    MERGES = [
+        '{"', '":', '", "', '"}', '}}', '"]', '], "', ': {', ': [',
+        'true', 'false', 'null', '0.', '123', '-1', '1e3',
+        'name', 'value', 'key', 'abc', '\\n', '\\"', ', ', '": "',
+        "\n",
+    ]
+
+    def __init__(self) -> None:
+        base = [chr(b) for b in range(0x20, 0x7F)]
+        self.pieces = [None, None, None] + base + self.MERGES
+        self.pad_id, self.bos_id, self.eos_id = 0, 1, 2
+        self.vocab_size = len(self.pieces)
+        # Longest-match-first encode order.
+        self._by_len = sorted(
+            [(p, i) for i, p in enumerate(self.pieces) if p],
+            key=lambda t: -len(t[0]),
+        )
+
+    def token_bytes(self, i):
+        p = self.pieces[i]
+        return p.encode() if p else None
+
+    def encode(self, text, add_bos=True):
+        ids = []
+        pos = 0
+        while pos < len(text):
+            for p, i in self._by_len:
+                if text.startswith(p, pos):
+                    ids.append(i)
+                    pos += len(p)
+                    break
+            else:
+                pos += 1  # unencodable char: drop
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids):
+        return "".join(self.pieces[i] or "" for i in ids)
+
+
+@pytest.fixture(scope="module")
+def tok_tables():
+    tok = TinyBPE()
+    tb, tl = token_byte_table(tok)
+    return tok, jnp.asarray(tb), jnp.asarray(tl)
+
+
+def test_table_excludes_specials_and_keeps_merges(tok_tables):
+    tok, tb, tl = tok_tables
+    tl = np.asarray(tl)
+    assert tl[tok.pad_id] == 0 and tl[tok.eos_id] == 0
+    i = tok.pieces.index('{"')
+    assert tl[i] == 2
+    assert bytes(np.asarray(tb)[i, :2]) == b'{"'
+
+
+def test_token_advance_matches_byte_advance(tok_tables):
+    """Advancing coords by one multi-byte token == advancing the byte
+    automaton over the token's bytes one at a time."""
+    tok, tb, tl = tok_tables
+    # Compact JSON only — the automaton deliberately has no whitespace
+    # transitions (json_mask.py _WS).
+    text = '{"name":[1,{"key":"v"},true],"x":-1e3}'
+    ids = tok.encode(text, add_bos=False)
+    assert tok.decode(ids) == text
+
+    s_t = jnp.zeros((1,), jnp.int32)
+    st_t = jnp.zeros((1,), jnp.int32)
+    d_t = jnp.zeros((1,), jnp.int32)
+    s_b, st_b, d_b = s_t, st_t, d_t
+    for i in ids:
+        s_t, st_t, d_t = json_advance_tokens(
+            s_t, st_t, d_t, jnp.asarray([i]), tb, tl
+        )
+        for byte in tok.pieces[i].encode():
+            s_b, st_b, d_b = json_advance(
+                s_b, st_b, d_b, jnp.asarray([byte])
+            )
+        assert (int(s_t[0]), int(st_t[0]), int(d_t[0])) == (
+            int(s_b[0]), int(st_b[0]), int(d_b[0])
+        ), f"diverged after token {tok.pieces[i]!r}"
+
+
+def test_mask_legal_tokens_only(tok_tables):
+    """From the start state only document openers are legal; after '{\"'
+    only key-continuation bytes are."""
+    tok, tb, tl = tok_tables
+    zero = jnp.zeros((1,), jnp.int32)
+    mask = np.asarray(json_allowed_tokens(zero, zero, zero, tb, tl))[0]
+    legal = {tok.pieces[i] for i in np.nonzero(mask)[0]}
+    assert '{' in legal and '[' in legal and '{"' in legal
+    assert 'true' not in legal and '0' not in legal and '}' not in legal
+    # '": ...' merges are illegal at start; '\\n' (escape) too.
+    assert '":' not in legal
+
+
+def _roll_constrained(tok, tb, tl, seed, budget, temperature=1.0):
+    """Sample a whole constrained generation with random logits."""
+    state = SamplingState.create(1, seed=seed)
+    state = update_slot(
+        state, 0, temperature=temperature, top_k=0, top_p=1.0,
+        seed=seed, eos_id=tok.eos_id, json_mode=True,
+    )
+    rng = np.random.default_rng(seed)
+    out = []
+    remaining = budget
+    for _ in range(budget):
+        logits = jnp.asarray(
+            rng.standard_normal((1, tok.vocab_size)) * 4.0, jnp.float32
+        )
+        tokens, state = sample_core(
+            logits, state,
+            json_remaining=jnp.asarray([remaining], jnp.int32),
+            json_token_tables=(tb, tl),
+        )
+        t = int(tokens[0])
+        remaining -= 1
+        if t == tok.eos_id:
+            break
+        out.append(t)
+    return out
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_logits_always_parse(tok_tables, seed):
+    tok, tb, tl = tok_tables
+    ids = _roll_constrained(tok, tb, tl, seed=seed, budget=48)
+    text = tok.decode(ids)
+    doc = json.loads(text)  # raises on any grammar leak
+    assert isinstance(doc, (dict, list))
+
+
+@pytest.mark.parametrize("budget", [4, 6, 9, 14])
+def test_tight_budget_still_closes(tok_tables, budget):
+    """Budget feasibility must close the document before tokens run out —
+    even when random logits would rather keep nesting."""
+    tok, tb, tl = tok_tables
+    for seed in range(4):
+        ids = _roll_constrained(tok, tb, tl, seed=seed, budget=budget)
+        text = tok.decode(ids)
+        assert len(ids) <= budget
+        json.loads(text)
+
+
+def test_table_build_rejects_incomplete_vocab():
+    """A vocab missing a closure byte (or exposing no byte info at all)
+    must fail table construction — the engine then degrades to
+    unconstrained sampling instead of masking everything out (review
+    finding: all-False rows previously emitted pad-token garbage)."""
+
+    class NoBrace(TinyBPE):
+        def token_bytes(self, i):
+            b = super().token_bytes(i)
+            return None if b == b"}" else b
+
+    with pytest.raises(ValueError, match="closure"):
+        token_byte_table(NoBrace())
+
+    class Opaque(Tokenizer):
+        vocab_size = 16
+        pad_id = bos_id = eos_id = 0
+
+        def encode(self, text, add_bos=True):
+            return []
+
+        def decode(self, ids):
+            return ""
+
+    with pytest.raises(ValueError, match="closure"):
+        token_byte_table(Opaque())
+
+
+def test_infeasible_budget_degrades_to_eos(tok_tables):
+    """remaining=1 makes every token budget-infeasible from S_START; the
+    empty-mask fallback must end the generation with EOS, not spew pad
+    tokens."""
+    tok, tb, tl = tok_tables
+    state = SamplingState.create(1)
+    state = update_slot(
+        state, 0, temperature=0.0, top_k=0, top_p=1.0, seed=0,
+        eos_id=tok.eos_id, json_mode=True,
+    )
+    logits = jnp.zeros((1, tok.vocab_size), jnp.float32)
+    tokens, _ = sample_core(
+        logits, state, json_remaining=jnp.asarray([1], jnp.int32),
+        json_token_tables=(tb, tl),
+    )
+    assert int(tokens[0]) == tok.eos_id
+
+
+def test_hf_tokenizer_token_bytes_roundtrip(tmp_path):
+    """HFTokenizer.token_bytes on a REAL fast tokenizer: train a tiny
+    byte-level BPE locally (no network), then assert every encoded id's
+    derived bytes concatenate back to the original text."""
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers import decoders, models, pre_tokenizers, trainers
+
+    from pilottai_tpu.engine.tokenizer import HFTokenizer
+
+    raw = tokenizers.Tokenizer(models.BPE(unk_token=None))
+    raw.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    raw.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=400,
+        special_tokens=["<pad>", "<bos>", "<eos>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    corpus = [
+        '{"name": "value", "items": [1, 2.5, true, false, null], '
+        '"nested": {"key": "abc"}}'
+    ] * 50
+    raw.train_from_iterator(corpus, trainer)
+    raw.save(str(tmp_path / "tokenizer.json"))
+    (tmp_path / "tokenizer_config.json").write_text(json.dumps({
+        "tokenizer_class": "PreTrainedTokenizerFast",
+        "pad_token": "<pad>", "bos_token": "<bos>", "eos_token": "<eos>",
+    }))
+
+    tok = HFTokenizer(tmp_path)
+    tb, tl = token_byte_table(tok)
+    assert int((tl > 0).sum()) > 100  # merges + byte alphabet usable
+    for text in ('{"key": true}', '{"a": [1, 2.5], "b": null}'):
+        ids = tok.encode(text, add_bos=False)
+        recon = b"".join(
+            bytes(tb[i, : tl[i]]) for i in ids if tl[i] > 0
+        )
+        assert recon == text.encode(), (text, recon)
+
+
+@pytest.mark.asyncio
+async def test_engine_json_mode_with_subword_tokenizer():
+    """End-to-end: the native engine serves grammar-constrained JSON with
+    a SUBWORD tokenizer — the path native.py:216 used to silently drop."""
+    from pilottai_tpu.core.config import LLMConfig
+    from pilottai_tpu.engine.native import NativeEngine
+    from pilottai_tpu.engine.types import ChatMessage, GenerationParams
+
+    engine = NativeEngine(
+        LLMConfig(
+            model_name="llama-tiny", provider="cpu", engine_slots=2,
+            engine_max_seq=128, engine_chunk=4, dtype="float32",
+        ),
+        platform="cpu",
+    )
+    engine.tokenizer = TinyBPE()  # swap in the subword vocab pre-start
+    await engine.start()
+    try:
+        assert engine._json_tables is not None, "table build skipped"
+        for seed in range(3):
+            resp = await engine.generate(
+                [ChatMessage(role="user", content="emit some json")],
+                params=GenerationParams(
+                    max_new_tokens=60, temperature=1.0, seed=seed,
+                    json_mode=True,
+                ),
+            )
+            doc = json.loads(resp.content)
+            assert isinstance(doc, (dict, list))
+    finally:
+        await engine.stop()
