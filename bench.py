@@ -5,21 +5,26 @@ An "agent step" is one LLM call inside the agent's plan/act/evaluate loop
 remote HTTPS round-trip per step, ``pilott/engine/llm.py:59``). Here the
 same step runs on local devices through the continuous batcher.
 
-Baseline: the reference publishes no numbers (SURVEY.md §6); BASELINE.json's
-north star is ≤500 ms p50 per agent step → 2.0 steps/sec/chip. vs_baseline
-is measured steps/sec/chip against that 2.0.
+Two sections on accelerator (VERDICT r2 next-step 3):
+
+* ``llama3-1b-byte`` — 32-way concurrency throughput section;
+* ``llama3-8b`` — the BASELINE.md north-star model, int8 weight-only +
+  speculative decoding, 8-way; its p50 vs the ≤500 ms target is the
+  headline (``vs_baseline`` = 500 / p50_8b — ≥1.0 means target met; the
+  reference publishes no numbers of its own, SURVEY.md §6).
 
 The TPU is reached through a shared tunnel whose latency oscillates
 between ~100 ms and multi-second stalls (see .claude/skills/verify
 gotchas); a single epoch can land in a bad window and misreport the
-engine by 5x. The bench therefore runs EPOCHS epochs and reports the
-best one — peak sustained throughput — with every epoch's steps/s in
-``epoch_steps_per_sec`` for transparency.
+engine by 5x. Each section therefore runs several epochs and reports the
+best one (peak sustained throughput) PLUS the median epoch and every
+epoch's rate, so the flattering statistic never stands alone.
 
 Prints ONE JSON line.
 """
 
 import asyncio
+import gc
 import json
 import os
 import statistics
@@ -30,33 +35,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 
-
-CONCURRENCY = 32       # concurrent agent steps in flight
-STEPS = 96             # total timed steps per epoch
-EPOCHS = 3             # measurement epochs; best one is reported
 MAX_NEW_TOKENS = 48    # JSON-ish agent-step reply length
-BASELINE_STEPS_PER_SEC = 2.0
-
-
-def pick_config():
-    platform = jax.default_backend()
-    on_accel = platform not in ("cpu",)
-    from pilottai_tpu.core.config import LLMConfig
-
-    return on_accel, LLMConfig(
-        model_name="llama3-1b-byte" if on_accel else "llama-tiny",
-        provider="tpu" if on_accel else "cpu",
-        engine_slots=min(CONCURRENCY, 32),
-        engine_max_seq=512,
-        # Swept on v5e (chunk ∈ {8, 12, 16, 24} × {bf16, int8}): int8
-        # weight-only + chunk 12 wins (p50 430 ms, 71 steps/s measured) —
-        # int8 halves the decode weight stream (models/quant.py), and 12
-        # balances chunk-boundary dead time against per-chunk overhead.
-        engine_chunk=12,
-        quantize="int8" if on_accel else None,
-        dtype="bfloat16" if on_accel else "float32",
-    )
-
+TARGET_P50_MS = 500.0  # BASELINE.md north star for llama3-8b
 
 PROMPT = (
     "Analyze the task and respond with JSON: "
@@ -66,20 +46,20 @@ PROMPT = (
 )
 
 
-async def run_bench():
-    on_accel, cfg = pick_config()
+async def bench_model(cfg, concurrency, steps, epochs, n_chips=1):
+    """Run one engine section; returns the result dict."""
     from pilottai_tpu.engine.handler import LLMHandler
     from pilottai_tpu.engine.types import GenerationParams
+    from pilottai_tpu.models.registry import get_model_config
 
     handler = LLMHandler(cfg)
     params = GenerationParams(max_new_tokens=MAX_NEW_TOKENS, temperature=0.0)
 
     async def one_step():
-        resp = await handler.apredict(PROMPT, params=params)
-        return resp
+        return await handler.apredict(PROMPT, params=params)
 
-    # Warmup: compile prefill bucket + decode, fill the pipeline.
-    await asyncio.gather(*[one_step() for _ in range(min(8, CONCURRENCY))])
+    # Warmup: compile prefill buckets + decode, fill the pipeline.
+    await asyncio.gather(*[one_step() for _ in range(min(8, concurrency))])
 
     async def epoch():
         latencies = []
@@ -88,53 +68,102 @@ async def run_bench():
 
         async def worker():
             nonlocal done
-            while done < STEPS:
+            while done < steps:
                 done += 1
                 s = time.perf_counter()
                 await one_step()
                 latencies.append(time.perf_counter() - s)
 
-        await asyncio.gather(*[worker() for _ in range(CONCURRENCY)])
+        await asyncio.gather(*[worker() for _ in range(concurrency)])
         return latencies, time.perf_counter() - t0
 
-    epochs = [await epoch() for _ in range(EPOCHS)]
-    epoch_rates = [round(len(l) / w, 3) for l, w in epochs]
-    latencies, wall = max(epochs, key=lambda e: len(e[0]) / e[1])
+    runs = [await epoch() for _ in range(epochs)]
     await handler.stop()
+    del handler
+    gc.collect()
 
-    n_chips = max(len(jax.devices()), 1) if on_accel else 1
-    steps_per_sec_chip = len(latencies) / wall / n_chips
+    epoch_rates = [round(len(l) / w / n_chips, 3) for l, w in runs]
+    latencies, wall = max(runs, key=lambda e: len(e[0]) / e[1])
+    steps_per_sec = len(latencies) / wall / n_chips
     p50_ms = statistics.median(latencies) * 1000.0
-
-    # Decode throughput + MFU so the distance to hardware roofline is
-    # visible in the artifact (VERDICT r1 asked for both). Every step
-    # generates MAX_NEW_TOKENS (random weights never emit EOS).
-    from pilottai_tpu.models.registry import get_model_config
-
     n_params = get_model_config(cfg.model_name).param_count()
+    on_accel = cfg.provider != "cpu"
     decode_tok_s = len(latencies) * MAX_NEW_TOKENS / wall / n_chips
     peak_flops = 197e12 if on_accel else 1e12  # v5e bf16 peak per chip
-    mfu = decode_tok_s * 2.0 * n_params / peak_flops
+    return {
+        "model": cfg.model_name,
+        "steps_per_sec_per_chip": round(steps_per_sec, 3),
+        "median_epoch_steps_per_sec": round(
+            statistics.median(epoch_rates), 3
+        ),
+        "p50_step_ms": round(p50_ms, 1),
+        "decode_tokens_per_sec_per_chip": round(decode_tok_s, 1),
+        "mfu": round(decode_tok_s * 2.0 * n_params / peak_flops, 4),
+        "concurrency": concurrency,
+        "steps": len(latencies),
+        "speculate": cfg.engine_speculate,
+        "quantize": cfg.quantize,
+        "epoch_steps_per_sec": epoch_rates,
+    }
 
-    print(
-        json.dumps(
-            {
-                "metric": "agent_steps_per_sec_per_chip",
-                "value": round(steps_per_sec_chip, 3),
-                "unit": "steps/s/chip",
-                "vs_baseline": round(steps_per_sec_chip / BASELINE_STEPS_PER_SEC, 3),
-                "p50_step_ms": round(p50_ms, 1),
-                "decode_tokens_per_sec_per_chip": round(decode_tok_s, 1),
-                "mfu": round(mfu, 4),
-                "model": cfg.model_name,
-                "provider": cfg.provider,
-                "n_chips": n_chips,
-                "concurrency": CONCURRENCY,
-                "steps": len(latencies),
-                "epoch_steps_per_sec": epoch_rates,
-            }
-        )
+
+async def run_bench():
+    from pilottai_tpu.core.config import LLMConfig
+
+    platform = jax.default_backend()
+    on_accel = platform not in ("cpu",)
+    n_chips = max(len(jax.devices()), 1) if on_accel else 1
+
+    common = dict(
+        provider="tpu" if on_accel else "cpu",
+        engine_max_seq=512,
+        dtype="bfloat16" if on_accel else "float32",
+        # Swept on v5e round 2 (chunk ∈ {8,12,16,24} × {bf16,int8}): int8
+        # + chunk 12 won; speculation (round 3) rides the same chunking.
+        engine_chunk=12,
+        quantize="int8" if on_accel else None,
+        # n-gram verify-blocks: decode is weight-stream-bound, accepted
+        # drafts are ~free tokens (engine/decode.py:decode_chunk_spec).
+        engine_speculate=4,
     )
+
+    # Section 1: 1B throughput model (byte vocab: runs without a
+    # checkpoint download in the zero-egress environment).
+    sec_1b = await bench_model(
+        LLMConfig(
+            model_name="llama3-1b-byte" if on_accel else "llama-tiny",
+            engine_slots=32, **common,
+        ),
+        concurrency=32, steps=96, epochs=3, n_chips=n_chips,
+    )
+
+    # Section 2: the north-star model. int8 8B params stream at ~8 GB per
+    # token-pass; speculation is what breaks the one-token-per-pass
+    # bandwidth floor (VERDICT r2 Weak #2).
+    sec_8b = None
+    if on_accel:
+        sec_8b = await bench_model(
+            LLMConfig(
+                model_name="llama3-8b-byte", engine_slots=8, **common,
+            ),
+            concurrency=8, steps=32, epochs=2, n_chips=n_chips,
+        )
+
+    headline = sec_8b or sec_1b
+    out = {
+        "metric": "agent_steps_per_sec_per_chip",
+        "value": sec_1b["steps_per_sec_per_chip"],
+        "unit": "steps/s/chip",
+        # ≥ 1.0 ⇔ the north-star model meets the ≤500 ms p50 target.
+        "vs_baseline": round(TARGET_P50_MS / headline["p50_step_ms"], 3),
+        "p50_step_ms": sec_1b["p50_step_ms"],
+        "p50_step_ms_8b": sec_8b["p50_step_ms"] if sec_8b else None,
+        "provider": "tpu" if on_accel else "cpu",
+        "n_chips": n_chips,
+        "models": {sec_1b["model"]: sec_1b,
+                   **({sec_8b["model"]: sec_8b} if sec_8b else {})},
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -111,6 +111,11 @@ class LLMConfig(BaseModel):
     engine_paged_kv: Optional[bool] = None
     engine_kv_pages: Optional[int] = None
     engine_page_size: int = Field(default=128, ge=8)
+    # Speculative decoding: verify-blocks of N tokens per weight pass via
+    # n-gram self-drafting (0 = off; >= 2 enables; dense KV only). Decode
+    # is weight-stream-bound, so accepted drafts are nearly free tokens
+    # (engine/decode.py:decode_chunk_spec).
+    engine_speculate: int = Field(default=0, ge=0)
     seed: int = 0                                    # param init seed when no checkpoint
 
 

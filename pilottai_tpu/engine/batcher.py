@@ -44,6 +44,7 @@ from pilottai_tpu.engine.decode import (
     DecodeState,
     admit_group,
     decode_chunk,
+    decode_chunk_spec,
     release_decode,
 )
 from pilottai_tpu.engine.sampling import SamplingState
@@ -84,11 +85,18 @@ class _Slot:
     # First generated token still living on device (read lazily with the
     # admission group's array; None once folded into ``generated``).
     first_pending: bool = True
-    # Decode tokens already covered by dispatched chunks. Once this reaches
-    # the request's budget, further chunks can't produce anything for the
-    # slot — the device loop uses it to stop dispatching no-op chunks
-    # while completions are still in the read pipeline.
-    dispatched: int = 0
+    # In-flight chunk accounting. A dispatched-but-unread chunk will
+    # deliver between 1 and D tokens per block (D = 1 without
+    # speculation): ``est_pending`` carries the rate-EMA estimate the
+    # device loop uses to decide whether ANOTHER chunk would still be
+    # useful, ``hi_pending`` the hard maximum the prefix-bound
+    # computation needs. Both are reduced when the reader folds the
+    # chunk and the slot's ``generated`` absorbs the actual tokens, so
+    # estimates self-correct every read: an over-estimate can pause
+    # dispatching for at most one fold cycle (the fold wakes the loop),
+    # never hang it.
+    est_pending: float = 0.0
+    hi_pending: int = 0
 
 
 class ContinuousBatcher:
@@ -113,6 +121,7 @@ class ContinuousBatcher:
         page_size: int = 128,
         num_pages: Optional[int] = None,
         json_tables: Optional[Tuple[Any, Any]] = None,
+        speculate: int = 0,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -167,6 +176,23 @@ class ContinuousBatcher:
             tuple(jnp.asarray(t) for t in json_tables)
             if json_tables is not None else None
         )
+
+        # Speculative decoding: verify-blocks of ``speculate`` tokens per
+        # weight pass (engine/decode.py:decode_chunk_spec). Dense cache
+        # only — the paged chunk keeps one-token steps.
+        if speculate and paged:
+            self._log.warning(
+                "speculative decode not supported with the paged KV "
+                "cache; disabling speculation"
+            )
+            speculate = 0
+        self.speculate = speculate if speculate >= 2 else 0
+        # Observed tokens-per-block EMA (1.0 = no acceptance; up to D).
+        # Drives the in-flight token estimates: dispatching assuming no
+        # acceptance wastes whole weight passes on no-op chunks (measured
+        # 4x wave time on v5e), assuming full acceptance stalls the
+        # pipeline when drafts miss.
+        self._spec_rate = 1.0
 
         self.cache_dtype = cache_dtype
         # Paged KV: shared page pool + host-side block table/allocator
@@ -495,9 +521,11 @@ class ContinuousBatcher:
             page_rows = jnp.asarray(pr)
         with global_metrics.timer("engine.prefill_latency"):
             # One fused dispatch for the whole admission (prefill + cache
-            # write + sampler + first token + decode install) — five
+            # write + sampler + first token + decode install + history) —
             # separate dispatches each paid tunnel latency.
-            self.cache, self.dstate, self.sampling, first = admit_group(
+            (
+                self.cache, self.dstate, self.sampling, first, self.history,
+            ) = admit_group(
                 self.params, self.cfg, self.cache, self.dstate,
                 self.sampling, jnp.asarray(tokens), jnp.asarray(positions),
                 jnp.asarray(lens), jnp.asarray(slots), jnp.asarray(temps),
@@ -505,6 +533,7 @@ class ContinuousBatcher:
                 jnp.asarray(eos), jnp.asarray(jsonm), jnp.asarray(budgets),
                 use_flash=self.on_tpu, flash_mesh=self.flash_mesh,
                 page_rows=page_rows, json_tables=group_json,
+                history=self.history,
             )
         try:
             first.copy_to_host_async()
@@ -586,14 +615,26 @@ class ContinuousBatcher:
         return any(s is not None for s in self._slots)
 
     def _chunk_useful(self) -> bool:
-        """True when at least one occupied slot still has decode budget a
-        new chunk could consume (lock held)."""
+        """True when at least one occupied slot still has decode budget
+        that folded tokens plus in-flight estimates don't already cover
+        (lock held)."""
+        # Half-a-block tolerance under speculation: the acceptance EMA
+        # sits just under D (request tails emit partial blocks), so an
+        # exact-boundary check would dispatch one whole wasted weight
+        # pass per wave. A boundary miss costs only one fold cycle (the
+        # fold corrects the ledger and wakes this loop).
+        tol = self._spec_rate / 2 if self.speculate else 0.0
         for s in self._slots:
-            if s is not None and s.dispatched < s.request.max_new_tokens - 1:
+            if s is None:
+                continue
+            folded = max(0, len(s.generated) - 1)  # decode tokens landed
+            if folded + s.est_pending < s.request.max_new_tokens - 1 - tol:
                 return True
         return False
 
-    def _dispatch_chunk(self, prefix_bound: int):
+    def _dispatch_chunk(
+        self, prefix_bound: int, est: float = 0.0, hi: int = 0,
+    ):
         table = (
             jnp.asarray(self.alloc.table) if self.alloc is not None else None
         )
@@ -609,11 +650,25 @@ class ContinuousBatcher:
             ) else None
         )
         with global_metrics.timer("engine.chunk_dispatch_latency"):
-            toks, valid, self.cache, self.dstate, self.sampling = decode_chunk(
-                self.params, self.cfg, self.cache, self.dstate, self.sampling,
-                self.chunk_size, self.use_pallas, prefix_bound=prefix_bound,
-                table=table, json_tables=chunk_json,
-            )
+            if self.speculate:
+                (
+                    toks, valid, self.cache, self.dstate, self.sampling,
+                    self.history,
+                ) = decode_chunk_spec(
+                    self.params, self.cfg, self.cache, self.dstate,
+                    self.sampling, self.history, self.chunk_size,
+                    self.speculate, prefix_bound=prefix_bound,
+                    json_tables=chunk_json,
+                )
+            else:
+                toks, valid, self.cache, self.dstate, self.sampling = (
+                    decode_chunk(
+                        self.params, self.cfg, self.cache, self.dstate,
+                        self.sampling, self.chunk_size, self.use_pallas,
+                        prefix_bound=prefix_bound, table=table,
+                        json_tables=chunk_json,
+                    )
+                )
         # Start the D2H transfer as soon as the chunk finishes computing,
         # so the blocking read one pipeline-cycle later is a cache hit, not
         # a full round trip (the tunnel RTT is ~100 ms).
@@ -623,9 +678,9 @@ class ContinuousBatcher:
         except AttributeError:  # non-jax array types in tests
             pass
         global_metrics.inc("engine.decode_steps", self.chunk_size)
-        return toks, valid, tuple(self._gen)
+        return toks, valid, tuple(self._gen), est, hi
 
-    def _process_chunk(self, toks, valid, gen_stamp) -> None:
+    def _process_chunk(self, toks, valid, gen_stamp, est, hi) -> None:
         """Host-read one finished chunk and fold its tokens into slots
         (reader thread). Pending first-token arrays ride the same read."""
         with self._lock:
@@ -644,11 +699,13 @@ class ContinuousBatcher:
                 self._fold_first_tokens(groups, fetched[2:])
             for b in range(B):
                 slot = self._slots[b]
-                if (
-                    slot is None
-                    or slot.first_pending
-                    or gen_stamp[b] != self._gen[b]
-                ):
+                if slot is None or gen_stamp[b] != self._gen[b]:
+                    continue
+                # This chunk's contribution leaves the in-flight ledger
+                # whether or not tokens landed (same occupant only).
+                slot.est_pending = max(0.0, slot.est_pending - est)
+                slot.hi_pending = max(0, slot.hi_pending - hi)
+                if slot.first_pending:
                     continue
                 for i in range(n):
                     if not valid_h[i, b]:
@@ -657,6 +714,18 @@ class ContinuousBatcher:
                     self._check_finished(b)
                     if self._slots[b] is None:
                         break
+        if self.speculate:
+            # Observed tokens-per-block over blocks that actually emitted
+            # (done-slot and trailing no-op blocks excluded — counting
+            # them drags the EMA back toward 1 and re-creates the wasted
+            # weight passes the estimate exists to avoid).
+            D = self.speculate
+            blk = valid_h.reshape(self.chunk_size, D, B)
+            active_blocks = int(blk.any(axis=1).sum())
+            if active_blocks > 0:
+                obs = float(valid_h.sum()) / active_blocks
+                obs = min(max(obs, 0.5), float(D))
+                self._spec_rate = 0.5 * self._spec_rate + 0.5 * obs
         global_metrics.inc("engine.generated_tokens_device", int(valid_h.sum()))
 
     def _read_loop(self) -> None:
@@ -710,6 +779,11 @@ class ContinuousBatcher:
             self.alloc = None
         self.sampling = SamplingState.create(self.n_slots)
         self.dstate = DecodeState.create(self.n_slots)
+        # Per-slot token-id history by position (speculative drafting).
+        self.history = (
+            jnp.zeros((self.n_slots, self.max_seq_len), jnp.int32)
+            if self.speculate else None
+        )
 
     def _fail_occupied_slots(self, exc: Exception) -> None:
         """Fail every in-flight request and reset slot bookkeeping after an
@@ -742,19 +816,31 @@ class ContinuousBatcher:
                     useful = self._chunk_useful()
                     if useful:
                         # Upper bound on any live slot's cache length at
-                        # chunk start (device lengths ≤ prompt + already-
-                        # dispatched decode tokens), taken BEFORE this
-                        # chunk's own tokens are counted.
+                        # chunk start (device lengths ≤ prompt + folded
+                        # decode tokens + the in-flight chunks' hard
+                        # maximum), taken BEFORE this chunk's own tokens
+                        # are counted.
                         bound = max(
-                            s.prompt_len + s.dispatched
+                            s.prompt_len + min(
+                                max(0, len(s.generated) - 1)
+                                + s.hi_pending,
+                                s.request.max_new_tokens - 1,
+                            )
                             for s in self._slots
                             if s is not None
                         )
+                        est = self.chunk_size * (
+                            self._spec_rate if self.speculate else 1.0
+                        )
+                        hi = self.chunk_size * (self.speculate or 1)
                         for s in self._slots:
                             if s is not None:
-                                s.dispatched += self.chunk_size
+                                s.est_pending += est
+                                s.hi_pending += hi
                 if useful:
-                    item = self._dispatch_chunk(self._decode_bucket(bound))
+                    item = self._dispatch_chunk(
+                        self._decode_bucket(bound), est, hi
+                    )
                     while not self._stop.is_set():
                         try:
                             self._results.put(item, timeout=0.5)

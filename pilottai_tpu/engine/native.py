@@ -188,6 +188,7 @@ class NativeEngine(LLMBackend):
             page_size=self.config.engine_page_size,
             num_pages=self.config.engine_kv_pages,
             json_tables=self._json_tables,
+            speculate=self.config.engine_speculate,
         )
         self.batcher.start()
         self.batcher.warmup()

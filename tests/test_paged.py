@@ -178,7 +178,7 @@ def _admit_both(cfg, params, budgets):
 def test_paged_chunk_matches_dense(prefix_bound):
     cfg = get_model_config("llama-tiny")
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
-    (dc, dd, ds, d_first), (pc, pd, psm, p_first), table = _admit_both(
+    (dc, dd, ds, d_first, _), (pc, pd, psm, p_first, _), table = _admit_both(
         cfg, params, budgets=[20, 20, 0, 0]
     )
     np.testing.assert_array_equal(np.asarray(d_first), np.asarray(p_first))

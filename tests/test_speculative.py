@@ -1,0 +1,221 @@
+"""Speculative decoding (n-gram self-drafting verify-blocks).
+
+The invariant that makes speculation safe: acceptance only ever compares
+the model's OWN masked greedy output against the draft, so for greedy
+slots the emitted token stream is BIT-IDENTICAL to the plain fused chunk
+— drafts change speed, never content. These tests pin that, plus budget/
+EOS bookkeeping and the json_mode interaction. (VERDICT r2 next-step 2.)
+"""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pilottai_tpu.engine.decode import (
+    DecodeState,
+    admit_group,
+    decode_chunk,
+    decode_chunk_spec,
+)
+from pilottai_tpu.engine.sampling import SamplingState
+from pilottai_tpu.models.common import init_params
+from pilottai_tpu.models.registry import get_model_config
+
+
+def _admit(cfg, params, prompts, budgets, temps=None, jsonm=None,
+           eos=-1, n_slots=4, max_seq=128):
+    from pilottai_tpu.ops.kvcache import KVCache
+
+    A = len(prompts)
+    T = max(len(p) for p in prompts)
+    T = max(16, 1 << (T - 1).bit_length())
+    tokens = np.zeros((A, T), np.int32)
+    lens = np.zeros((A,), np.int32)
+    for i, p in enumerate(prompts):
+        tokens[i, : len(p)] = p
+        lens[i] = len(p)
+    positions = np.broadcast_to(np.arange(T, dtype=np.int32)[None], (A, T))
+    cache = KVCache.create(
+        cfg.n_layers, n_slots, max_seq, cfg.n_kv_heads, cfg.head_dim,
+        dtype=jnp.float32,
+    )
+    history = jnp.zeros((n_slots, max_seq), jnp.int32)
+    temps = temps or [0.0] * A
+    jsonm = jsonm or [False] * A
+    cache, dstate, sampling, first, history = admit_group(
+        params, cfg, cache, DecodeState.create(n_slots),
+        SamplingState.create(n_slots),
+        jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(lens),
+        jnp.asarray(list(range(A)), jnp.int32),
+        jnp.asarray(temps, jnp.float32),
+        jnp.zeros((A,), jnp.int32), jnp.ones((A,), jnp.float32),
+        jnp.arange(A, dtype=jnp.int32),
+        jnp.full((A,), eos, jnp.int32),
+        jnp.asarray(jsonm),
+        jnp.asarray([b - 1 for b in budgets], jnp.int32),
+        use_flash=False, history=history,
+    )
+    return cache, dstate, sampling, history, np.asarray(first)[:A]
+
+
+def _collect(toks, valid, n_slots):
+    out = [[] for _ in range(n_slots)]
+    t, v = np.asarray(toks), np.asarray(valid)
+    for i in range(t.shape[0]):
+        for b in range(n_slots):
+            if v[i, b]:
+                out[b].append(int(t[i, b]))
+    return out
+
+
+# Prompts with internal repetition so the 2-gram draft actually fires.
+PROMPTS = [
+    [5, 6, 7, 5, 6, 7, 5, 6],
+    [9, 9, 9, 9, 9, 9],
+    [3, 4, 3, 4, 3, 4, 3],
+]
+
+
+def test_spec_chunk_greedy_parity():
+    """decode_chunk_spec emits the same greedy token stream as
+    decode_chunk, block by block, including cache lengths."""
+    cfg = get_model_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    budgets = [25, 25, 25]
+
+    c1, d1, s1, _, f1 = _admit(cfg, params, PROMPTS, budgets)
+    plain = [[] for _ in range(4)]
+    for _ in range(4):
+        t, v, c1, d1, s1 = decode_chunk(
+            params, cfg, c1, d1, s1, 8, use_pallas=False
+        )
+        for b, seq in enumerate(_collect(t, v, 4)):
+            plain[b].extend(seq)
+
+    c2, d2, s2, h2, f2 = _admit(cfg, params, PROMPTS, budgets)
+    np.testing.assert_array_equal(f1, f2)
+    spec = [[] for _ in range(4)]
+    for _ in range(4):
+        t, v, c2, d2, s2, h2 = decode_chunk_spec(
+            params, cfg, c2, d2, s2, h2, 8, 4, prefix_bound=None
+        )
+        for b, seq in enumerate(_collect(t, v, 4)):
+            spec[b].extend(seq)
+
+    for b in range(3):
+        assert spec[b] == plain[b], f"slot {b} diverged"
+    np.testing.assert_array_equal(
+        np.asarray(c1.lengths), np.asarray(c2.lengths)
+    )
+    # History mirrors prompt + generated per position.
+    h = np.asarray(h2)
+    for b in range(3):
+        gen = [f2[b]] + spec[b]
+        want = PROMPTS[b] + gen
+        got = list(h[b, : len(want)])
+        assert got == want, f"slot {b} history wrong"
+
+
+def test_spec_respects_budget_exactly():
+    cfg = get_model_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    for budget in (2, 3, 5):
+        c, d, s, h, _ = _admit(cfg, params, PROMPTS, [budget] * 3)
+        total = [0, 0, 0]
+        for _ in range(3):
+            t, v, c, d, s, h = decode_chunk_spec(
+                params, cfg, c, d, s, h, 4, 4
+            )
+            for b, seq in enumerate(_collect(t, v, 4)[:3]):
+                total[b] += len(seq)
+        # budget-1 decode tokens (first token came from prefill).
+        assert total == [budget - 1] * 3, (budget, total)
+
+
+def test_spec_acceptance_actually_fires():
+    """On self-repeating sequences the 2-gram draft must accept > 0
+    tokens — otherwise the whole mechanism silently degrades to 1
+    token/pass and the perf claim is vapor. Greedy decode on a tiny
+    random-weight model collapses to a cycle, so acceptance must appear
+    within a few blocks."""
+    cfg = get_model_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    c, d, s, h, _ = _admit(cfg, params, [[7, 8, 9, 7, 8]], [60], n_slots=2)
+    emitted = 0
+    blocks = 0
+    for _ in range(4):
+        t, v, c, d, s, h = decode_chunk_spec(params, cfg, c, d, s, h, 4, 4)
+        emitted += int(np.asarray(v)[:, 0].sum())
+        blocks += 4
+    # A cycling greedy stream must reach well past 1 token/block once the
+    # cycle is in history (the frontier-matching bug measured exactly
+    # 1.0 here).
+    assert emitted >= 1.5 * blocks, (
+        f"weak speculative acceptance: {emitted} tokens in {blocks} blocks"
+    )
+
+
+def test_spec_sampled_slots_stay_exact():
+    """temperature > 0 slots emit exactly one token per block and the
+    stream stays within the vocab (distributional path intact)."""
+    cfg = get_model_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    c, d, s, h, _ = _admit(
+        cfg, params, PROMPTS, [10, 10, 10], temps=[1.0, 0.0, 1.0]
+    )
+    seqs = [[] for _ in range(4)]
+    for _ in range(4):
+        t, v, c, d, s, h = decode_chunk_spec(params, cfg, c, d, s, h, 3, 4)
+        for b, seq in enumerate(_collect(t, v, 4)):
+            seqs[b].extend(seq)
+    for b in range(3):
+        assert len(seqs[b]) == 9  # budget-1
+        assert all(0 <= t < cfg.vocab_size for t in seqs[b])
+
+
+@pytest.mark.asyncio
+async def test_engine_spec_e2e_parity_and_json():
+    """Full engine: engine_speculate=4 produces byte-identical greedy
+    output to the plain engine, and json_mode under speculation still
+    yields parseable documents."""
+    from pilottai_tpu.core.config import LLMConfig
+    from pilottai_tpu.engine.handler import LLMHandler
+    from pilottai_tpu.engine.types import ChatMessage, GenerationParams
+
+    async def run(speculate):
+        h = LLMHandler(LLMConfig(
+            model_name="llama-tiny", provider="cpu", engine_slots=2,
+            engine_max_seq=128, engine_chunk=4, dtype="float32",
+            engine_speculate=speculate,
+        ))
+        await h.start()
+        try:
+            outs = []
+            for prompt in ("abc abc abc abc", "xyzzy"):
+                r = await h.generate_response(
+                    [ChatMessage(role="user", content=prompt)],
+                    params=GenerationParams(
+                        max_new_tokens=16, temperature=0.0
+                    ),
+                )
+                outs.append(r.content)
+            j = await h.generate_response(
+                [ChatMessage(role="user", content="emit json")],
+                params=GenerationParams(
+                    max_new_tokens=60, temperature=1.0, seed=3,
+                    json_mode=True,
+                ),
+            )
+            return outs, j.content
+        finally:
+            await h.stop()
+
+    plain_outs, _ = await run(0)
+    spec_outs, spec_json = await run(4)
+    assert spec_outs == plain_outs
+    doc = json.loads(spec_json)
+    assert isinstance(doc, (dict, list))
