@@ -144,7 +144,10 @@ async def run_bench():
     if on_accel:
         sec_8b = await bench_model(
             LLMConfig(
-                model_name="llama3-8b-byte", engine_slots=8, **common,
+                # chunk 14 x acceptance ~3.75 covers the whole 48-token
+                # step in ONE dispatch (swept 12/14/16 on v5e round 3).
+                model_name="llama3-8b-byte", engine_slots=8,
+                **{**common, "engine_chunk": 14},
             ),
             concurrency=8, steps=32, epochs=2, n_chips=n_chips,
         )

@@ -116,6 +116,11 @@ class LLMConfig(BaseModel):
     # is weight-stream-bound, so accepted drafts are nearly free tokens
     # (engine/decode.py:decode_chunk_spec).
     engine_speculate: int = Field(default=0, ge=0)
+    # Automatic prefix caching: keep the K/V of the last N admitted
+    # prompt prefixes on device; repeated/shared prefixes skip their
+    # prefill FLOPs (engine/prefix_cache.py). 0 disables; dense KV only.
+    # Each entry costs L x K x min(len,1024) x H x 4 bytes of HBM.
+    engine_prefix_cache: int = Field(default=4, ge=0)
     seed: int = 0                                    # param init seed when no checkpoint
 
 

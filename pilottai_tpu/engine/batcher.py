@@ -43,10 +43,13 @@ import numpy as np
 from pilottai_tpu.engine.decode import (
     DecodeState,
     admit_group,
+    admit_group_prefix,
     decode_chunk,
     decode_chunk_spec,
+    export_prefix,
     release_decode,
 )
+from pilottai_tpu.engine.prefix_cache import PrefixStore
 from pilottai_tpu.engine.sampling import SamplingState
 from pilottai_tpu.models.common import ModelConfig
 from pilottai_tpu.ops.kvcache import KVCache, free_slots
@@ -122,6 +125,7 @@ class ContinuousBatcher:
         num_pages: Optional[int] = None,
         json_tables: Optional[Tuple[Any, Any]] = None,
         speculate: int = 0,
+        prefix_cache: int = 8,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -187,6 +191,21 @@ class ContinuousBatcher:
             )
             speculate = 0
         self.speculate = speculate if speculate >= 2 else 0
+        # Automatic prefix caching (engine/prefix_cache.py): admitted
+        # prompts' K/V panels are kept and reused so repeated/shared
+        # prefixes skip their prefill FLOPs. Dense cache only.
+        self.prefix_store = (
+            PrefixStore(
+                capacity=prefix_cache,
+                min_len=min_bucket,
+                # Prompt-length cap bounds HBM: a 2048-row 8B entry is
+                # ~540 MB; capacity x 1024 rows keeps the store around
+                # 0.5 GB worst case next to 8 GB of weights on a 16 GB
+                # chip.
+                max_len=min(max_seq_len or cfg.max_seq_len, 1024),
+            )
+            if prefix_cache > 0 and not paged else None
+        )
         # Observed tokens-per-block EMA (1.0 = no acceptance; up to D).
         # Drives the in-flight token estimates: dispatching assuming no
         # acceptance wastes whole weight passes on no-op chunks (measured
@@ -352,6 +371,33 @@ class ContinuousBatcher:
             b *= 2
         return min(b, self.max_seq_len)
 
+    def _tail_bucket(self, n: int) -> int:
+        """Prefix-cache tail ladder: 8-floor power-of-two (the 64-floor
+        prompt ladder would spend ~25% of a full 8B prefill on a
+        one-token tail)."""
+        b = 8
+        while b < n:
+            b *= 2
+        return b
+
+    def _prefix_hit(self, req: GenRequest):
+        """Prefix-store match that also fits: the tail write lands at
+        [prefix_len, prefix_len + tail_bucket) and dynamic_update_slice
+        CLAMPS out-of-range starts — an oversized hit would silently
+        shift the tail onto the cached prefix rows (KV corruption), so
+        it must fall back to the full-prefill path instead."""
+        if self.prefix_store is None:
+            return None
+        entry = self.prefix_store.match(req.prompt_ids)
+        if entry is None:
+            return None
+        plen = len(entry.ids)
+        if plen + self._tail_bucket(len(req.prompt_ids) - plen) > self.max_seq_len:
+            return None
+        if entry.p_bucket > self.max_seq_len:
+            return None
+        return entry
+
     def _decode_bucket(self, n: int) -> int:
         """Prefix-bound bucket for a decode chunk: the prefill bucket
         ladder with a 128 floor (so tiny bounds don't churn recompiles and
@@ -404,15 +450,22 @@ class ContinuousBatcher:
             # release wipe the new occupant. One cycle of patience.
             not_yet = set(self._release)
             free = [i for i in self._free_slot_indices() if i not in not_yet]
-            groups: List[List[Tuple[int, GenRequest]]] = []
+            groups: List[Tuple[Any, List[Tuple[int, GenRequest]]]] = []
             blocked = False
             while free and not blocked:
                 group: List[Tuple[int, GenRequest]] = []
+                group_key = None
                 while free and self._backlog and len(group) < self.admit_batch:
                     req = self._backlog[0]
                     if req.cancelled or req.future.cancelled():
                         self._backlog.popleft()
                         continue
+                    # Prefix-cache match keys the group: one shared
+                    # cached prefix per admission dispatch.
+                    key = self._prefix_hit(req)
+                    if group and key is not group_key:
+                        break  # next group picks it up
+                    group_key = key
                     if self.alloc is not None:
                         # Clamp to slot capacity: decode stops at
                         # ctx-full anyway, so the cache never holds more
@@ -436,13 +489,13 @@ class ContinuousBatcher:
                     group.append((idx, req))
                 if not group:
                     break
-                groups.append(group)
+                groups.append((group_key, group))
             # Only this thread allocates slots, so the picks stay valid
             # after the lock drops; occupied entries land in _prefill_group.
 
-        for gi, group in enumerate(groups):
+        for gi, (entry, group) in enumerate(groups):
             try:
-                self._prefill_group(group)
+                self._prefill_group(group, entry)
             except Exception as exc:  # noqa: BLE001 — fail these requests only
                 self._log.error("prefill failed: %s", exc, exc_info=True)
                 with self._lock:
@@ -471,16 +524,17 @@ class ContinuousBatcher:
                     # scratch page and "complete" with garbage). Requeue
                     # them at the backlog head, in order, so they re-admit
                     # with fresh allocations next cycle.
-                    for later in reversed(groups[gi + 1:]):
+                    for _, later in reversed(groups[gi + 1:]):
                         for _, later_req in reversed(later):
                             self._backlog.appendleft(later_req)
                     break
 
-    def _prefill_group(self, group: List[Tuple[int, GenRequest]]) -> None:
+    def _prefill_group(
+        self,
+        group: List[Tuple[int, GenRequest]],
+        entry: Optional[Any] = None,
+    ) -> None:
         A = self.admit_batch
-        T = self._bucket(max(len(r.prompt_ids) for _, r in group))
-        tokens = np.zeros((A, T), np.int32)
-        lens = np.zeros((A,), np.int32)
         slots = np.full((A,), self.n_slots, np.int32)  # OOB = padding row
         temps = np.zeros((A,), np.float32)
         topks = np.zeros((A,), np.int32)
@@ -490,9 +544,6 @@ class ContinuousBatcher:
         budgets = np.zeros((A,), np.int32)
         jsonm = np.zeros((A,), bool)
         for row, (idx, req) in enumerate(group):
-            ids = req.prompt_ids
-            tokens[row, : len(ids)] = ids
-            lens[row] = len(ids)
             slots[row] = idx
             temps[row] = req.temperature
             topks[row] = req.top_k
@@ -501,8 +552,6 @@ class ContinuousBatcher:
             eos[row] = req.eos_id
             jsonm[row] = req.json_mode
             budgets[row] = req.max_new_tokens - 1
-
-        positions = np.broadcast_to(np.arange(T, dtype=np.int32)[None], (A, T))
         # Bake the token tables into this dispatch only when the group
         # actually constrains: with a 128k-vocab the B x V x L automaton
         # simulation is pure waste for non-JSON traffic. Two jit variants
@@ -511,30 +560,81 @@ class ContinuousBatcher:
             self.json_tables
             if any(req.json_mode for _, req in group) else None
         )
-        page_rows = None
-        if self.alloc is not None:
-            pr = np.full(
-                (A, self.max_pages_per_slot), self.alloc.sentinel, np.int32
+
+        if entry is not None:
+            # Cached-prefix admission: copy the stored panels, prefill
+            # only the tails (an exact repeat is a one-token tail). Tail
+            # buckets get an 8-floor ladder of their own: the 64-floor
+            # prompt ladder would spend ~25% of a full 8B prefill on a
+            # one-token tail.
+            plen = len(entry.ids)
+            Tt = self._tail_bucket(
+                max(len(r.prompt_ids) - plen for _, r in group)
             )
-            for row, (idx, _) in enumerate(group):
-                pr[row] = self.alloc.table[idx]
-            page_rows = jnp.asarray(pr)
-        with global_metrics.timer("engine.prefill_latency"):
-            # One fused dispatch for the whole admission (prefill + cache
-            # write + sampler + first token + decode install + history) —
-            # separate dispatches each paid tunnel latency.
-            (
-                self.cache, self.dstate, self.sampling, first, self.history,
-            ) = admit_group(
-                self.params, self.cfg, self.cache, self.dstate,
-                self.sampling, jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(lens), jnp.asarray(slots), jnp.asarray(temps),
-                jnp.asarray(topks), jnp.asarray(topps), jnp.asarray(seeds),
-                jnp.asarray(eos), jnp.asarray(jsonm), jnp.asarray(budgets),
-                use_flash=self.on_tpu, flash_mesh=self.flash_mesh,
-                page_rows=page_rows, json_tables=group_json,
-                history=self.history,
+            assert plen + Tt <= self.max_seq_len  # _prefix_hit guarantees
+            Tf = self._bucket(max(len(r.prompt_ids) for _, r in group))
+            tail_tokens = np.zeros((A, Tt), np.int32)
+            tail_lens = np.zeros((A,), np.int32)
+            full_tokens = np.zeros((A, Tf), np.int32)
+            for row, (idx, req) in enumerate(group):
+                tail = req.prompt_ids[plen:]
+                tail_tokens[row, : len(tail)] = tail
+                tail_lens[row] = len(tail)
+                full_tokens[row, : len(req.prompt_ids)] = req.prompt_ids
+            with global_metrics.timer("engine.prefill_latency"):
+                (
+                    self.cache, self.dstate, self.sampling, first,
+                    self.history,
+                ) = admit_group_prefix(
+                    self.params, self.cfg, self.cache, self.dstate,
+                    self.sampling, entry.ks, entry.vs,
+                    jnp.int32(plen), jnp.asarray(tail_tokens),
+                    jnp.asarray(tail_lens), jnp.asarray(full_tokens),
+                    jnp.asarray(slots), jnp.asarray(temps),
+                    jnp.asarray(topks), jnp.asarray(topps),
+                    jnp.asarray(seeds), jnp.asarray(eos),
+                    jnp.asarray(jsonm), jnp.asarray(budgets),
+                    json_tables=group_json, history=self.history,
+                )
+            global_metrics.inc("engine.prefix_hits", len(group))
+        else:
+            T = self._bucket(max(len(r.prompt_ids) for _, r in group))
+            tokens = np.zeros((A, T), np.int32)
+            lens = np.zeros((A,), np.int32)
+            for row, (idx, req) in enumerate(group):
+                ids = req.prompt_ids
+                tokens[row, : len(ids)] = ids
+                lens[row] = len(ids)
+            positions = np.broadcast_to(
+                np.arange(T, dtype=np.int32)[None], (A, T)
             )
+            page_rows = None
+            if self.alloc is not None:
+                pr = np.full(
+                    (A, self.max_pages_per_slot), self.alloc.sentinel,
+                    np.int32,
+                )
+                for row, (idx, _) in enumerate(group):
+                    pr[row] = self.alloc.table[idx]
+                page_rows = jnp.asarray(pr)
+            with global_metrics.timer("engine.prefill_latency"):
+                # One fused dispatch for the whole admission (prefill +
+                # cache write + sampler + first token + decode install +
+                # history) — separate dispatches each paid tunnel latency.
+                (
+                    self.cache, self.dstate, self.sampling, first,
+                    self.history,
+                ) = admit_group(
+                    self.params, self.cfg, self.cache, self.dstate,
+                    self.sampling, jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(lens), jnp.asarray(slots), jnp.asarray(temps),
+                    jnp.asarray(topks), jnp.asarray(topps), jnp.asarray(seeds),
+                    jnp.asarray(eos), jnp.asarray(jsonm), jnp.asarray(budgets),
+                    use_flash=self.on_tpu, flash_mesh=self.flash_mesh,
+                    page_rows=page_rows, json_tables=group_json,
+                    history=self.history,
+                )
+            self._maybe_export(group)
         try:
             first.copy_to_host_async()
         except AttributeError:
@@ -549,6 +649,37 @@ class ContinuousBatcher:
                 ([(idx, self._gen[idx]) for idx, _ in group], first)
             )
         global_metrics.inc("engine.admitted", len(group))
+
+    def _maybe_export(self, group: List[Tuple[int, GenRequest]]) -> None:
+        """After a miss admission, copy new prompts' K/V out of the slot
+        cache into the prefix store (plus derived longest-common-prefix
+        entries, which converge on shared preambles). Best-effort — a
+        failed export never fails the requests."""
+        store = self.prefix_store
+        if store is None:
+            return
+        seen = set()
+        for idx, req in group:
+            # Store the prompt MINUS its last token: match() requires a
+            # proper prefix (a tail token must produce the first-token
+            # logits), so this is what makes an exact repeat hit — as a
+            # one-token tail.
+            ids = tuple(req.prompt_ids[:-1])
+            if not (store.min_len <= len(ids) <= store.max_len):
+                continue
+            if ids in seen or store.has(ids):
+                continue
+            seen.add(ids)
+            try:
+                pb = self._bucket(len(ids))
+                ks, vs = export_prefix(self.cache.layers, idx, p_bucket=pb)
+                store.store(ids, ks, vs, pb)
+                for p in store.lcp_candidates(ids):
+                    pb2 = self._bucket(p)
+                    store.store(ids[:p], ks[:, :, :pb2], vs[:, :, :pb2], pb2)
+            except Exception as exc:  # noqa: BLE001 — cache is optional
+                self._log.warning("prefix export failed: %s", exc)
+                return
 
     def _fold_first_tokens(self, groups, hosts: List[np.ndarray]) -> None:
         """Fold prefill-sampled first tokens into their slots (lock held).
@@ -874,6 +1005,11 @@ class ContinuousBatcher:
                 {"kv_pages_free": self.alloc.free_pages,
                  "kv_pages_total": self.num_pages - 1}
                 if self.alloc is not None else {}
+            ),
+            **(
+                {"prefix_entries": len(self.prefix_store),
+                 "prefix_hits": global_metrics.get("engine.prefix_hits")}
+                if self.prefix_store is not None else {}
             ),
             "decode_steps": global_metrics.get("engine.decode_steps"),
             "completed": global_metrics.get("engine.completed"),

@@ -167,12 +167,9 @@ def _ring_stats(
 
 
 def _combine_stats(acc_a, m_a, l_a, acc_b, m_b, l_b):
-    """Merge two online-softmax partials over disjoint key sets."""
-    m = jnp.maximum(m_a, m_b)
-    wa = jnp.where(m_a > NEG_INF / 2, jnp.exp(m_a - m), 0.0)
-    wb = jnp.where(m_b > NEG_INF / 2, jnp.exp(m_b - m), 0.0)
-    l = l_a * wa + l_b * wb
-    acc = acc_a * wa[..., None] + acc_b * wb[..., None]
+    """Merge two online-softmax partials over disjoint key sets and
+    normalize (final-merge form of ``_merge_stats``)."""
+    acc, _, l = _merge_stats(acc_a, m_a, l_a, acc_b, m_b, l_b)
     return acc / jnp.maximum(l, 1e-30)[..., None]
 
 
@@ -731,6 +728,220 @@ def decode_chunk_spec(
     )
     dstate = DecodeState(tokens=tokens, done=done, budget=budget)
     return out_toks, out_valid, cache, dstate, sampling, history
+
+
+# --------------------------------------------------------------------- #
+# Prefix-cached admission (engine/prefix_cache.py)
+# --------------------------------------------------------------------- #
+
+
+def _tail_prefix_attn(
+    qg: jax.Array,          # [A, K, G, T, H] tail queries
+    pk: jax.Array,          # [K, P, H] shared cached-prefix keys
+    pv: jax.Array,
+    blk_k: jax.Array,       # [A, K, T, H] tail's own keys
+    blk_v: jax.Array,
+    prefix_len: jax.Array,  # scalar int32 — true prefix length (<= P)
+    valid: jax.Array,       # [A] true tail lengths
+    scale: float,
+    softcap: float,
+    window: int,
+) -> jax.Array:
+    """Tail-prefill attention: every tail query attends the whole cached
+    prefix plus the tail causally. The prefix panels carry no batch dim —
+    one cached prompt serves the whole admission group."""
+    A, K, G, T, H = qg.shape
+
+    def softcapped(s):
+        return jnp.tanh(s / softcap) * softcap if softcap > 0.0 else s
+
+    qpos = prefix_len + jnp.arange(T)                       # tail positions
+    s = softcapped(jnp.einsum(
+        "akgth,kph->akgtp", qg, pk, preferred_element_type=jnp.float32,
+    ) * scale)
+    col = jnp.arange(pk.shape[1])[None, None, None, None, :]
+    mask = col < prefix_len
+    if window > 0:
+        mask = mask & (
+            (qpos[None, None, None, :, None] - col) < window
+        )
+    s = jnp.where(mask, s, NEG_INF)
+    m_p = jnp.max(s, axis=-1)
+    p = jnp.where(m_p[..., None] > NEG_INF / 2, jnp.exp(s - m_p[..., None]), 0.0)
+    l_p = jnp.sum(p, axis=-1)
+    acc_p = jnp.einsum(
+        "akgtp,kph->akgth", p.astype(pv.dtype), pv,
+        preferred_element_type=jnp.float32,
+    )
+
+    s = softcapped(jnp.einsum(
+        "akgth,akeh->akgte", qg, blk_k, preferred_element_type=jnp.float32,
+    ) * scale)
+    e = jnp.arange(T)[None, None, None, None, :]
+    t = jnp.arange(T)[None, None, None, :, None]
+    mask = (e <= t) & (e < valid[:, None, None, None, None])
+    if window > 0:
+        mask = mask & ((t - e) < window)
+    s = jnp.where(mask, s, NEG_INF)
+    m_b = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m_b[..., None])  # e == t always valid → never empty
+    l_b = jnp.sum(p, axis=-1)
+    acc_b = jnp.einsum(
+        "akgte,akeh->akgth", p.astype(blk_v.dtype), blk_v,
+        preferred_element_type=jnp.float32,
+    )
+
+    acc, _, l = _merge_stats(acc_p, m_p, l_p, acc_b, m_b, l_b)
+    attn = acc / jnp.maximum(l, 1e-30)[..., None]
+    return attn.transpose(0, 3, 1, 2, 4).reshape(A, T, K * G * H)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg",),
+    donate_argnames=("cache", "dstate", "sampling", "history"),
+)
+def admit_group_prefix(
+    params,
+    cfg: ModelConfig,
+    cache: KVCache,
+    dstate: "DecodeState",
+    sampling: SamplingState,
+    prefix_ks: jax.Array,   # [L, K, P, H] cached prompt-prefix keys
+    prefix_vs: jax.Array,
+    prefix_len: jax.Array,  # scalar int32 — true prefix length
+    tail_tokens: jax.Array,  # [A, Tt] right-padded prompt tails
+    tail_lens: jax.Array,    # [A] true tail lengths (0 = padding row)
+    full_tokens: jax.Array,  # [A, Tf] full prompts (history install)
+    slots: jax.Array,
+    temps: jax.Array,
+    topks: jax.Array,
+    topps: jax.Array,
+    seeds: jax.Array,
+    eos: jax.Array,
+    jsonm: jax.Array,
+    budgets: jax.Array,
+    json_tables: Optional[Tuple[jax.Array, jax.Array]] = None,
+    history: Optional[jax.Array] = None,
+):
+    """Admission with a cached prefix: copy the prefix K/V into each
+    slot, prefill ONLY the tail with prefix-aware attention, sample the
+    first token — one fused dispatch, like ``admit_group``. An exact
+    repeat admits with a one-token tail: the 2048-position 8B prefill
+    (~33 TFLOP, the dominant share of the agent-step wave measured on
+    v5e) collapses to a single position."""
+    A, Tt = tail_tokens.shape
+    positions = prefix_len + jnp.broadcast_to(
+        jnp.arange(Tt, dtype=jnp.int32)[None], (A, Tt)
+    )
+    x = _embed(cfg, params, tail_tokens)
+    sin, cos = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+    windows = jnp.asarray(cfg.window_sizes())
+    qscale = cfg.query_scale if cfg.query_scale is not None else cfg.head_dim**-0.5
+    G = cfg.n_heads // cfg.n_kv_heads
+    cache_dtype = cache.layers[0][0].dtype
+
+    def layer_fn(carry, scanned):
+        x = carry
+        lp, window, pk, pv = scanned
+        h = rms_norm(x, lp["ln1"]["scale"], cfg.rms_eps, cfg.rms_offset)
+        q, k, v = _qkv(cfg, lp["attn"], h, sin, cos)
+        qg = q.transpose(0, 2, 1, 3).reshape(
+            A, cfg.n_kv_heads, G, Tt, cfg.head_dim
+        )
+        blk_k = k.transpose(0, 2, 1, 3).astype(cache_dtype)
+        blk_v = v.transpose(0, 2, 1, 3).astype(cache_dtype)
+        # lax.switch-free per-layer window: windows is traced per-scan
+        # element; the dense masks take it as an array.
+        attn = _tail_prefix_attn(
+            qg, pk, pv, blk_k, blk_v, prefix_len, tail_lens,
+            qscale, cfg.attn_softcap, 0,
+        )
+        win_attn = _tail_prefix_attn(
+            qg, pk, pv, blk_k, blk_v, prefix_len, tail_lens,
+            qscale, cfg.attn_softcap, int(cfg.sliding_window),
+        ) if cfg.sliding_window > 0 else attn
+        attn = jnp.where(window > 0, win_attn, attn)
+        out = _attn_out(cfg, lp["attn"], attn.astype(x.dtype).reshape(
+            A, Tt, cfg.n_heads, cfg.head_dim
+        ))
+        if cfg.post_norms:
+            out = rms_norm(out, lp["ln1_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
+        x = x + out
+        h = rms_norm(x, lp["ln2"]["scale"], cfg.rms_eps, cfg.rms_offset)
+        out, _ = _mlp(cfg, lp, h)
+        if cfg.post_norms:
+            out = rms_norm(out, lp["ln2_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
+        x = x + out
+        return x, (blk_k, blk_v)
+
+    x, (ks, vs) = jax.lax.scan(
+        layer_fn, x, (params["layers"], windows, prefix_ks, prefix_vs)
+    )
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps, cfg.rms_offset)
+    logits = _unembed(cfg, params, x)                    # [A, Tt, V] fp32
+
+    # Cache install: prefix panels (shared) + tail (per slot). Padding
+    # rows route to row 0's slot and are overwritten by its later write
+    # (write_prompts' reversed-dus trick).
+    live = tail_lens > 0
+    safe_slots = jnp.where(live, slots, slots[0])
+    plen_start = jnp.clip(prefix_len, 0, cache.max_len - 1)
+    new_layers = []
+    for l, (k_panel, v_panel) in enumerate(cache.layers):
+        pk = prefix_ks[l].astype(cache_dtype)[None]     # [1, K, P, H]
+        pv = prefix_vs[l].astype(cache_dtype)[None]
+        for a in reversed(range(A)):
+            start = (safe_slots[a], 0, 0, 0)
+            k_panel = jax.lax.dynamic_update_slice(k_panel, pk, start)
+            v_panel = jax.lax.dynamic_update_slice(v_panel, pv, start)
+            # Scan outputs are already K-major: ks[l][a] is [K, Tt, H].
+            tstart = (safe_slots[a], 0, plen_start, 0)
+            k_panel = jax.lax.dynamic_update_slice(
+                k_panel, ks[l][a][None], tstart
+            )
+            v_panel = jax.lax.dynamic_update_slice(
+                v_panel, vs[l][a][None], tstart
+            )
+        new_layers.append((k_panel, v_panel))
+    new_lengths = cache.lengths
+    full_lens = jnp.where(live, prefix_len + tail_lens, 0)
+    for a in reversed(range(A)):
+        new_lengths = jax.lax.dynamic_update_slice(
+            new_lengths, full_lens[a][None], (safe_slots[a],)
+        )
+    cache = cache._replace(layers=tuple(new_layers), lengths=new_lengths)
+
+    sampling = admit_sampling(
+        sampling, slots, temps, topks, topps, seeds, eos, jsonm
+    )
+    first, sampling = sample_prefill_tokens(
+        logits, tail_lens, slots, sampling, remaining=budgets + 1,
+        json_tables=json_tables,
+    )
+    dstate = admit_decode(dstate, slots, first, budgets, live)
+    if history is not None:
+        history = install_history(
+            history, slots, full_tokens, full_lens, first
+        )
+    return cache, dstate, sampling, first, history
+
+
+@partial(jax.jit, static_argnames=("p_bucket",))
+def export_prefix(layers, slot, p_bucket: int):
+    """Read one slot's first ``p_bucket`` cache rows out as stacked
+    [L, K, p_bucket, H] arrays (the prefix-store entry payload). Runs
+    right after the admission dispatch, before any decode chunk touches
+    the slot, so the rows hold exactly the prompt's K/V."""
+    def grab(panel):
+        K, _, H = panel.shape[1:]
+        return jax.lax.dynamic_slice(
+            panel, (slot, 0, 0, 0), (1, K, p_bucket, H)
+        )[0]
+
+    ks = jnp.stack([grab(k) for k, _ in layers])
+    vs = jnp.stack([grab(v) for _, v in layers])
+    return ks, vs
 
 
 def install_history(

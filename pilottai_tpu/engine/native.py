@@ -189,6 +189,7 @@ class NativeEngine(LLMBackend):
             num_pages=self.config.engine_kv_pages,
             json_tables=self._json_tables,
             speculate=self.config.engine_speculate,
+            prefix_cache=self.config.engine_prefix_cache,
         )
         self.batcher.start()
         self.batcher.warmup()

@@ -1,0 +1,240 @@
+"""Automatic prefix caching (engine/prefix_cache.py + admit_group_prefix).
+
+The safety invariant mirrors speculation's: a cache hit changes WHERE
+prompt K/V comes from, never what gets generated — greedy output after a
+hit must be bit-identical to a cold engine's. (Round-3 perf item: the
+8B admission prefill measured as the dominant share of the agent-step
+wave on v5e.)
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from pilottai_tpu.core.config import LLMConfig
+from pilottai_tpu.engine.handler import LLMHandler
+from pilottai_tpu.engine.prefix_cache import PrefixStore
+from pilottai_tpu.engine.types import ChatMessage, GenerationParams
+from pilottai_tpu.utils.metrics import global_metrics
+
+
+def test_store_match_and_lru():
+    s = PrefixStore(capacity=2, min_len=4, max_len=64)
+    a = tuple(range(10, 30))
+    b = tuple(range(40, 56))
+    s.store(a, "ka", "va", 32)
+    s.store(b, "kb", "vb", 16)
+    # Proper-prefix match only, longest wins.
+    assert s.match(list(a) + [1, 2]).ids == a
+    assert s.match(list(a)[:8]) is None or len(s.match(list(a)[:8]).ids) <= 8
+    assert s.match(list(b)) is None  # exact length: no tail left
+    # LRU: touching a then inserting evicts b.
+    s.match(list(a) + [1])
+    s.store(tuple(range(70, 90)), "kc", "vc", 32)
+    assert s.has(a) and not s.has(b)
+
+
+def test_store_lcp_candidates():
+    s = PrefixStore(capacity=4, min_len=4, max_len=64)
+    base = tuple(range(100, 120))
+    s.store(base + (1, 2, 3), "k", "v", 32)
+    # A different continuation shares the 20-token base.
+    cands = s.lcp_candidates(base + (7, 8, 9))
+    assert cands == [len(base)]
+
+
+async def _engine(prefix_cache, speculate=0):
+    h = LLMHandler(LLMConfig(
+        model_name="llama-tiny", provider="cpu", engine_slots=4,
+        engine_max_seq=256, engine_chunk=4, dtype="float32",
+        engine_prefix_cache=prefix_cache, engine_speculate=speculate,
+    ))
+    await h.start()
+    return h
+
+
+# Long enough to clear the 64-token min_bucket entry floor.
+LONG = ("You are the orchestrator. Analyze the task and respond with "
+        "strict JSON as instructed by the rules preamble. Task: ")
+
+
+@pytest.mark.asyncio
+async def test_hit_output_identical_to_cold_engine():
+    params = GenerationParams(max_new_tokens=12, temperature=0.0)
+    prompt = LONG + "summarize the report"
+
+    cold = await _engine(prefix_cache=0)
+    try:
+        want = (await cold.generate_response(
+            [ChatMessage(content=prompt)], params=params)).content
+    finally:
+        await cold.stop()
+
+    warm = await _engine(prefix_cache=8)
+    try:
+        h0 = global_metrics.get("engine.prefix_hits")
+        first = (await warm.generate_response(
+            [ChatMessage(content=prompt)], params=params)).content
+        again = (await warm.generate_response(
+            [ChatMessage(content=prompt)], params=params)).content
+        hits = global_metrics.get("engine.prefix_hits") - h0
+        assert first == want          # miss path unchanged
+        assert again == want          # exact-repeat hit, same bits
+        assert hits >= 1, "second request did not hit the prefix cache"
+    finally:
+        await warm.stop()
+
+
+def test_prefix_extension_hit_identical():
+    """A prompt extending a cached one (raw ids — the multi-turn /
+    growing-transcript shape) admits via tail-prefill with output
+    identical to a cold batcher. (Engine-level prompts end with the
+    assistant marker, so THEIR sharing goes through the LCP entries —
+    tested below.)"""
+    import jax
+    import jax.numpy as jnp
+
+    from pilottai_tpu.engine.batcher import ContinuousBatcher, GenRequest
+    from pilottai_tpu.models.common import init_params
+    from pilottai_tpu.models.registry import get_model_config
+
+    cfg = get_model_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    base = [(i % 90) + 5 for i in range(80)]
+    longer = base + [7, 9, 11, 13, 9, 7]
+
+    def run(prefix_cache, prompts):
+        b = ContinuousBatcher(
+            cfg, params, n_slots=2, max_seq_len=256,
+            cache_dtype=jnp.float32, chunk_size=4,
+            prefix_cache=prefix_cache,
+        )
+        b.start()
+        try:
+            outs = []
+            for p in prompts:
+                req = GenRequest(prompt_ids=list(p), max_new_tokens=10)
+                outs.append(b.submit(req).result(timeout=120))
+            return outs, (
+                len(b.prefix_store) if b.prefix_store else 0
+            )
+        finally:
+            b.stop()
+
+    (want,), _ = run(0, [longer])
+    h0 = global_metrics.get("engine.prefix_hits")
+    (_, got), entries = run(8, [base, longer])
+    assert entries >= 1
+    assert global_metrics.get("engine.prefix_hits") > h0
+    assert got == want
+
+
+def test_oversized_hit_falls_back_to_full_prefill():
+    """When prefix_len + tail bucket exceeds max_seq, the dus tail write
+    would CLAMP and shift K/V onto the cached prefix rows (review
+    finding: silent corruption) — the hit must be rejected and the output
+    must match a cold batcher's."""
+    import jax
+    import jax.numpy as jnp
+
+    from pilottai_tpu.engine.batcher import ContinuousBatcher, GenRequest
+    from pilottai_tpu.models.common import init_params
+    from pilottai_tpu.models.registry import get_model_config
+
+    cfg = get_model_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    base = [(i % 90) + 5 for i in range(80)]
+    big = base + [(i % 50) + 7 for i in range(38)]  # 118 ids, tail 39
+
+    def run(prefix_cache, prompts):
+        b = ContinuousBatcher(
+            cfg, params, n_slots=2, max_seq_len=128,
+            cache_dtype=jnp.float32, chunk_size=4,
+            prefix_cache=prefix_cache,
+        )
+        b.start()
+        try:
+            return [
+                b.submit(
+                    GenRequest(prompt_ids=list(p), max_new_tokens=6)
+                ).result(timeout=120)
+                for p in prompts
+            ]
+        finally:
+            b.stop()
+
+    want = run(0, [big])[0]
+    got = run(8, [base, big])[1]  # base seeds the store; big must miss
+    assert got == want
+
+
+@pytest.mark.asyncio
+async def test_lcp_entry_serves_shared_preamble():
+    """Two different tasks sharing the preamble: the derived LCP entry
+    must make the THIRD distinct prompt hit without any full repeat."""
+    params = GenerationParams(max_new_tokens=8, temperature=0.0)
+    warm = await _engine(prefix_cache=8)
+    try:
+        await warm.generate_response(
+            [ChatMessage(content=LONG + "first task")], params=params)
+        await warm.generate_response(
+            [ChatMessage(content=LONG + "second very different task")],
+            params=params)
+        h0 = global_metrics.get("engine.prefix_hits")
+        await warm.generate_response(
+            [ChatMessage(content=LONG + "third unseen task")],
+            params=params)
+        assert global_metrics.get("engine.prefix_hits") > h0, (
+            "shared-preamble LCP entry never formed"
+        )
+    finally:
+        await warm.stop()
+
+
+@pytest.mark.asyncio
+async def test_prefix_cache_with_speculation():
+    """Both round-3 perf features together: hit + speculative decode
+    still bit-match the cold engine's greedy output."""
+    params = GenerationParams(max_new_tokens=16, temperature=0.0)
+    prompt = LONG + "repeat repeat repeat repeat"
+
+    cold = await _engine(prefix_cache=0, speculate=0)
+    try:
+        want = (await cold.generate_response(
+            [ChatMessage(content=prompt)], params=params)).content
+    finally:
+        await cold.stop()
+
+    warm = await _engine(prefix_cache=8, speculate=4)
+    try:
+        for _ in range(3):
+            got = (await warm.generate_response(
+                [ChatMessage(content=prompt)], params=params)).content
+            assert got == want
+    finally:
+        await warm.stop()
+
+
+@pytest.mark.asyncio
+async def test_prefix_cache_on_mesh():
+    """Hit path under sharded params (the v5e-8 serving configuration):
+    parity with the same engine's own miss output."""
+    params = GenerationParams(max_new_tokens=8, temperature=0.0)
+    h = LLMHandler(LLMConfig(
+        model_name="llama-tiny", provider="cpu", engine_slots=4,
+        engine_max_seq=256, engine_chunk=4, dtype="float32",
+        mesh_shape={"model": 2, "data": 2}, engine_prefix_cache=8,
+    ))
+    await h.start()
+    try:
+        prompt = LONG + "mesh parity"
+        first = (await h.generate_response(
+            [ChatMessage(content=prompt)], params=params)).content
+        h0 = global_metrics.get("engine.prefix_hits")
+        again = (await h.generate_response(
+            [ChatMessage(content=prompt)], params=params)).content
+        assert global_metrics.get("engine.prefix_hits") > h0
+        assert again == first
+    finally:
+        await h.stop()
