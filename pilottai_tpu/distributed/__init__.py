@@ -1,0 +1,18 @@
+"""Cross-host orchestrator↔agent control plane (SURVEY §2.14).
+
+The reference declared networking intent it never built (websockets dep,
+``pilott/pyproject.toml:19``; dead websocket config fields,
+``pilott/core/config.py:153-156``). Here it exists: ``ServeEndpoint``
+attaches a TCP listener to a :class:`~pilottai_tpu.serve.Serve`,
+``AgentWorker`` hosts real agents in other processes/hosts (each with its
+own TPU engine), and :class:`RemoteAgent` proxies make remote agents
+first-class citizens of routing, fault tolerance and retry.
+"""
+
+from pilottai_tpu.distributed.control_plane import (
+    AgentWorker,
+    RemoteAgent,
+    ServeEndpoint,
+)
+
+__all__ = ["AgentWorker", "RemoteAgent", "ServeEndpoint"]

@@ -1,0 +1,515 @@
+"""Orchestrator↔worker control plane over asyncio TCP (SURVEY §2.14).
+
+Topology: ``Serve`` runs on host 0 with a :class:`ServeEndpoint` listener;
+each worker host runs an :class:`AgentWorker` hosting real
+:class:`~pilottai_tpu.core.agent.BaseAgent`\\ s (backed by that host's own
+TPU engine). Workers DIAL the orchestrator and register; per registered
+agent the endpoint installs a :class:`RemoteAgent` proxy into
+``serve.agents``, so the router scores remote agents exactly like local
+ones and ``FaultTolerance`` sees their (heartbeat-fed) liveness.
+
+Wire format: newline-delimited JSON on one persistent connection per
+worker. Messages: ``register``/``registered``, ``heartbeat`` (per-agent
+status + load stats), ``execute`` (task payload), ``result``. Tasks and
+results cross the wire as their pydantic JSON dumps — at-least-once
+semantics: a worker death mid-execution fails the proxy's pending futures
+with an unsuccessful :class:`TaskResult`, which flows into Serve's normal
+retry path and re-routes to a healthy agent; Serve's journal covers
+orchestrator death (``checkpoint/journal.py``).
+
+Trust model: the listener is meant for a private interconnect (TPU-pod
+DCN / VPC). An optional shared ``token`` rejects accidental cross-talk;
+it is not cryptographic authentication.
+
+Reference intent with no implementation behind it:
+``pilott/pyproject.toml:19`` (websockets dep),
+``pilott/core/config.py:153-156`` (websocket fields nothing reads).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from pilottai_tpu.core.agent import BaseAgent
+from pilottai_tpu.core.config import AgentConfig
+from pilottai_tpu.core.status import AgentStatus
+from pilottai_tpu.core.task import Task, TaskResult
+from pilottai_tpu.utils.logging import get_logger
+from pilottai_tpu.utils.metrics import global_metrics
+
+_MAX_LINE = 16 * 1024 * 1024  # one message; tasks carry prompts, not tensors
+
+
+class RegistrationRejected(ConnectionError):
+    """The orchestrator refused this worker (bad token / malformed
+    register) — permanent; reconnecting with the same credentials would
+    hammer the endpoint forever."""
+
+
+async def _send(writer: asyncio.StreamWriter, msg: Dict[str, Any]) -> None:
+    writer.write(json.dumps(msg, default=str).encode() + b"\n")
+    await writer.drain()
+
+
+async def _recv(reader: asyncio.StreamReader) -> Dict[str, Any]:
+    line = await reader.readline()
+    if not line:
+        raise ConnectionError("peer closed")
+    return json.loads(line)
+
+
+class RemoteAgent:
+    """Orchestrator-side proxy for an agent hosted by an AgentWorker.
+
+    Implements the surface Serve/TaskRouter/FaultTolerance actually read
+    from :class:`BaseAgent`: identity, ``config`` (role/specializations/
+    capabilities), availability ``status``, load stats, suitability
+    scoring, ``execute_task``, heartbeat age. Load stats arrive with
+    worker heartbeats instead of being computed locally.
+    """
+
+    is_remote = True
+
+    def __init__(self, endpoint: "ServeEndpoint", worker_id: str,
+                 desc: Dict[str, Any]) -> None:
+        self._endpoint = endpoint
+        self.worker_id = worker_id
+        self.id = desc["agent_id"]
+        self.config = AgentConfig(
+            role=desc.get("role", "worker"),
+            specializations=list(desc.get("specializations", [])),
+            required_capabilities=list(desc.get("required_capabilities", [])),
+        )
+        self.role = self.config.role
+        self.status = AgentStatus.IDLE
+        self.dependency_resolver = None  # Serve.start assigns; unused here
+        self._stats: Dict[str, float] = {
+            "queue_utilization": 0.0, "load": 0.0, "success_rate": 1.0,
+        }
+        self._last_heartbeat = time.time()
+        self._log = get_logger(
+            "remote_agent", agent_id=self.id[:8], role=self.role
+        )
+
+    # ----- surface read by TaskRouter / Serve / FaultTolerance -------- #
+
+    @property
+    def queue_utilization(self) -> float:
+        return float(self._stats.get("queue_utilization", 0.0))
+
+    @property
+    def load(self) -> float:
+        return float(self._stats.get("load", 0.0))
+
+    @property
+    def success_rate(self) -> float:
+        return float(self._stats.get("success_rate", 1.0))
+
+    def evaluate_task_suitability(self, task: Task) -> float:
+        """Same shape as ``BaseAgent.evaluate_task_suitability``
+        (reference ``pilott/core/agent.py:549-575``), fed by
+        heartbeat-reported stats."""
+        if not self.status.is_available:
+            return 0.0
+        score = 0.7
+        if task.type in self.config.specializations:
+            score += 0.2
+        caps = set(self.config.required_capabilities)
+        if caps and not set(task.required_capabilities) <= caps:
+            score -= 0.3
+        return max(0.0, min(1.0, score - 0.2 * self.load))
+
+    def heartbeat(self) -> float:
+        return self._last_heartbeat
+
+    def send_heartbeat(self) -> float:
+        # Liveness is owned by the WORKER's heartbeats; a local poke must
+        # not mask a dead connection, so this is a read, not a write.
+        return self._last_heartbeat
+
+    # FaultTolerance replacement hooks: a remote agent's queue lives with
+    # the worker, so there is nothing to detach locally — its in-flight
+    # futures already fail (and re-route) on connection loss.
+    _worker_task = None
+
+    def remove_task(self, task_id: str) -> Optional[Task]:
+        return None
+
+    async def start(self) -> None:
+        if self.status == AgentStatus.CREATED:
+            self.status = AgentStatus.IDLE
+
+    async def stop(self) -> None:
+        self.status = AgentStatus.STOPPED
+
+    def queued_tasks(self) -> List[Task]:
+        return []  # the remote queue lives with the worker's real agent
+
+    async def add_task(self, task: Task) -> None:
+        """Queue-style submission: run remotely in the background (the
+        balancer/scaler move work through this entry point)."""
+        t = asyncio.get_running_loop().create_task(self.execute_task(task))
+        # The loop holds only weak refs to tasks — keep one until done.
+        self._bg = getattr(self, "_bg", set())
+        self._bg.add(t)
+        t.add_done_callback(self._bg.discard)
+
+    async def execute_task(self, task: Task) -> TaskResult:
+        """Mirror BaseAgent.execute_task's local bookkeeping (started/
+        completed marks, BUSY while in flight) around the remote call —
+        the worker's agent marks ITS copy, not the orchestrator's."""
+        task.mark_started(agent_id=self.id)
+        if self.status == AgentStatus.IDLE:
+            self.status = AgentStatus.BUSY
+        self._inflight = getattr(self, "_inflight", 0) + 1
+        try:
+            result = await self._endpoint.execute(self, task)
+        finally:
+            self._inflight -= 1
+            if self._inflight == 0 and self.status == AgentStatus.BUSY:
+                self.status = AgentStatus.IDLE
+        if result.success:
+            task.mark_completed(result)
+        else:
+            task.mark_failed(result.error or "remote execution failed", result)
+        return result
+
+    @property
+    def current_tasks(self) -> Dict[str, Task]:
+        return {}  # in-flight work tracked on the worker side
+
+    def get_health(self) -> Dict[str, Any]:
+        return {
+            "agent_id": self.id,
+            "status": self.status.value,
+            "error_count": 0,
+            "last_heartbeat": self._last_heartbeat,
+            "queue_utilization": self.queue_utilization,
+            "current_tasks": getattr(self, "_inflight", 0),
+        }
+
+    def get_metrics(self) -> Dict[str, Any]:
+        return {
+            "agent_id": self.id,
+            "role": self.role,
+            "status": self.status.value,
+            "remote": True,
+            "worker_id": self.worker_id,
+            **self._stats,
+        }
+
+
+class ServeEndpoint:
+    """TCP listener that attaches remote workers to a running Serve."""
+
+    def __init__(self, serve, host: str = "127.0.0.1", port: int = 0,
+                 token: Optional[str] = None) -> None:
+        self.serve = serve
+        self.host = host
+        self.port = port
+        self.token = token
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._writers: Dict[str, asyncio.StreamWriter] = {}
+        self._proxies: Dict[str, List[RemoteAgent]] = {}
+        self._pending: Dict[str, asyncio.Future] = {}
+        self._log = get_logger("serve_endpoint")
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port, limit=_MAX_LINE
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._log.info("control plane listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for worker_id in list(self._writers):
+            await self._drop_worker(worker_id, "endpoint stopped")
+
+    # ------------------------------------------------------------------ #
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        worker_id = None
+        try:
+            msg = await _recv(reader)
+            if msg.get("type") != "register" or (
+                self.token is not None and msg.get("token") != self.token
+            ):
+                await _send(writer, {"type": "error", "error": "bad register"})
+                writer.close()
+                return
+            worker_id = msg["worker_id"]
+            self._writers[worker_id] = writer
+            proxies = []
+            for desc in msg.get("agents", []):
+                proxy = RemoteAgent(self, worker_id, desc)
+                # Re-registration after a connection blip: the dead proxy
+                # from the previous session still sits in serve.agents
+                # (kept ERROR so FaultTolerance can observe the outage) —
+                # replace it, or add_agent's duplicate-id guard would kill
+                # this handler and strand the reconnecting worker forever.
+                stale = self.serve.agents.get(proxy.id)
+                if isinstance(stale, RemoteAgent):
+                    await self.serve.remove_agent(proxy.id)
+                self.serve.add_agent(proxy)
+                proxies.append(proxy)
+            self._proxies[worker_id] = proxies
+            await _send(writer, {"type": "registered"})
+            self._log.info(
+                "worker %s registered %d agents", worker_id[:8], len(proxies)
+            )
+            global_metrics.inc("control_plane.workers_registered")
+            while True:
+                msg = await _recv(reader)
+                kind = msg.get("type")
+                if kind == "heartbeat":
+                    now = time.time()
+                    stats = msg.get("agents", {})
+                    for proxy in proxies:
+                        proxy._last_heartbeat = now
+                        s = stats.get(proxy.id)
+                        if s:
+                            proxy._stats.update({
+                                k: s[k] for k in
+                                ("queue_utilization", "load", "success_rate")
+                                if k in s
+                            })
+                            try:
+                                proxy.status = AgentStatus(s["status"])
+                            except (KeyError, ValueError):
+                                pass
+                elif kind == "result":
+                    fut = self._pending.pop(msg["req_id"], None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(
+                            TaskResult.model_validate(msg["result"])
+                        )
+                else:
+                    self._log.warning("unknown message type %r", kind)
+        except (ConnectionError, asyncio.IncompleteReadError,
+                json.JSONDecodeError) as exc:
+            if worker_id is not None:
+                self._log.warning(
+                    "worker %s connection lost: %s", worker_id[:8], exc
+                )
+        finally:
+            if worker_id is not None:
+                await self._drop_worker(worker_id, "worker connection lost")
+
+    async def _drop_worker(self, worker_id: str, reason: str) -> None:
+        writer = self._writers.pop(worker_id, None)
+        if writer is not None:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001 — already gone
+                pass
+        for proxy in self._proxies.pop(worker_id, []):
+            proxy.status = AgentStatus.ERROR
+            # Fail this worker's in-flight work so Serve's retry path
+            # re-routes it (at-least-once; BASELINE config #5 story).
+            for req_id, fut in list(self._pending.items()):
+                if req_id.startswith(proxy.id) and not fut.done():
+                    self._pending.pop(req_id, None)
+                    fut.set_result(TaskResult(
+                        success=False,
+                        error=f"remote agent {proxy.id[:8]}: {reason}",
+                    ))
+        global_metrics.inc("control_plane.workers_dropped")
+
+    async def execute(self, proxy: RemoteAgent, task: Task) -> TaskResult:
+        writer = self._writers.get(proxy.worker_id)
+        if writer is None:
+            return TaskResult(
+                success=False,
+                error=f"worker {proxy.worker_id[:8]} not connected",
+            )
+        req_id = f"{proxy.id}:{uuid.uuid4()}"
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        t0 = time.perf_counter()
+        try:
+            await _send(writer, {
+                "type": "execute",
+                "req_id": req_id,
+                "agent_id": proxy.id,
+                "task": task.model_dump(mode="json"),
+            })
+            result = await asyncio.wait_for(fut, timeout=task.timeout)
+        except asyncio.TimeoutError:
+            self._pending.pop(req_id, None)
+            result = TaskResult(
+                success=False,
+                error=f"remote execution timed out after {task.timeout}s",
+            )
+        except ConnectionError as exc:
+            self._pending.pop(req_id, None)
+            result = TaskResult(success=False, error=f"send failed: {exc}")
+        result.execution_time = result.execution_time or (
+            time.perf_counter() - t0
+        )
+        global_metrics.inc("control_plane.remote_executions")
+        return result
+
+
+class AgentWorker:
+    """Worker-process side: hosts real agents, serves remote executions.
+
+    The worker owns its agents' full lifecycle (their LLM handlers run on
+    THIS host's devices), dials the orchestrator, registers, then
+    heartbeats its agents' status/load until stopped. Reconnects with
+    backoff if the orchestrator restarts."""
+
+    def __init__(self, host: str, port: int, agents: List[BaseAgent],
+                 worker_id: Optional[str] = None,
+                 heartbeat_interval: float = 1.0,
+                 token: Optional[str] = None,
+                 reconnect: bool = True) -> None:
+        self.host = host
+        self.port = port
+        self.worker_id = worker_id or str(uuid.uuid4())
+        self.agents = {a.id: a for a in agents}
+        self.heartbeat_interval = heartbeat_interval
+        self.token = token
+        self.reconnect = reconnect
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._stopped = asyncio.Event()
+        self._tasks: List[asyncio.Task] = []
+        # Strong refs to in-flight executions: the loop's task refs are
+        # weak, and stop() must be able to wait for them.
+        self._inflight: set = set()
+        self._log = get_logger("agent_worker", agent_id=self.worker_id[:8])
+
+    async def start(self) -> None:
+        for agent in self.agents.values():
+            await agent.start()
+        self._tasks.append(asyncio.create_task(self._run()))
+
+    async def stop(self) -> None:
+        self._stopped.set()
+        if self._inflight:
+            # Give running executions a moment to report their results
+            # before the agents underneath them stop.
+            await asyncio.wait(list(self._inflight), timeout=5.0)
+        for t in list(self._inflight) + self._tasks:
+            t.cancel()
+        await asyncio.gather(
+            *self._tasks, *list(self._inflight), return_exceptions=True
+        )
+        self._tasks.clear()
+        self._inflight.clear()
+        if self._writer is not None:
+            self._writer.close()
+        for agent in self.agents.values():
+            await agent.stop()
+
+    async def run_until_stopped(self) -> None:
+        await self._stopped.wait()
+
+    # ------------------------------------------------------------------ #
+
+    async def _run(self) -> None:
+        backoff = 0.5
+        while not self._stopped.is_set():
+            try:
+                await self._session()
+                backoff = 0.5
+            except RegistrationRejected as exc:
+                self._log.error("giving up: %s", exc)
+                self._stopped.set()
+                break
+            except (ConnectionError, OSError, asyncio.IncompleteReadError) as exc:
+                self._log.warning("control-plane session ended: %s", exc)
+            if not self.reconnect or self._stopped.is_set():
+                break
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, 10.0)
+
+    async def _session(self) -> None:
+        reader, writer = await asyncio.open_connection(
+            self.host, self.port, limit=_MAX_LINE
+        )
+        self._writer = writer
+        await _send(writer, {
+            "type": "register",
+            "worker_id": self.worker_id,
+            "token": self.token,
+            "agents": [
+                {
+                    "agent_id": a.id,
+                    "role": a.config.role,
+                    "specializations": a.config.specializations,
+                    "required_capabilities": a.config.required_capabilities,
+                }
+                for a in self.agents.values()
+            ],
+        })
+        ack = await _recv(reader)
+        if ack.get("type") != "registered":
+            raise RegistrationRejected(f"registration rejected: {ack}")
+        self._log.info("registered with orchestrator %s:%d", self.host, self.port)
+        hb = asyncio.create_task(self._heartbeat_loop(writer))
+        try:
+            while True:
+                msg = await _recv(reader)
+                if msg.get("type") == "execute":
+                    t = asyncio.get_running_loop().create_task(
+                        self._execute(writer, msg)
+                    )
+                    self._inflight.add(t)
+                    t.add_done_callback(self._inflight.discard)
+        finally:
+            hb.cancel()
+            self._writer = None
+
+    async def _heartbeat_loop(self, writer: asyncio.StreamWriter) -> None:
+        while True:
+            stats = {}
+            for a in self.agents.values():
+                stats[a.id] = {
+                    "status": a.status.value,
+                    "queue_utilization": a.queue_utilization,
+                    "load": a.load,
+                    "success_rate": a.success_rate,
+                }
+            try:
+                await _send(writer, {
+                    "type": "heartbeat",
+                    "worker_id": self.worker_id,
+                    "agents": stats,
+                })
+            except ConnectionError:
+                return
+            await asyncio.sleep(self.heartbeat_interval)
+
+    async def _execute(self, writer: asyncio.StreamWriter,
+                       msg: Dict[str, Any]) -> None:
+        try:
+            task = Task.model_validate(msg["task"])
+            agent = self.agents.get(msg["agent_id"])
+            if agent is None:
+                result = TaskResult(
+                    success=False,
+                    error=f"no agent {msg['agent_id'][:8]} on this worker",
+                )
+            else:
+                result = await agent.execute_task(task)
+        except Exception as exc:  # noqa: BLE001 — report, don't die
+            result = TaskResult(success=False, error=str(exc))
+        try:
+            await _send(writer, {
+                "type": "result",
+                "req_id": msg["req_id"],
+                "result": result.model_dump(mode="json"),
+            })
+        except ConnectionError:
+            self._log.warning(
+                "result for %s lost (connection closed)", msg["req_id"][:16]
+            )

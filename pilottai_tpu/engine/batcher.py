@@ -125,7 +125,7 @@ class ContinuousBatcher:
         num_pages: Optional[int] = None,
         json_tables: Optional[Tuple[Any, Any]] = None,
         speculate: int = 0,
-        prefix_cache: int = 8,
+        prefix_cache: int = 4,  # mirrors LLMConfig.engine_prefix_cache
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -663,9 +663,11 @@ class ContinuousBatcher:
             # Store the prompt MINUS its last token: match() requires a
             # proper prefix (a tail token must produce the first-token
             # logits), so this is what makes an exact repeat hit — as a
-            # one-token tail.
-            ids = tuple(req.prompt_ids[:-1])
-            if not (store.min_len <= len(ids) <= store.max_len):
+            # one-token tail. Prompts past the HBM cap store their first
+            # max_len tokens (prefix K/V is suffix-independent) — the
+            # long-prompt workload is the one that needs caching most.
+            ids = tuple(req.prompt_ids[:-1])[: store.max_len]
+            if len(ids) < store.min_len:
                 continue
             if ids in seen or store.has(ids):
                 continue
