@@ -132,7 +132,12 @@ async def run_bench():
     sec_1b = await bench_model(
         LLMConfig(
             model_name="llama3-1b-byte" if on_accel else "llama-tiny",
-            engine_slots=32, **common,
+            engine_slots=32,
+            # One fused admission per 32-slot wave + chunk 14 so a wave's
+            # 48 tokens fit one dispatch (swept on v5e round 3:
+            # p50 403 -> ~207 ms vs round 2).
+            engine_admit_batch=32,
+            **{**common, "engine_chunk": 14},
         ),
         concurrency=32, steps=96, epochs=3, n_chips=n_chips,
     )

@@ -102,6 +102,10 @@ class LLMConfig(BaseModel):
     # per-token HBM weight stream that bounds decode (models/quant.py).
     quantize: Optional[str] = None
     engine_slots: int = Field(default=8, ge=1)       # continuous-batching slots
+    # Admission group width: prompts prefilled per fused admission
+    # dispatch (padded to this, so compile variants stay bounded). A full
+    # 32-slot wave admits in ceil(32/width) dispatches.
+    engine_admit_batch: int = Field(default=8, ge=1)
     engine_max_seq: Optional[int] = None             # KV length cap (default model max)
     engine_chunk: int = Field(default=16, ge=1)      # decode tokens per dispatch
     # Paged KV cache (ops/paged.py): None = auto (paged when the per-slot

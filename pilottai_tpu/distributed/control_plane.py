@@ -146,6 +146,12 @@ class RemoteAgent:
     async def stop(self) -> None:
         self.status = AgentStatus.STOPPED
 
+    async def reset(self) -> None:
+        """FaultTolerance's in-place recovery hook: re-arm the proxy; the
+        next worker heartbeat restores the true remote status."""
+        if self._endpoint._writers.get(self.worker_id) is not None:
+            self.status = AgentStatus.IDLE
+
     def queued_tasks(self) -> List[Task]:
         return []  # the remote queue lives with the worker's real agent
 
@@ -228,10 +234,15 @@ class ServeEndpoint:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
-            self._server = None
+        # Drop workers BEFORE wait_closed(): on Python >= 3.12.1
+        # wait_closed blocks until every connection handler exits, and
+        # the handlers sit in _recv on their persistent connections —
+        # waiting first deadlocks shutdown with any live worker.
         for worker_id in list(self._writers):
             await self._drop_worker(worker_id, "endpoint stopped")
+        if self._server is not None:
+            await self._server.wait_closed()
+            self._server = None
 
     # ------------------------------------------------------------------ #
 
@@ -301,7 +312,11 @@ class ServeEndpoint:
                     "worker %s connection lost: %s", worker_id[:8], exc
                 )
         finally:
-            if worker_id is not None:
+            # Identity check: a silently-partitioned connection can linger
+            # in _recv until TCP timeout while the worker re-dials and
+            # re-registers; when the dead handler finally errors out it
+            # must not tear down the NEW session it no longer owns.
+            if worker_id is not None and self._writers.get(worker_id) is writer:
                 await self._drop_worker(worker_id, "worker connection lost")
 
     async def _drop_worker(self, worker_id: str, reason: str) -> None:
@@ -420,15 +435,21 @@ class AgentWorker:
         while not self._stopped.is_set():
             try:
                 await self._session()
-                backoff = 0.5
             except RegistrationRejected as exc:
                 self._log.error("giving up: %s", exc)
                 self._stopped.set()
                 break
-            except (ConnectionError, OSError, asyncio.IncompleteReadError) as exc:
+            except (ConnectionError, OSError,
+                    asyncio.IncompleteReadError, json.JSONDecodeError) as exc:
+                # JSONDecodeError too: one garbage line from a crashing
+                # orchestrator must mean "reconnect", not a silently dead
+                # worker loop.
                 self._log.warning("control-plane session ended: %s", exc)
             if not self.reconnect or self._stopped.is_set():
                 break
+            if getattr(self, "_backoff_reset", False):
+                backoff = 0.5
+                self._backoff_reset = False
             await asyncio.sleep(backoff)
             backoff = min(backoff * 2, 10.0)
 
@@ -455,6 +476,10 @@ class AgentWorker:
         if ack.get("type") != "registered":
             raise RegistrationRejected(f"registration rejected: {ack}")
         self._log.info("registered with orchestrator %s:%d", self.host, self.port)
+        # Successful registration resets the reconnect backoff here —
+        # _session only ever EXITS by raising, so a reset after the call
+        # would be dead code and blips would ratchet to max permanently.
+        self._backoff_reset = True
         hb = asyncio.create_task(self._heartbeat_loop(writer))
         try:
             while True:

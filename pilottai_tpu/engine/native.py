@@ -179,6 +179,7 @@ class NativeEngine(LLMBackend):
             self.model_cfg,
             params,
             n_slots=self.config.engine_slots,
+            admit_batch=self.config.engine_admit_batch,
             max_seq_len=max_seq,
             cache_dtype=self.model_cfg.dtype,
             chunk_size=self.config.engine_chunk,

@@ -43,12 +43,16 @@ def flash_shapes_ok(
     block_q: int = 128,
     block_k: int = 128,
 ) -> bool:
-    """Block divisibility plus a VMEM bound: the kernel keeps the full
-    [S, H] K and V resident (double-buffered by the pipeline), so S must
-    fit the budget or Mosaic fails allocation where XLA would have run."""
-    if T % block_q or S % block_k or T < block_q or S < block_k:
+    """Size floor plus a VMEM bound: the kernel keeps the full [S, H]
+    K and V resident (double-buffered by the pipeline), so the PADDED S
+    must fit the budget or Mosaic fails allocation where XLA would have
+    run. Ragged T/S are fine — ``flash_attention`` pads to block
+    multiples internally (VERDICT r2 next-step 8); only tiny shapes,
+    where the pad waste dwarfs the work, stay on XLA."""
+    if T < 16 or S < 16:
         return False
-    kv_bytes = 2 * S * head_dim * itemsize * 2  # K+V, double-buffered
+    s_padded = -(-S // block_k) * block_k
+    kv_bytes = 2 * s_padded * head_dim * itemsize * 2  # K+V, double-buffered
     return kv_bytes <= _FLASH_KV_VMEM_BUDGET
 
 

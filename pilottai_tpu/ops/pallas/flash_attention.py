@@ -355,7 +355,7 @@ def _bwd_dkv_kernel(
 
 def _bwd_impl(
     q, k, v, q_positions, kv_positions, valid, window, o, lse, do,
-    scale, softcap, block_q, block_k, interpret,
+    scale, softcap, block_q, block_k, interpret, dlse=None,
 ):
     B, T, N, H = q.shape
     _, S, K, _ = k.shape
@@ -374,6 +374,11 @@ def _bwd_impl(
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     ).transpose(0, 2, 1)[..., None]
+    if dlse is not None:
+        # lse cotangent (flash_attention_with_lse): d lse_i / d s_ij = p_ij,
+        # so ds_ij = p_ij (dp_ij - delta_i + dlse_i) — exactly the delta
+        # operand shifted. No kernel change needed.
+        delta = delta - dlse.astype(jnp.float32)
 
     dq_kernel = functools.partial(
         _bwd_dq_kernel, scale=scale, softcap=softcap, block_k=block_k
@@ -453,29 +458,33 @@ def _bwd_impl(
 # --------------------------------------------------------------------- #
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
-def _flash(scale, softcap, block_q, block_k, interpret,
-           q, k, v, q_positions, kv_positions, valid, window):
-    o, _ = _fwd_impl(
+def _flash_lse(scale, softcap, block_q, block_k, interpret,
+               q, k, v, q_positions, kv_positions, valid, window):
+    """THE vjp-carrying op: forward returns (o, lse). Plain
+    ``flash_attention`` discards lse (its zero cotangent makes
+    ``delta - dlse`` collapse to the standard flash backward), so one
+    set of vjp rules serves both entry points."""
+    return _fwd_impl(
         q, k, v, q_positions, kv_positions, valid, window,
         scale, softcap, block_q, block_k, interpret,
     )
-    return o
 
 
-def _flash_fwd_rule(scale, softcap, block_q, block_k, interpret,
-                    q, k, v, q_positions, kv_positions, valid, window):
+def _flash_lse_fwd_rule(scale, softcap, block_q, block_k, interpret,
+                        q, k, v, q_positions, kv_positions, valid, window):
     o, lse = _fwd_impl(
         q, k, v, q_positions, kv_positions, valid, window,
         scale, softcap, block_q, block_k, interpret,
     )
-    return o, (q, k, v, q_positions, kv_positions, valid, window, o, lse)
+    return (o, lse), (q, k, v, q_positions, kv_positions, valid, window, o, lse)
 
 
-def _flash_bwd_rule(scale, softcap, block_q, block_k, interpret, res, do):
+def _flash_lse_bwd_rule(scale, softcap, block_q, block_k, interpret, res, ct):
     q, k, v, q_positions, kv_positions, valid, window, o, lse = res
+    do, dlse = ct
     dq, dk, dv = _bwd_impl(
         q, k, v, q_positions, kv_positions, valid, window, o, lse, do,
-        scale, softcap, block_q, block_k, interpret,
+        scale, softcap, block_q, block_k, interpret, dlse=dlse,
     )
 
     def f0(x):
@@ -484,7 +493,67 @@ def _flash_bwd_rule(scale, softcap, block_q, block_k, interpret, res, do):
     return (dq, dk, dv, f0(q_positions), f0(kv_positions), f0(valid), f0(window))
 
 
-_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+_flash_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
+
+
+def _pad_to_blocks(q, k, v, q_positions, kv_positions, block_q, block_k):
+    """Pad T to a block_q multiple and S to a block_k multiple so ragged
+    training shapes stay on the Pallas path (VERDICT r2 next-step 8).
+    Positions edge-replicate (keeps the causal horizon and block-skip
+    bounds sane); K/V pad with zeros and are masked by the kernel's
+    ``jidx < valid`` check; padded QUERY rows produce garbage the caller
+    slices off — and since the pad/slice pair differentiates cleanly,
+    their gradient contribution is exactly zero."""
+    T, S = q.shape[1], k.shape[1]
+    Tp = -(-T // block_q) * block_q
+    Sp = -(-S // block_k) * block_k
+    if Tp != T:
+        q = jnp.pad(q, ((0, 0), (0, Tp - T), (0, 0), (0, 0)))
+        q_positions = jnp.pad(
+            q_positions, ((0, 0), (0, Tp - T)), mode="edge"
+        )
+    if Sp != S:
+        k = jnp.pad(k, ((0, 0), (0, Sp - S), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, Sp - S), (0, 0), (0, 0)))
+        kv_positions = jnp.pad(
+            kv_positions, ((0, 0), (0, Sp - S)), mode="edge"
+        )
+    return q, k, v, q_positions, kv_positions, T
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "softcap", "block_q", "block_k", "interpret"),
+)
+def flash_attention_with_lse(
+    q: jax.Array,             # [B, T, N, H]
+    k: jax.Array,             # [B, S, K, H]
+    v: jax.Array,             # [B, S, K, H]
+    q_positions: jax.Array,   # [B, T]
+    kv_positions: jax.Array,  # [B, S]
+    valid: jax.Array,         # [B] valid kv length (kv INDEX bound)
+    window: jax.Array,
+    scale: Optional[float] = None,
+    softcap: float = 0.0,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Like ``flash_attention`` but also returns the log-sum-exp rows
+    ``[B, T, N, 1]`` (NEG_INF where the row saw no keys) so disjoint
+    KV chunks can be merged exactly — ring attention's per-step form.
+    Differentiable in (q, k, v) INCLUDING through lse. Ragged T/S pad
+    to block multiples internally."""
+    H = q.shape[-1]
+    scale = scale if scale is not None else H ** -0.5
+    q, k, v, q_positions, kv_positions, T = _pad_to_blocks(
+        q, k, v, q_positions, kv_positions, block_q, block_k
+    )
+    o, lse = _flash_lse(
+        scale, softcap, block_q, block_k, interpret,
+        q, k, v, q_positions, kv_positions, valid, window,
+    )
+    return o[:, :T], lse.transpose(0, 2, 1, 3)[:, :T]  # lse -> [B, T, N, 1]
 
 
 @functools.partial(
@@ -508,13 +577,18 @@ def flash_attention(
     """Causal GQA flash attention, differentiable in (q, k, v). Mask
     semantics match ``models/transformer.py`` prefill: attend iff
     kv_pos <= q_pos, kv index < valid, and (window == 0 or
-    q_pos - kv_pos < window)."""
+    q_pos - kv_pos < window). Ragged T/S pad to block multiples
+    internally (the pad/slice pair contributes zero gradient)."""
     H = q.shape[-1]
     scale = scale if scale is not None else H ** -0.5
-    return _flash(
+    q, k, v, q_positions, kv_positions, T = _pad_to_blocks(
+        q, k, v, q_positions, kv_positions, block_q, block_k
+    )
+    out, _ = _flash_lse(
         scale, softcap, block_q, block_k, interpret,
         q, k, v, q_positions, kv_positions, valid, window,
     )
+    return out[:, :T]
 
 
 # --------------------------------------------------------------------- #

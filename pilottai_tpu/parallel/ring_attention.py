@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from pilottai_tpu.ops.attention import NEG_INF
+from pilottai_tpu.ops.attention import NEG_INF, flash_enabled, flash_shapes_ok
 from pilottai_tpu.parallel.sharding import _current_mesh
 
 # Logical shardings of the operands (mesh axes, not logical names, because
@@ -64,12 +64,21 @@ def ring_attention(
     softcap: float = 0.0,
     axis: str = "seq",
     mesh: Optional[Mesh] = None,
+    use_flash: Optional[bool] = None,
+    interpret: bool = False,
 ) -> jax.Array:
     """Causal GQA attention with K/V rotating around the ``axis`` ring.
 
     Mask semantics match ``models/transformer.py`` prefill: attend iff
     kv_pos <= q_pos, kv sequence index < valid, and (window == 0 or
     q_pos - kv_pos < window).
+
+    Each ring step's local block runs through the Pallas flash kernel on
+    TPU (``flash_attention_with_lse``; VERDICT r2 next-step 8 — the ring
+    used to pay dense O(Tl·Tl) XLA math per step). Steps merge by their
+    log-sum-exp rows, which is exact; the lse cotangent flows through the
+    kernel's custom VJP, so training uses the same path. ``use_flash``
+    overrides the TPU autodetect (tests force it with ``interpret``).
     """
     mesh = mesh if mesh is not None else _current_mesh()
     if mesh is None:
@@ -80,6 +89,50 @@ def ring_attention(
     scale = scale if scale is not None else H ** -0.5
     P_ring = mesh.shape[axis]
     window = jnp.asarray(window, jnp.int32)
+    Tl = T // P_ring
+    if use_flash is None:
+        use_flash = flash_enabled() and flash_shapes_ok(
+            Tl, Tl, head_dim=H, itemsize=q.dtype.itemsize
+        )
+
+    def per_device_flash(q, k, v, qpos, valid, window):
+        from pilottai_tpu.ops.pallas.flash_attention import (
+            flash_attention_with_lse,
+        )
+
+        Bl, Tl = q.shape[0], q.shape[1]
+        my = jax.lax.axis_index(axis)
+        kpos = qpos                                   # kv chunk starts local
+        start = jnp.full((1,), my * Tl, jnp.int32)    # chunk's global offset
+
+        M = jnp.full((Bl, Tl, q.shape[2], 1), NEG_INF, jnp.float32)
+        num = jnp.zeros((Bl, Tl, q.shape[2], H), jnp.float32)
+        den = jnp.zeros_like(M)
+
+        perm = [(j, (j + 1) % P_ring) for j in range(P_ring)]
+        for step in range(P_ring):
+            # The kernel's valid is a LOCAL kv-index bound; translate the
+            # global valid length by this chunk's offset in the sequence.
+            valid_eff = jnp.clip(valid - start[0], 0, Tl)
+            o_i, lse_i = flash_attention_with_lse(
+                q, k, v, qpos, kpos, valid_eff, window,
+                scale=scale, softcap=softcap, interpret=interpret,
+            )                                         # o [B,Tl,N,H]; lse [B,Tl,N,1]
+            M_new = jnp.maximum(M, lse_i)
+            w = jnp.where(lse_i > NEG_INF / 2, jnp.exp(lse_i - M_new), 0.0)
+            corr = jnp.where(M > NEG_INF / 2, jnp.exp(M - M_new), 0.0)
+            num = num * corr + o_i.astype(jnp.float32) * w
+            den = den * corr + w
+            M = M_new
+            if step + 1 < P_ring:
+                k = jax.lax.ppermute(k, axis, perm)
+                v = jax.lax.ppermute(v, axis, perm)
+                kpos = jax.lax.ppermute(kpos, axis, perm)
+                start = jax.lax.ppermute(start, axis, perm)
+
+        out = num / jnp.maximum(den, 1e-30)
+        out = jnp.where(den > 0.0, out, 0.0)
+        return out.astype(v.dtype)
 
     def per_device(q, k, v, qpos, valid, window):
         # Local shapes: q [Bl, Tl, Nl, H], k/v [Bl, Tl, Kl, H], qpos [Bl, Tl].
@@ -118,7 +171,7 @@ def ring_attention(
         )
 
     return jax.shard_map(
-        partial(per_device),
+        per_device_flash if use_flash else per_device,
         mesh=mesh,
         in_specs=(_Q_SPEC, _KV_SPEC, _KV_SPEC, _POS_SPEC, _VALID_SPEC, P()),
         out_specs=_Q_SPEC,

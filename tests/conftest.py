@@ -30,6 +30,28 @@ import inspect  # noqa: E402
 
 import pytest  # noqa: E402
 
+# The suite compiles hundreds of XLA:CPU executables in one process; each
+# holds mmap'd JIT code pages that are never unmapped while the jit cache
+# holds the program. Measured: the process crosses vm.max_map_count
+# (65530 default) around 350 tests and LLVM SEGFAULTS on the failed mmap
+# mid-compile. Two defenses: raise the limit when we can (CI images run
+# as root), and drop compiled programs between test modules — modules
+# rarely share shapes, so the recompile cost is small and map growth
+# stays bounded.
+try:  # best-effort; harmless without privileges
+    with open("/proc/sys/vm/max_map_count", "r+") as f:
+        if int(f.read()) < 1_048_576:
+            f.seek(0)
+            f.write("1048576")
+except OSError:
+    pass
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    yield
+    jax.clear_caches()
+
 # pytest-asyncio is not available in this image; provide a minimal strict-mode
 # equivalent: coroutine tests marked ``@pytest.mark.asyncio`` run under
 # ``asyncio.run`` on a fresh event loop per test.

@@ -141,6 +141,31 @@ async def test_worker_reconnects_after_connection_blip():
 
 
 @pytest.mark.asyncio
+async def test_endpoint_stop_with_live_worker_does_not_hang():
+    """Review finding: wait_closed() on 3.12 blocks until every handler
+    exits, so stop() must drop workers first — shutdown with a live
+    worker attached is the normal production case."""
+    serve = _serve()
+    await serve.start()
+    endpoint = ServeEndpoint(serve)
+    await endpoint.start()
+    worker = AgentWorker(
+        "127.0.0.1", endpoint.port, [_mock_agent()], reconnect=False,
+    )
+    await worker.start()
+    try:
+        deadline = time.time() + 10
+        while not serve.agents and time.time() < deadline:
+            await asyncio.sleep(0.05)
+        assert serve.agents
+        # Worker still connected: stop must complete promptly.
+        await asyncio.wait_for(endpoint.stop(), timeout=10)
+    finally:
+        await worker.stop()
+        await serve.stop()
+
+
+@pytest.mark.asyncio
 async def test_endpoint_rejects_bad_token():
     serve = _serve()
     await serve.start()

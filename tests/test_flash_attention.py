@@ -102,10 +102,13 @@ def test_dispatch_gates(monkeypatch):
     monkeypatch.setenv("PILOTTAI_NO_FLASH", "1")
     assert not flash_enabled()  # env kill-switch wins on any platform
     assert flash_shapes_ok(256, 256)
-    assert not flash_shapes_ok(192, 256)
-    assert not flash_shapes_ok(64, 64)          # below one block
+    assert flash_shapes_ok(192, 256)   # ragged T pads internally (round 3)
+    assert flash_shapes_ok(64, 64)     # sub-block pads to one block
+    assert not flash_shapes_ok(8, 8)   # tiny: pad waste dwarfs the work
     assert flash_shapes_ok(8192, 8192, head_dim=128, itemsize=2)
     assert not flash_shapes_ok(16384, 16384, head_dim=128, itemsize=2)  # VMEM
+    # The VMEM bound applies to the PADDED S.
+    assert not flash_shapes_ok(16300, 16300, head_dim=128, itemsize=2)
 
 
 # --------------------------------------------------------------------- #
@@ -245,3 +248,93 @@ def test_flash_sharding_gates():
     assert not flash_sharding_ok(mesh, 8, 8, 1)    # kv heads < TP degree
     sp = create_mesh(MeshConfig(data=1, fsdp=1, model=1, seq=8))
     assert not flash_sharding_ok(sp, 8, 8, 2)      # seq-sharded -> ring path
+
+
+# ------------------- ragged shapes + with-lse (round 3) ------------------ #
+
+@pytest.mark.parametrize("T", [200, 130, 96])
+def test_flash_ragged_T_matches_reference(T):
+    """T % block_q != 0 must stay on the kernel path via internal padding
+    (VERDICT r2 next-step 8) with exact parity."""
+    q, k, v, ps = _setup(T=T)
+    H = q.shape[3]
+    valid = jnp.asarray([T, T - 37], jnp.int32)
+    ref = _reference(q, k, v, ps, valid, 0, 0.0, H**-0.5)
+    got = flash_attention(
+        q, k, v, ps, ps, valid, jnp.int32(0), scale=H**-0.5, interpret=True
+    )
+    assert got.shape == q.shape
+    for b in range(2):
+        n = int(valid[b])
+        np.testing.assert_allclose(got[b, :n], ref[b, :n], atol=2e-5, rtol=2e-5)
+
+
+def test_flash_ragged_grad_matches_reference():
+    """Gradients through the pad/slice pair: padded rows contribute
+    exactly zero; real rows match the XLA reference."""
+    q, k, v, ps = _setup(T=96)
+    H = q.shape[3]
+    T = q.shape[1]
+    valid = jnp.full((2,), T, jnp.int32)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(
+            q, k, v, ps, ps, valid, jnp.int32(0),
+            scale=H**-0.5, interpret=True,
+        )
+        return jnp.sum(o**2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_reference(q, k, v, ps, valid, 0, 0.0, H**-0.5) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+
+def test_flash_with_lse_chunked_merge_and_grad():
+    """flash_attention_with_lse: two disjoint KV chunks merged by their
+    lse rows must equal full attention — forward AND gradient (the dlse
+    cotangent folds into the backward's delta operand)."""
+    from pilottai_tpu.ops.pallas.flash_attention import flash_attention_with_lse
+
+    q, k, v, ps = _setup(T=256)
+    H = q.shape[3]
+    T = q.shape[1]
+    half = T // 2
+    valid = jnp.asarray([T, 200], jnp.int32)
+
+    def merged(q, k, v):
+        outs = []
+        for lo in (0, half):
+            v_eff = jnp.clip(valid - lo, 0, half)
+            o, lse = flash_attention_with_lse(
+                q, k[:, lo:lo + half], v[:, lo:lo + half],
+                ps, ps[:, lo:lo + half], v_eff, jnp.int32(0),
+                scale=H**-0.5, interpret=True,
+            )
+            outs.append((o, lse))
+        (o1, l1), (o2, l2) = outs
+        M = jnp.maximum(l1, l2)
+        w1 = jnp.where(l1 > -2.0**29, jnp.exp(l1 - M), 0.0)
+        w2 = jnp.where(l2 > -2.0**29, jnp.exp(l2 - M), 0.0)
+        den = jnp.maximum(w1 + w2, 1e-30)
+        out = (o1 * w1 + o2 * w2) / den
+        return jnp.where((w1 + w2) > 0, out, 0.0)
+
+    def full(q, k, v):
+        return _reference(q, k, v, ps, valid, 0, 0.0, H**-0.5)
+
+    np.testing.assert_allclose(
+        merged(q, k, v), full(q, k, v), atol=2e-5, rtol=2e-5
+    )
+    wmask = (
+        jnp.arange(T)[None, :, None, None] < valid[:, None, None, None]
+    )
+    g1 = jax.grad(lambda *a: jnp.sum((merged(*a) * wmask) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: jnp.sum((full(*a) * wmask) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)

@@ -103,3 +103,50 @@ def test_trainer_context_parallel_matches_dense(mesh):
         losses[cp] = float(m["loss"])
     assert np.isfinite(losses[True])
     np.testing.assert_allclose(losses[True], losses[False], rtol=2e-3)
+
+
+def test_ring_flash_path_matches_reference(mesh):
+    """The flash-in-ring path (per-step Pallas kernel + lse merge,
+    VERDICT r2 next-step 8) — forced on with interpret mode on CPU —
+    must match the dense oracle including ragged valid lengths."""
+    q, k, v, ps = _setup()
+    T, H = q.shape[1], q.shape[3]
+    valid = jnp.asarray([64, 50, 64, 40], jnp.int32)
+    mask = make_attention_mask(ps, T, valid)
+    ref = dot_product_attention(q, k, v, mask=mask, scale=H**-0.5)
+    with jax.set_mesh(mesh):
+        got = jax.jit(
+            lambda *a: ring_attention(
+                *a, scale=H**-0.5, mesh=mesh,
+                use_flash=True, interpret=True,
+            )
+        )(q, k, v, ps, valid, jnp.int32(0))
+    for b in range(4):
+        n = int(valid[b])
+        np.testing.assert_allclose(ref[b, :n], got[b, :n], atol=1e-5, rtol=1e-5)
+
+
+def test_ring_flash_gradients_match(mesh):
+    """Training goes through the flash-in-ring path: gradients must match
+    the dense reference (lse cotangents through the kernel VJP)."""
+    q, k, v, ps = _setup()
+    T, H = q.shape[1], q.shape[3]
+    valid = jnp.asarray([64, 50, 64, 40], jnp.int32)
+    wmask = jnp.arange(T)[None, :, None, None] < valid[:, None, None, None]
+    mask = make_attention_mask(ps, T, valid)
+
+    def loss_ref(q, k, v):
+        o = dot_product_attention(q, k, v, mask=mask, scale=H**-0.5)
+        return jnp.sum((o * wmask) ** 2)
+
+    def loss_ring(q, k, v):
+        o = ring_attention(q, k, v, ps, valid, jnp.int32(0),
+                           scale=H**-0.5, mesh=mesh,
+                           use_flash=True, interpret=True)
+        return jnp.sum((o * wmask) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    with jax.set_mesh(mesh):
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_ring):
+        np.testing.assert_allclose(a, b, atol=1e-3, rtol=1e-3)
