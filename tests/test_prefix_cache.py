@@ -44,9 +44,9 @@ def test_store_lcp_candidates():
     assert cands == [len(base)]
 
 
-async def _engine(prefix_cache, speculate=0):
+async def _engine(prefix_cache, speculate=0, model="llama-tiny"):
     h = LLMHandler(LLMConfig(
-        model_name="llama-tiny", provider="cpu", engine_slots=4,
+        model_name=model, provider="cpu", engine_slots=4,
         engine_max_seq=256, engine_chunk=4, dtype="float32",
         engine_prefix_cache=prefix_cache, engine_speculate=speculate,
     ))
@@ -60,18 +60,21 @@ LONG = ("You are the orchestrator. Analyze the task and respond with "
 
 
 @pytest.mark.asyncio
-async def test_hit_output_identical_to_cold_engine():
+@pytest.mark.parametrize("model", ["llama-tiny", "gemma-tiny"])
+async def test_hit_output_identical_to_cold_engine(model):
+    """gemma-tiny exercises admit_group_prefix's sliding-window branch
+    (the per-layer windowed tail attention) — llama never enters it."""
     params = GenerationParams(max_new_tokens=12, temperature=0.0)
     prompt = LONG + "summarize the report"
 
-    cold = await _engine(prefix_cache=0)
+    cold = await _engine(prefix_cache=0, model=model)
     try:
         want = (await cold.generate_response(
             [ChatMessage(content=prompt)], params=params)).content
     finally:
         await cold.stop()
 
-    warm = await _engine(prefix_cache=8)
+    warm = await _engine(prefix_cache=8, model=model)
     try:
         h0 = global_metrics.get("engine.prefix_hits")
         first = (await warm.generate_response(

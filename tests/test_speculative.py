@@ -80,10 +80,15 @@ PROMPTS = [
 ]
 
 
-def test_spec_chunk_greedy_parity():
+@pytest.mark.parametrize(
+    "model", ["llama-tiny", "gemma-tiny", "moe-tiny"]
+)
+def test_spec_chunk_greedy_parity(model):
     """decode_chunk_spec emits the same greedy token stream as
-    decode_chunk, block by block, including cache lengths."""
-    cfg = get_model_config("llama-tiny")
+    decode_chunk, block by block, including cache lengths — across the
+    families (gemma-tiny covers the sliding-window + softcap branches of
+    the spec block attention; moe-tiny the expert MLP)."""
+    cfg = get_model_config(model)
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
     budgets = [25, 25, 25]
 
@@ -111,7 +116,7 @@ def test_spec_chunk_greedy_parity():
     np.testing.assert_array_equal(
         np.asarray(c1.lengths), np.asarray(c2.lengths)
     )
-    # History mirrors prompt + generated per position.
+    # History mirrors prompt + generated per position (all families).
     h = np.asarray(h2)
     for b in range(3):
         gen = [f2[b]] + spec[b]
