@@ -78,6 +78,11 @@ class TaskRouter:
                 ]
                 if available:
                     best = max(available, key=lambda a: self._score(a, task))
+                    # Drop the winner's cached score: its load just
+                    # changed by this very dispatch, and serving it from
+                    # the TTL cache piles whole bursts onto one agent
+                    # while its peers idle.
+                    self._score_cache.pop((best.id, task.type), None)
                     self._log.debug(
                         "routed task %s -> agent %s", task.id[:8], best.id[:8]
                     )

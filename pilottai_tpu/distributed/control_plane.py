@@ -90,6 +90,7 @@ class RemoteAgent:
         self._stats: Dict[str, float] = {
             "queue_utilization": 0.0, "load": 0.0, "success_rate": 1.0,
         }
+        self._inflight = 0
         self._last_heartbeat = time.time()
         self._log = get_logger(
             "remote_agent", agent_id=self.id[:8], role=self.role
@@ -99,11 +100,20 @@ class RemoteAgent:
 
     @property
     def queue_utilization(self) -> float:
+        # Availability gating (router load_threshold) stays purely
+        # heartbeat-driven: folding local in-flight here would EXCLUDE a
+        # proxy with capacity from routing entirely ("no available
+        # agent" hard failures on bursts) instead of just deprioritizing
+        # it.
         return float(self._stats.get("queue_utilization", 0.0))
 
     @property
     def load(self) -> float:
-        return float(self._stats.get("load", 0.0))
+        # Score penalty: heartbeat load lags by an interval, so fold in
+        # the requests THIS orchestrator already routed — known load
+        # right now. Affects ranking only, never availability.
+        inflight = min(1.0, self._inflight / 4.0)
+        return max(float(self._stats.get("load", 0.0)), inflight)
 
     @property
     def success_rate(self) -> float:
@@ -171,7 +181,7 @@ class RemoteAgent:
         task.mark_started(agent_id=self.id)
         if self.status == AgentStatus.IDLE:
             self.status = AgentStatus.BUSY
-        self._inflight = getattr(self, "_inflight", 0) + 1
+        self._inflight += 1
         try:
             result = await self._endpoint.execute(self, task)
         finally:
@@ -195,7 +205,7 @@ class RemoteAgent:
             "error_count": 0,
             "last_heartbeat": self._last_heartbeat,
             "queue_utilization": self.queue_utilization,
-            "current_tasks": getattr(self, "_inflight", 0),
+            "current_tasks": self._inflight,
         }
 
     def get_metrics(self) -> Dict[str, Any]:

@@ -217,16 +217,15 @@ _WORKER_CHILD = textwrap.dedent(
 )
 
 
-def _spawn_worker(tmp_path, port, latency=0.0):
+def _spawn_script(tmp_path, script_text, timeout=60):
+    """Spawn a worker subprocess, drain its output on a thread (a full
+    pipe would block the child), and wait for its WORKER-UP line."""
     script = tmp_path / "worker.py"
-    script.write_text(
-        _WORKER_CHILD.format(repo=str(REPO), port=port, latency=latency)
-    )
+    script.write_text(script_text)
     proc = subprocess.Popen(
         [sys.executable, str(script)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
-    # Block until the worker is up (prints WORKER-UP) or dies.
     import queue as _q
     import threading
 
@@ -235,16 +234,28 @@ def _spawn_worker(tmp_path, port, latency=0.0):
         target=lambda: [lines.put(ln) for ln in proc.stdout],  # type: ignore[union-attr]
         daemon=True,
     ).start()
-    deadline = time.time() + 60
+    seen = []
+    deadline = time.time() + timeout
     while time.time() < deadline:
         try:
-            if "WORKER-UP" in lines.get(timeout=1.0):
+            ln = lines.get(timeout=1.0)
+            seen.append(ln)
+            if "WORKER-UP" in ln:
                 return proc
         except _q.Empty:
             if proc.poll() is not None:
                 break
     proc.kill()
-    raise AssertionError("worker subprocess never came up")
+    raise AssertionError(
+        "worker subprocess never came up; output:\n" + "".join(seen[-30:])
+    )
+
+
+def _spawn_worker(tmp_path, port, latency=0.0):
+    return _spawn_script(
+        tmp_path,
+        _WORKER_CHILD.format(repo=str(REPO), port=port, latency=latency),
+    )
 
 
 @pytest.mark.asyncio
@@ -265,6 +276,72 @@ async def test_two_process_remote_execution(tmp_path):
         proxy = next(iter(serve.agents.values()))
         assert task.agent_id == proxy.id
         assert proxy.role == "remote-processor"  # defined only in the child
+    finally:
+        proc.kill()
+        await endpoint.stop()
+        await serve.stop()
+
+
+_NATIVE_WORKER_CHILD = textwrap.dedent(
+    """
+    import asyncio, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from pilottai_tpu.core.agent import BaseAgent
+    from pilottai_tpu.core.config import AgentConfig, LLMConfig, SamplingConfig
+    from pilottai_tpu.distributed import AgentWorker
+    from pilottai_tpu.engine.handler import LLMHandler
+
+    async def main():
+        # A REAL in-tree engine on this worker's own devices — the
+        # deployment story: each TPU-VM host serves its agents locally.
+        agent = BaseAgent(
+            config=AgentConfig(role="native-worker"),
+            llm=LLMHandler(LLMConfig(
+                model_name="llama-tiny", provider="cpu", engine_slots=2,
+                engine_max_seq=128, engine_chunk=4, dtype="float32",
+                sampling=SamplingConfig(max_new_tokens=8, temperature=0.0),
+            )),
+        )
+        worker = AgentWorker("127.0.0.1", {port}, [agent],
+                             heartbeat_interval=0.2)
+        await worker.start()
+        print("WORKER-UP", flush=True)
+        await worker.run_until_stopped()
+
+    asyncio.run(main())
+    """
+)
+
+
+@pytest.mark.asyncio
+async def test_remote_agent_backed_by_native_engine(tmp_path):
+    """The control plane's whole point: a worker host serving its agents
+    with ITS OWN in-tree JAX engine. The orchestrator routes a task to
+    it and gets a real generation back across the process boundary."""
+    serve = _serve()
+    await serve.start()
+    endpoint = ServeEndpoint(serve)
+    await endpoint.start()
+    proc = _spawn_script(
+        tmp_path,
+        _NATIVE_WORKER_CHILD.format(repo=str(REPO), port=endpoint.port),
+        timeout=180,  # engine cold-start compiles before WORKER-UP
+    )
+    try:
+        deadline = time.time() + 60
+        while not serve.agents and time.time() < deadline:
+            await asyncio.sleep(0.2)
+        assert serve.agents, "native worker never registered"
+        task = await serve.add_task("process this on the remote engine")
+        # Engine cold-start (compile) happens inside the remote step.
+        result = await serve.wait_for(task.id, timeout=240)
+        assert result.success
+        proxy = next(iter(serve.agents.values()))
+        assert proxy.role == "native-worker"
+        assert task.agent_id == proxy.id
     finally:
         proc.kill()
         await endpoint.stop()
