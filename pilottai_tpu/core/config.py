@@ -123,7 +123,8 @@ class LLMConfig(BaseModel):
     # Automatic prefix caching: keep the K/V of the last N admitted
     # prompt prefixes on device; repeated/shared prefixes skip their
     # prefill FLOPs (engine/prefix_cache.py). 0 disables; dense KV only.
-    # Each entry costs L x K x min(len,1024) x H x 4 bytes of HBM.
+    # Entry HBM cost: 2 (K and V) x L x K x bucket(len, cap 1024) x H x
+    # itemsize — ~67 MB for llama3-8b bf16 at bucket 512.
     engine_prefix_cache: int = Field(default=4, ge=0)
     seed: int = 0                                    # param init seed when no checkpoint
 
