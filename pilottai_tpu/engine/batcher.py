@@ -44,11 +44,13 @@ from pilottai_tpu.engine.decode import (
     DecodeState,
     admit_group,
     admit_group_prefix,
+    admit_group_prefix_paged,
     decode_chunk,
     decode_chunk_spec,
     export_prefix,
     release_decode,
 )
+from pilottai_tpu.engine.page_prefix import PagePrefixIndex
 from pilottai_tpu.engine.prefix_cache import PrefixStore
 from pilottai_tpu.engine.sampling import SamplingState
 from pilottai_tpu.models.common import ModelConfig
@@ -182,30 +184,14 @@ class ContinuousBatcher:
         )
 
         # Speculative decoding: verify-blocks of ``speculate`` tokens per
-        # weight pass (engine/decode.py:decode_chunk_spec). Dense cache
-        # only — the paged chunk keeps one-token steps.
-        if speculate and paged:
-            self._log.warning(
-                "speculative decode not supported with the paged KV "
-                "cache; disabling speculation"
-            )
-            speculate = 0
+        # weight pass (engine/decode.py:decode_chunk_spec) — both caches
+        # (the paged chunk reads its prefix through the block table).
         self.speculate = speculate if speculate >= 2 else 0
-        # Automatic prefix caching (engine/prefix_cache.py): admitted
-        # prompts' K/V panels are kept and reused so repeated/shared
-        # prefixes skip their prefill FLOPs. Dense cache only.
-        self.prefix_store = (
-            PrefixStore(
-                capacity=prefix_cache,
-                min_len=min_bucket,
-                # Prompt-length cap bounds HBM: a 2048-row 8B entry is
-                # ~540 MB; capacity x 1024 rows keeps the store around
-                # 0.5 GB worst case next to 8 GB of weights on a 16 GB
-                # chip.
-                max_len=min(max_seq_len or cfg.max_seq_len, 1024),
-            )
-            if prefix_cache > 0 and not paged else None
-        )
+        # Warmup sweeps must compile the FULL-prefill buckets — gate the
+        # paged index during warmup so warmup prompts (which share
+        # prefixes by construction) don't short-circuit into the
+        # tail-prefill path.
+        self._warming = False
         # Observed tokens-per-block EMA (1.0 = no acceptance; up to D).
         # Drives the in-flight token estimates: dispatching assuming no
         # acceptance wastes whole weight passes on no-op chunks (measured
@@ -243,6 +229,33 @@ class ContinuousBatcher:
             if usable < self.max_seq_len:
                 self.max_seq_len = usable
             self.max_pages_per_slot = -(-self.max_seq_len // page_size)
+        # Automatic prefix caching. Dense cache: panel-copy store
+        # (engine/prefix_cache.py). Paged cache: block-granular radix of
+        # refcounted pages (engine/page_prefix.py) — shared prefixes are
+        # MAPPED into new slots' block tables, never copied, and
+        # granularity is per page rather than per whole prompt.
+        self.prefix_store = None
+        self.page_index = None
+        if prefix_cache > 0:
+            if paged:
+                self.page_index = PagePrefixIndex(
+                    page_size,
+                    # Cap pinned pages at a quarter of the allocatable
+                    # pool so caching can never crowd out admissions'
+                    # working set (admission pressure can also reclaim
+                    # on demand via evict()).
+                    capacity_pages=max((self.num_pages - 1) // 4, 1),
+                )
+            else:
+                self.prefix_store = PrefixStore(
+                    capacity=prefix_cache,
+                    min_len=min_bucket,
+                    # Prompt-length cap bounds HBM: a 2048-row 8B entry
+                    # is ~540 MB; capacity x 1024 rows keeps the store
+                    # around 0.5 GB worst case next to 8 GB of weights
+                    # on a 16 GB chip.
+                    max_len=min(max_seq_len or cfg.max_seq_len, 1024),
+                )
         self._rebuild_device_state()
         self._slots: List[Optional[_Slot]] = [None] * n_slots
         # Admission generation per slot: chunk results are stamped with the
@@ -328,13 +341,17 @@ class ContinuousBatcher:
             prompt_lens = tuple(sorted(
                 {self._bucket(n) for n in range(1, self.max_seq_len + 1)}
             ))
-        for plen in prompt_lens:
-            plen = min(plen, self.max_seq_len - 8)
-            req = GenRequest(
-                prompt_ids=list(range(2, 2 + plen)), max_new_tokens=2
-            )
-            self.submit(req)
-            req.future.result(timeout=900)
+        self._warming = True
+        try:
+            for plen in prompt_lens:
+                plen = min(plen, self.max_seq_len - 8)
+                req = GenRequest(
+                    prompt_ids=list(range(2, 2 + plen)), max_new_tokens=2
+                )
+                self.submit(req)
+                req.future.result(timeout=900)
+        finally:
+            self._warming = False
 
     # ------------------------------------------------------------------ #
     # Submission (any thread)
@@ -385,7 +402,21 @@ class ContinuousBatcher:
         [prefix_len, prefix_len + tail_bucket) and dynamic_update_slice
         CLAMPS out-of-range starts — an oversized hit would silently
         shift the tail onto the cached prefix rows (KV corruption), so
-        it must fall back to the full-prefill path instead."""
+        it must fall back to the full-prefill path instead.
+
+        Paged cache: block-granular radix match instead — returns a
+        PageNode whose ``path_pages`` get mapped (not copied) into the
+        slot's block table. No clamp hazard there (writes go through the
+        table), so the only fit check is that the prefix leaves room."""
+        if self.page_index is not None:
+            if self._warming:
+                return None
+            node = self.page_index.match(req.prompt_ids)
+            if node is None:
+                return None
+            if node.depth * self.page_size >= self.max_seq_len:
+                return None
+            return node
         if self.prefix_store is None:
             return None
         entry = self.prefix_store.match(req.prompt_ids)
@@ -466,6 +497,9 @@ class ContinuousBatcher:
                     if group and key is not group_key:
                         break  # next group picks it up
                     group_key = key
+                    prefix_pages: Tuple[int, ...] = ()
+                    if self.page_index is not None and key is not None:
+                        prefix_pages = key.path_pages
                     if self.alloc is not None:
                         # Clamp to slot capacity: decode stops at
                         # ctx-full anyway, so the cache never holds more
@@ -476,15 +510,40 @@ class ContinuousBatcher:
                             len(req.prompt_ids) + req.max_new_tokens,
                             self.max_seq_len,
                         )
-                        if not self.alloc.can_allocate(need):
-                            # Head-of-line waits for pages (FIFO fairness);
-                            # completions will free them.
-                            blocked = True
-                            break
+                        if not self.alloc.can_allocate(
+                            need, len(prefix_pages)
+                        ):
+                            # Reclaim cached prefix pages before declaring
+                            # the head blocked — caching must never starve
+                            # admission. The hit's own chain is protected
+                            # (evicting it would free pages we are about
+                            # to map).
+                            short = (
+                                self.alloc.pages_needed(need)
+                                - len(prefix_pages)
+                                - self.alloc.free_pages
+                            )
+                            if not (
+                                self.page_index is not None
+                                and short > 0
+                                and self.page_index.evict(
+                                    short, self.alloc,
+                                    protect=frozenset(prefix_pages),
+                                ) > 0
+                                and self.alloc.can_allocate(
+                                    need, len(prefix_pages)
+                                )
+                            ):
+                                # Head-of-line waits for pages (FIFO
+                                # fairness); completions will free them.
+                                blocked = True
+                                break
                     self._backlog.popleft()
                     idx = free.pop(0)
                     if self.alloc is not None:
-                        ok = self.alloc.allocate(idx, need)
+                        ok = self.alloc.allocate(
+                            idx, need, prefix_pages=prefix_pages
+                        )
                         assert ok, "can_allocate/allocate disagree"
                     group.append((idx, req))
                 if not group:
@@ -561,7 +620,56 @@ class ContinuousBatcher:
             if any(req.json_mode for _, req in group) else None
         )
 
-        if entry is not None:
+        if entry is not None and self.page_index is not None:
+            # Paged block-granular hit: the shared chain's pages are
+            # already mapped into each slot's block table by the
+            # allocator — no panel copy exists anywhere. Prefill only
+            # the tails, with prefix attention reading the shared pages.
+            k = entry.depth
+            plen = k * self.page_size
+            kb = 1
+            while kb < k:
+                kb *= 2
+            pages_arr = np.full((kb,), self.alloc.sentinel, np.int32)
+            pages_arr[:k] = entry.path_pages
+            Tt = self._tail_bucket(
+                max(len(r.prompt_ids) - plen for _, r in group)
+            )
+            Tf = self._bucket(max(len(r.prompt_ids) for _, r in group))
+            tail_tokens = np.zeros((A, Tt), np.int32)
+            tail_lens = np.zeros((A,), np.int32)
+            full_tokens = np.zeros((A, Tf), np.int32)
+            for row, (idx, req) in enumerate(group):
+                tail = req.prompt_ids[plen:]
+                tail_tokens[row, : len(tail)] = tail
+                tail_lens[row] = len(tail)
+                full_tokens[row, : len(req.prompt_ids)] = req.prompt_ids
+            pr = np.full(
+                (A, self.max_pages_per_slot), self.alloc.sentinel, np.int32
+            )
+            for row, (idx, _) in enumerate(group):
+                pr[row] = self.alloc.table[idx]
+            with global_metrics.timer("engine.prefill_latency"):
+                (
+                    self.cache, self.dstate, self.sampling, first,
+                    self.history,
+                ) = admit_group_prefix_paged(
+                    self.params, self.cfg, self.cache, self.dstate,
+                    self.sampling, jnp.asarray(pages_arr),
+                    jnp.int32(plen), jnp.asarray(tail_tokens),
+                    jnp.asarray(tail_lens), jnp.asarray(full_tokens),
+                    jnp.asarray(slots), jnp.asarray(pr),
+                    jnp.asarray(temps), jnp.asarray(topks),
+                    jnp.asarray(topps), jnp.asarray(seeds),
+                    jnp.asarray(eos), jnp.asarray(jsonm),
+                    jnp.asarray(budgets), n_prefix_bucket=kb,
+                    json_tables=group_json, history=self.history,
+                )
+            global_metrics.inc("engine.prefix_hits", len(group))
+            # Blocks past the shared chain that the prompt fully covers
+            # are immutable too — register them as chain extensions.
+            self._maybe_register(group)
+        elif entry is not None:
             # Cached-prefix admission: copy the stored panels, prefill
             # only the tails (an exact repeat is a one-token tail). Tail
             # buckets get an 8-floor ladder of their own: the 64-floor
@@ -634,7 +742,10 @@ class ContinuousBatcher:
                     page_rows=page_rows, json_tables=group_json,
                     history=self.history,
                 )
-            self._maybe_export(group)
+            if self.paged:
+                self._maybe_register(group)
+            else:
+                self._maybe_export(group)
         try:
             first.copy_to_host_async()
         except AttributeError:
@@ -649,6 +760,25 @@ class ContinuousBatcher:
                 ([(idx, self._gen[idx]) for idx, _ in group], first)
             )
         global_metrics.inc("engine.admitted", len(group))
+
+    def _maybe_register(self, group: List[Tuple[int, GenRequest]]) -> None:
+        """After a paged admission (miss or hit), pin the admitted
+        prompts' fully-covered pages into the radix index so future
+        prompts sharing page-aligned prefixes map them directly. Only
+        blocks fully inside the prompt are registered — they are
+        immutable (decode writes start at ``prompt_len``); the partial
+        last block keeps taking decode writes and stays private."""
+        if self.page_index is None or self._warming:
+            return
+        P = self.page_size
+        for idx, req in group:
+            nb = len(req.prompt_ids) // P
+            if nb == 0:
+                continue
+            pages = [int(p) for p in self.alloc.table[idx, :nb]]
+            self.page_index.register(
+                req.prompt_ids[: nb * P], pages, self.alloc
+            )
 
     def _maybe_export(self, group: List[Tuple[int, GenRequest]]) -> None:
         """After a miss admission, copy new prompts' K/V out of the slot
@@ -791,7 +921,8 @@ class ContinuousBatcher:
                     self.params, self.cfg, self.cache, self.dstate,
                     self.sampling, self.history, self.chunk_size,
                     self.speculate, prefix_bound=prefix_bound,
-                    json_tables=chunk_json,
+                    json_tables=chunk_json, table=table,
+                    use_pallas=self.paged and self.use_pallas,
                 )
             else:
                 toks, valid, self.cache, self.dstate, self.sampling = (
@@ -903,6 +1034,11 @@ class ContinuousBatcher:
                 self.num_pages, self.page_size, self.n_slots,
                 self.max_pages_per_slot,
             )
+            # A fresh pool invalidates every cached page — reset the
+            # index's bookkeeping (the allocator above is new, so no
+            # unpinning against the old one).
+            if getattr(self, "page_index", None) is not None:
+                self.page_index.clear()
         else:
             self.cache = KVCache.create(
                 self.cfg.n_layers, self.n_slots, self.max_seq_len,
@@ -1012,6 +1148,11 @@ class ContinuousBatcher:
                 {"prefix_entries": len(self.prefix_store),
                  "prefix_hits": global_metrics.get("engine.prefix_hits")}
                 if self.prefix_store is not None else {}
+            ),
+            **(
+                {"prefix_pages": self.page_index.pinned_pages,
+                 "prefix_hits": global_metrics.get("engine.prefix_hits")}
+                if self.page_index is not None else {}
             ),
             "decode_steps": global_metrics.get("engine.decode_steps"),
             "completed": global_metrics.get("engine.completed"),

@@ -423,8 +423,8 @@ def _merge_stats(acc_a, m_a, l_a, acc_b, m_b, l_b):
 
 def _spec_block_attn(
     qg: jax.Array,       # [B, K, G, D, H] block queries
-    layer_k: jax.Array,  # [B, K, Sb, H] bounded prefix panels
-    layer_v: jax.Array,
+    layer_k: jax.Array,  # [B, K, Sb, H] bounded prefix panels (None when
+    layer_v: jax.Array,  # prefix_stats is given)
     ring_k: jax.Array,   # [B, K, R, H] chunk ring (row r = position start+r)
     ring_v: jax.Array,
     blk_k: jax.Array,    # [B, K, D, H] the block's own keys
@@ -436,34 +436,44 @@ def _spec_block_attn(
     scale: float,
     softcap: float,
     window: int,
+    prefix_stats: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+    # ^ precomputed (acc_p [B,K,G,D,H], m_p [B,K,G,D], l_p) — the Pallas
+    # paged kernel's output; skips the dense prefix pass.
 ) -> jax.Array:
     """Three-source attention for a speculative block: bounded prefix
     panels + in-chunk ring (per-slot valid count) + the block itself
     (causal). Dense XLA on purpose: decode attention is HBM-bound and
     dense beat the Pallas prefix kernel at serving context sizes
-    (measured on v5e, round 2)."""
+    (measured on v5e, round 2). The paged-pool path supplies its prefix
+    partials via ``prefix_stats`` instead (its pages never materialize
+    as dense panels)."""
     B, K, G, D, H = qg.shape
 
     def softcapped(s):
         return jnp.tanh(s / softcap) * softcap if softcap > 0.0 else s
 
-    # Prefix: every block query sees the whole valid prefix.
-    s = softcapped(jnp.einsum(
-        "bkgdh,bksh->bkgds", qg, layer_k,
-        preferred_element_type=jnp.float32,
-    ) * scale)
-    col = jnp.arange(layer_k.shape[2])[None, None, None, None, :]
-    mask = col <= last[:, None, None, None, None]
-    if window > 0:
-        mask &= (qpos[:, None, None, :, None] - col) < window
-    s = jnp.where(mask, s, NEG_INF)
-    m_p = jnp.max(s, axis=-1)
-    p = jnp.where(m_p[..., None] > NEG_INF / 2, jnp.exp(s - m_p[..., None]), 0.0)
-    l_p = jnp.sum(p, axis=-1)
-    acc_p = jnp.einsum(
-        "bkgds,bksh->bkgdh", p.astype(layer_v.dtype), layer_v,
-        preferred_element_type=jnp.float32,
-    )
+    if prefix_stats is not None:
+        acc_p, m_p, l_p = prefix_stats
+    else:
+        # Prefix: every block query sees the whole valid prefix.
+        s = softcapped(jnp.einsum(
+            "bkgdh,bksh->bkgds", qg, layer_k,
+            preferred_element_type=jnp.float32,
+        ) * scale)
+        col = jnp.arange(layer_k.shape[2])[None, None, None, None, :]
+        mask = col <= last[:, None, None, None, None]
+        if window > 0:
+            mask &= (qpos[:, None, None, :, None] - col) < window
+        s = jnp.where(mask, s, NEG_INF)
+        m_p = jnp.max(s, axis=-1)
+        p = jnp.where(
+            m_p[..., None] > NEG_INF / 2, jnp.exp(s - m_p[..., None]), 0.0
+        )
+        l_p = jnp.sum(p, axis=-1)
+        acc_p = jnp.einsum(
+            "bkgds,bksh->bkgdh", p.astype(layer_v.dtype), layer_v,
+            preferred_element_type=jnp.float32,
+        )
 
     # Ring: rows < offset are live; row r sits at position start + r.
     R = ring_k.shape[2]
@@ -513,7 +523,7 @@ def _spec_block_attn(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "n_steps", "draft_len", "prefix_bound"),
+    static_argnames=("cfg", "n_steps", "draft_len", "prefix_bound", "use_pallas"),
     donate_argnames=("cache", "dstate", "sampling", "history"),
 )
 def decode_chunk_spec(
@@ -527,6 +537,9 @@ def decode_chunk_spec(
     draft_len: int,          # D >= 2: block width (1 current + D-1 drafts)
     prefix_bound: Optional[int] = None,
     json_tables: Optional[Tuple[jax.Array, jax.Array]] = None,
+    table: Optional[jax.Array] = None,  # [B, max_pages] — paged cache only
+    use_pallas: bool = False,           # paged prefix reads via the Pallas
+                                        # kernel (TPU); else gather fallback
 ) -> Tuple[jax.Array, jax.Array, KVCache, DecodeState, SamplingState, jax.Array]:
     """Speculative fused chunk: ``n_steps`` verify-blocks of ``draft_len``
     tokens per dispatch. Same contract as ``decode_chunk`` except the
@@ -536,21 +549,45 @@ def decode_chunk_spec(
     Greedy slots emit ``accepted + 1`` tokens per weight pass —
     bit-identical to the non-speculative chunk's output. Sampled slots
     emit exactly one sampled token per block (identical distribution;
-    different PRNG stream)."""
+    different PRNG stream).
+
+    Works on BOTH caches: dense panels are read through bounded slices;
+    paged pools through the block table — the extended Pallas paged
+    kernel streams each block's D queries against the slot's pages
+    (``q_blocks``), or the XLA fallback materializes bounded dense
+    panels once per chunk (pool contents are frozen during the scan)."""
     from pilottai_tpu.engine.sampling import _apply_json_mask, _advance_json
 
     B = dstate.tokens.shape[0]
     D = draft_len
     assert D >= 2, "draft_len < 2 is plain decode_chunk"
-    S = cache.max_len
-    Sb = S if prefix_bound is None else max(1, min(prefix_bound, S))
-    prefix_panels = tuple(
-        (
-            jax.lax.slice_in_dim(k_, 0, Sb, axis=2),
-            jax.lax.slice_in_dim(v_, 0, Sb, axis=2),
+    paged = isinstance(cache, PagedKVCache)
+    if paged:
+        assert table is not None, "paged decode needs the block table"
+        P = cache.page_size
+        S = table.shape[1] * P
+        Sb = S if prefix_bound is None else max(1, min(prefix_bound, S))
+        n_blocks = -(-Sb // P)
+        if use_pallas:
+            prefix_panels = cache.layers     # pools; kernel reads via table
+        else:
+            prefix_panels = tuple(
+                (
+                    gather_pages(k_, table, n_blocks),
+                    gather_pages(v_, table, n_blocks),
+                )
+                for (k_, v_) in cache.layers
+            )
+    else:
+        S = cache.max_len
+        Sb = S if prefix_bound is None else max(1, min(prefix_bound, S))
+        prefix_panels = tuple(
+            (
+                jax.lax.slice_in_dim(k_, 0, Sb, axis=2),
+                jax.lax.slice_in_dim(v_, 0, Sb, axis=2),
+            )
+            for (k_, v_) in cache.layers
         )
-        for (k_, v_) in cache.layers
-    )
     start = cache.lengths
     windows = cfg.window_sizes()
     qscale = cfg.query_scale if cfg.query_scale is not None else cfg.head_dim**-0.5
@@ -590,11 +627,34 @@ def decode_chunk_spec(
             qg = q.transpose(0, 2, 1, 3).reshape(
                 B, cfg.n_kv_heads, G, D, cfg.head_dim
             )
-            attn = _spec_block_attn(
-                qg, layer_k, layer_v, rk, rv, blk_k, blk_v,
-                prefix_last, start, offset, pvec,
-                qscale, cfg.attn_softcap, window,
-            )
+            if paged and use_pallas:
+                # Pallas paged prefix read with D query rows per slot
+                # (q_blocks): the kernel offsets row d's position by d
+                # for the sliding-window mask; causality vs the prefix
+                # is free (every prefix key precedes the block).
+                acc_p, m_p, l_p = paged_decode_attention(
+                    qg.reshape(B, cfg.n_kv_heads * G * D, cfg.head_dim),
+                    layer_k, layer_v, table, prefix_last,
+                    q_positions=pos, n_blocks=n_blocks, q_blocks=D,
+                    scale=qscale, softcap=cfg.attn_softcap, window=window,
+                )
+                pstats = (
+                    acc_p.reshape(B, cfg.n_kv_heads, G, D, cfg.head_dim),
+                    m_p.reshape(B, cfg.n_kv_heads, G, D),
+                    l_p.reshape(B, cfg.n_kv_heads, G, D),
+                )
+                attn = _spec_block_attn(
+                    qg, None, None, rk, rv, blk_k, blk_v,
+                    prefix_last, start, offset, pvec,
+                    qscale, cfg.attn_softcap, window,
+                    prefix_stats=pstats,
+                )
+            else:
+                attn = _spec_block_attn(
+                    qg, layer_k, layer_v, rk, rv, blk_k, blk_v,
+                    prefix_last, start, offset, pvec,
+                    qscale, cfg.attn_softcap, window,
+                )
             out = _attn_out(cfg, p, attn.astype(x.dtype).reshape(
                 B, D, cfg.n_heads, cfg.head_dim
             ))
@@ -723,9 +783,15 @@ def decode_chunk_spec(
     out_toks = out_toks.transpose(0, 2, 1).reshape(n_steps * D, B)
     out_valid = out_valid.transpose(0, 2, 1).reshape(n_steps * D, B)
 
-    cache = write_chunk_rows(
-        cache, [r[0] for r in rings], [r[1] for r in rings], start, offset
-    )
+    if paged:
+        cache = write_chunk_rows_paged(
+            cache, table, [r[0] for r in rings], [r[1] for r in rings],
+            start, offset,
+        )
+    else:
+        cache = write_chunk_rows(
+            cache, [r[0] for r in rings], [r[1] for r in rings], start, offset
+        )
     dstate = DecodeState(tokens=tokens, done=done, budget=budget)
     return out_toks, out_valid, cache, dstate, sampling, history
 
@@ -796,6 +862,81 @@ def _tail_prefix_attn(
     return attn.transpose(0, 3, 1, 2, 4).reshape(A, T, K * G * H)
 
 
+def _tail_prefill_core(
+    params,
+    cfg: ModelConfig,
+    prefix_ks: jax.Array,   # [L, K, P, H] cached prompt-prefix keys
+    prefix_vs: jax.Array,
+    prefix_len: jax.Array,  # scalar int32 — true prefix length (<= P)
+    tail_tokens: jax.Array,  # [A, Tt] right-padded prompt tails
+    tail_lens: jax.Array,    # [A] true tail lengths (0 = padding row)
+    cache_dtype,
+):
+    """Shared tail-prefill forward for both prefix-cached admission
+    paths (dense panel copy and paged page sharing): tail tokens attend
+    the cached prefix plus themselves causally. Returns
+    ``(logits [A, Tt, V], ks [L, A, K, Tt, H], vs)``."""
+    A, Tt = tail_tokens.shape
+    positions = prefix_len + jnp.broadcast_to(
+        jnp.arange(Tt, dtype=jnp.int32)[None], (A, Tt)
+    )
+    x = _embed(cfg, params, tail_tokens)
+    sin, cos = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+    windows = jnp.asarray(cfg.window_sizes())
+    qscale = cfg.query_scale if cfg.query_scale is not None else cfg.head_dim**-0.5
+    G = cfg.n_heads // cfg.n_kv_heads
+
+    def layer_fn(carry, scanned):
+        x = carry
+        lp, window, pk, pv = scanned
+        h = rms_norm(x, lp["ln1"]["scale"], cfg.rms_eps, cfg.rms_offset)
+        q, k, v = _qkv(cfg, lp["attn"], h, sin, cos)
+        qg = q.transpose(0, 2, 1, 3).reshape(
+            A, cfg.n_kv_heads, G, Tt, cfg.head_dim
+        )
+        blk_k = k.transpose(0, 2, 1, 3).astype(cache_dtype)
+        blk_v = v.transpose(0, 2, 1, 3).astype(cache_dtype)
+        # Per-layer window under lax.cond: ``window`` is a traced scan
+        # element, and only one attention variant runs per layer (the
+        # jnp.where form computed BOTH every layer — advisor r3).
+        if cfg.sliding_window > 0:
+            attn = jax.lax.cond(
+                window > 0,
+                lambda: _tail_prefix_attn(
+                    qg, pk, pv, blk_k, blk_v, prefix_len, tail_lens,
+                    qscale, cfg.attn_softcap, int(cfg.sliding_window),
+                ),
+                lambda: _tail_prefix_attn(
+                    qg, pk, pv, blk_k, blk_v, prefix_len, tail_lens,
+                    qscale, cfg.attn_softcap, 0,
+                ),
+            )
+        else:
+            attn = _tail_prefix_attn(
+                qg, pk, pv, blk_k, blk_v, prefix_len, tail_lens,
+                qscale, cfg.attn_softcap, 0,
+            )
+        out = _attn_out(cfg, lp["attn"], attn.astype(x.dtype).reshape(
+            A, Tt, cfg.n_heads, cfg.head_dim
+        ))
+        if cfg.post_norms:
+            out = rms_norm(out, lp["ln1_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
+        x = x + out
+        h = rms_norm(x, lp["ln2"]["scale"], cfg.rms_eps, cfg.rms_offset)
+        out, _ = _mlp(cfg, lp, h)
+        if cfg.post_norms:
+            out = rms_norm(out, lp["ln2_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
+        x = x + out
+        return x, (blk_k, blk_v)
+
+    x, (ks, vs) = jax.lax.scan(
+        layer_fn, x, (params["layers"], windows, prefix_ks, prefix_vs)
+    )
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps, cfg.rms_offset)
+    logits = _unembed(cfg, params, x)                    # [A, Tt, V] fp32
+    return logits, ks, vs
+
+
 @partial(
     jax.jit,
     static_argnames=("cfg",),
@@ -831,55 +972,11 @@ def admit_group_prefix(
     (~33 TFLOP, the dominant share of the agent-step wave measured on
     v5e) collapses to a single position."""
     A, Tt = tail_tokens.shape
-    positions = prefix_len + jnp.broadcast_to(
-        jnp.arange(Tt, dtype=jnp.int32)[None], (A, Tt)
-    )
-    x = _embed(cfg, params, tail_tokens)
-    sin, cos = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
-    windows = jnp.asarray(cfg.window_sizes())
-    qscale = cfg.query_scale if cfg.query_scale is not None else cfg.head_dim**-0.5
-    G = cfg.n_heads // cfg.n_kv_heads
     cache_dtype = cache.layers[0][0].dtype
-
-    def layer_fn(carry, scanned):
-        x = carry
-        lp, window, pk, pv = scanned
-        h = rms_norm(x, lp["ln1"]["scale"], cfg.rms_eps, cfg.rms_offset)
-        q, k, v = _qkv(cfg, lp["attn"], h, sin, cos)
-        qg = q.transpose(0, 2, 1, 3).reshape(
-            A, cfg.n_kv_heads, G, Tt, cfg.head_dim
-        )
-        blk_k = k.transpose(0, 2, 1, 3).astype(cache_dtype)
-        blk_v = v.transpose(0, 2, 1, 3).astype(cache_dtype)
-        # lax.switch-free per-layer window: windows is traced per-scan
-        # element; the dense masks take it as an array.
-        attn = _tail_prefix_attn(
-            qg, pk, pv, blk_k, blk_v, prefix_len, tail_lens,
-            qscale, cfg.attn_softcap, 0,
-        )
-        win_attn = _tail_prefix_attn(
-            qg, pk, pv, blk_k, blk_v, prefix_len, tail_lens,
-            qscale, cfg.attn_softcap, int(cfg.sliding_window),
-        ) if cfg.sliding_window > 0 else attn
-        attn = jnp.where(window > 0, win_attn, attn)
-        out = _attn_out(cfg, lp["attn"], attn.astype(x.dtype).reshape(
-            A, Tt, cfg.n_heads, cfg.head_dim
-        ))
-        if cfg.post_norms:
-            out = rms_norm(out, lp["ln1_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
-        x = x + out
-        h = rms_norm(x, lp["ln2"]["scale"], cfg.rms_eps, cfg.rms_offset)
-        out, _ = _mlp(cfg, lp, h)
-        if cfg.post_norms:
-            out = rms_norm(out, lp["ln2_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
-        x = x + out
-        return x, (blk_k, blk_v)
-
-    x, (ks, vs) = jax.lax.scan(
-        layer_fn, x, (params["layers"], windows, prefix_ks, prefix_vs)
+    logits, ks, vs = _tail_prefill_core(
+        params, cfg, prefix_ks, prefix_vs, prefix_len,
+        tail_tokens, tail_lens, cache_dtype,
     )
-    x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps, cfg.rms_offset)
-    logits = _unembed(cfg, params, x)                    # [A, Tt, V] fp32
 
     # Cache install: prefix panels (shared) + tail (per slot). Padding
     # rows route to row 0's slot and are overwritten by its later write
@@ -923,6 +1020,95 @@ def admit_group_prefix(
     if history is not None:
         history = install_history(
             history, slots, full_tokens, full_lens, first
+        )
+    return cache, dstate, sampling, first, history
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "n_prefix_bucket"),
+    donate_argnames=("cache", "dstate", "sampling", "history"),
+)
+def admit_group_prefix_paged(
+    params,
+    cfg: ModelConfig,
+    cache: PagedKVCache,
+    dstate: "DecodeState",
+    sampling: SamplingState,
+    prefix_pages: jax.Array,  # [n_prefix_bucket] int32 — shared chain pages
+                              # in order, sentinel-padded past the true count
+    prefix_len: jax.Array,    # scalar int32 — true prefix length
+                              # (page-aligned: chain pages are always full)
+    tail_tokens: jax.Array,   # [A, Tt] right-padded prompt tails
+    tail_lens: jax.Array,     # [A] true tail lengths (0 = padding row)
+    full_tokens: jax.Array,   # [A, Tf] full prompts (history install)
+    slots: jax.Array,
+    page_rows: jax.Array,     # [A, max_pages] full block tables (shared
+                              # prefix pages at the head, private after)
+    temps: jax.Array,
+    topks: jax.Array,
+    topps: jax.Array,
+    seeds: jax.Array,
+    eos: jax.Array,
+    jsonm: jax.Array,
+    budgets: jax.Array,
+    n_prefix_bucket: int = 1,
+    json_tables: Optional[Tuple[jax.Array, jax.Array]] = None,
+    history: Optional[jax.Array] = None,
+):
+    """Block-granular prefix-cached admission on the paged pool
+    (``engine/page_prefix.py``). Unlike the dense variant, the prefix is
+    **not copied anywhere**: the shared pages are already mapped into
+    each slot's block table by the host allocator — this dispatch only
+    gathers them read-only for the tail's prefix attention, prefills the
+    tail, and scatters the tail K/V into the slots' private pages (the
+    shared pages are immutable: decode writes start at ``prompt_len``,
+    past every fully-covered block)."""
+    P = cache.page_size
+    K = cache.n_kv_heads
+    H = cache.head_dim
+    Pb = n_prefix_bucket * P
+    # Gather the shared chain into stacked [L, K, Pb, H] panels
+    # (sentinel-padded pages gather scratch garbage — masked by
+    # ``col < prefix_len`` in the tail attention).
+    pks = jnp.stack(
+        [kp[:, prefix_pages].reshape(K, Pb, H) for (kp, _) in cache.layers]
+    )
+    pvs = jnp.stack(
+        [vp[:, prefix_pages].reshape(K, Pb, H) for (_, vp) in cache.layers]
+    )
+    cache_dtype = cache.layers[0][0].dtype
+    logits, ks, vs = _tail_prefill_core(
+        params, cfg, pks, pvs, prefix_len, tail_tokens, tail_lens,
+        cache_dtype,
+    )
+
+    # Tail install: position t of the tail lives at absolute position
+    # prefix_len + t — write through the slot's own table with that
+    # offset (prefix_len is page-aligned, so only private blocks past
+    # the shared chain are ever touched).
+    ks_w = ks.transpose(0, 1, 3, 2, 4)  # [L, A, Tt, K, H]
+    vs_w = vs.transpose(0, 1, 3, 2, 4)
+    cache = write_prompts_paged(
+        cache, page_rows, ks_w, vs_w, tail_lens, pos_offset=prefix_len
+    )
+    live = tail_lens > 0
+    cache = install_lengths(
+        cache, slots, jnp.where(live, prefix_len + tail_lens, 0)
+    )
+
+    sampling = admit_sampling(
+        sampling, slots, temps, topks, topps, seeds, eos, jsonm
+    )
+    first, sampling = sample_prefill_tokens(
+        logits, tail_lens, slots, sampling, remaining=budgets + 1,
+        json_tables=json_tables,
+    )
+    dstate = admit_decode(dstate, slots, first, budgets, live)
+    if history is not None:
+        history = install_history(
+            history, slots, full_tokens,
+            jnp.where(live, prefix_len + tail_lens, 0), first,
         )
     return cache, dstate, sampling, first, history
 

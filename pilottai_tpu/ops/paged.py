@@ -91,7 +91,16 @@ class PagedKVCache(NamedTuple):
 
 class PageAllocator:
     """Host-side free-list + block table (single-threaded: the device
-    thread owns admission and completion bookkeeping)."""
+    thread owns admission and completion bookkeeping).
+
+    Pages are **refcounted** so the block-granular prefix cache
+    (``engine/page_prefix.py``) can map one immutable prompt-prefix page
+    into many slots' tables at once — prefix sharing by indirection, no
+    panel copies. A slot holds one ref on every page in its table
+    (shared prefix pages included); the prefix index pins cached pages
+    with a ref of its own. A page returns to the free list only when its
+    last ref drops.
+    """
 
     def __init__(self, num_pages: int, page_size: int, n_slots: int,
                  max_pages_per_slot: int) -> None:
@@ -100,6 +109,7 @@ class PageAllocator:
         self.page_size = page_size
         self.sentinel = num_pages - 1
         self.free: List[int] = list(range(num_pages - 1))
+        self.refs = np.zeros((num_pages,), np.int32)
         self.table = np.full((n_slots, max_pages_per_slot), self.sentinel,
                              np.int32)
         self._held: List[List[int]] = [[] for _ in range(n_slots)]
@@ -107,28 +117,52 @@ class PageAllocator:
     def pages_needed(self, n_tokens: int) -> int:
         return -(-max(n_tokens, 1) // self.page_size)
 
-    def can_allocate(self, n_tokens: int) -> bool:
-        n = self.pages_needed(n_tokens)
-        return n <= len(self.free) and n <= self.table.shape[1]
+    def can_allocate(self, n_tokens: int, n_prefix_pages: int = 0) -> bool:
+        total = self.pages_needed(n_tokens)
+        n_new = max(total - n_prefix_pages, 0)
+        return n_new <= len(self.free) and total <= self.table.shape[1]
 
-    def allocate(self, slot: int, n_tokens: int) -> bool:
-        """Reserve pages covering n_tokens for a fresh slot. False (and no
-        change) when the pool can't cover it — caller leaves the request
-        pending."""
-        n = self.pages_needed(n_tokens)
-        if n > len(self.free) or n > self.table.shape[1]:
+    def allocate(
+        self, slot: int, n_tokens: int,
+        prefix_pages: Sequence[int] = (),
+    ) -> bool:
+        """Reserve pages covering n_tokens for a fresh slot. Shared
+        ``prefix_pages`` (already holding the prompt prefix's K/V) are
+        mapped into the head of the slot's table with a ref each; fresh
+        pages cover the rest. False (and no change) when the pool can't
+        cover it — caller leaves the request pending."""
+        total = self.pages_needed(n_tokens)
+        n_new = max(total - len(prefix_pages), 0)
+        if n_new > len(self.free) or total > self.table.shape[1]:
             return False
         assert not self._held[slot], f"slot {slot} still holds pages"
-        got = [self.free.pop() for _ in range(n)]
-        self._held[slot] = got
+        got = [self.free.pop() for _ in range(n_new)]
+        held = list(prefix_pages) + got
+        for p in held:
+            self.refs[p] += 1
+        self._held[slot] = held
         self.table[slot, :] = self.sentinel
-        self.table[slot, : n] = got
+        self.table[slot, : len(held)] = held
         return True
 
     def release(self, slot: int) -> None:
-        self.free.extend(self._held[slot])
+        for p in self._held[slot]:
+            self.refs[p] -= 1
+            if self.refs[p] == 0:
+                self.free.append(p)
         self._held[slot] = []
         self.table[slot, :] = self.sentinel
+
+    def pin(self, page: int) -> None:
+        """Add a non-slot ref (prefix index). Caller must hold/know the
+        page is live (refs > 0) — pinning a free page is a logic error."""
+        assert self.refs[page] > 0, f"pin of unreferenced page {page}"
+        self.refs[page] += 1
+
+    def unpin(self, page: int) -> None:
+        self.refs[page] -= 1
+        if self.refs[page] == 0:
+            self.free.append(page)
 
     @property
     def free_pages(self) -> int:
@@ -142,6 +176,9 @@ def write_prompts_paged(
     ks: jax.Array,        # [L, A, T, K, H]
     vs: jax.Array,
     lengths: jax.Array,   # [A] int32; <= 0 marks a padding row
+    pos_offset: Optional[jax.Array] = None,  # scalar int32 — absolute
+                          # position of row 0 (page-ALIGNED; prefix-cached
+                          # tail writes land after the shared pages)
 ) -> PagedKVCache:
     """Scatter freshly prefilled prompts into their slots' pages. T (the
     prefill bucket) need not be page-aligned; positions past ``lengths``
@@ -151,13 +188,16 @@ def write_prompts_paged(
     n_blocks = -(-T // P)
     Tp = n_blocks * P
     pos = jnp.arange(Tp)                                     # [Tp]
-    blk = pos // P
+    live = pos[None, :] < lengths[:, None]                   # [A, Tp]
+    if pos_offset is not None:
+        pos = pos + pos_offset
+    max_pos = table.shape[1] * P - 1
+    blk = jnp.minimum(pos, max_pos) // P
     # Page id per (row, position); sentinel when the position is beyond
     # the row's valid length or its allocation.
     pages = jnp.take_along_axis(
         table, jnp.broadcast_to(blk[None, :], (A, Tp)), axis=1
     )                                                        # [A, Tp]
-    live = pos[None, :] < lengths[:, None]                   # [A, Tp]
     pages = jnp.where(live, pages, cache.num_pages - 1)
     off = jnp.broadcast_to((pos % P)[None, :], (A, Tp))
     pages_f = pages.reshape(-1)                              # [A*Tp]

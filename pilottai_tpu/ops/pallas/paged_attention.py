@@ -52,6 +52,7 @@ def _paged_kernel(
     window: int,
     page_size: int,
     sentinel: int,
+    q_blocks: int,
 ):
     b = pl.program_id(0)
     j = pl.program_id(1)
@@ -68,6 +69,10 @@ def _paged_kernel(
     j0 = j * page_size
     live = (page != sentinel) & (j0 <= last)
     if window > 0:
+        # Most-permissive query decides page liveness: (qpos_row - col) <
+        # window is EASIEST to satisfy at the smallest position, i.e.
+        # row d=0 at qpos — later rows only tighten, and the per-row
+        # mask below applies them exactly.
         live &= (qpos - (j0 + page_size - 1)) < window
 
     @pl.when(live)
@@ -85,7 +90,13 @@ def _paged_kernel(
         col = j0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
         mask = col <= last
         if window > 0:
-            mask &= (qpos - col) < window
+            # Speculative blocks pack D queries per G row (row = g*D + d,
+            # query d at position qpos + d).
+            qpos_row = qpos + (
+                jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) % q_blocks
+                if q_blocks > 1 else 0
+            )
+            mask &= (qpos_row - col) < window
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[0, :, :, :]                            # [K, G, 1]
@@ -110,11 +121,14 @@ def _paged_kernel(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "n_blocks", "scale", "softcap", "window", "interpret"
+        "n_blocks", "scale", "softcap", "window", "q_blocks", "interpret"
     ),
 )
 def paged_decode_attention(
-    q: jax.Array,        # [B, N, H] current-token queries
+    q: jax.Array,        # [B, N, H] current-token queries; with q_blocks=D
+                         # the N axis packs D block queries per head
+                         # (row = head * D + d, query d at position
+                         # q_positions + d) — the speculative-decode shape
     k_pool: jax.Array,   # [K, num_pages, P, H]
     v_pool: jax.Array,
     table: jax.Array,    # [B, max_pages] int32 (sentinel = num_pages - 1)
@@ -124,6 +138,7 @@ def paged_decode_attention(
     scale: Optional[float] = None,
     softcap: float = 0.0,
     window: int = 0,
+    q_blocks: int = 1,   # static — queries per head row (speculation's D)
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Ragged paged GQA decode attention. Returns unnormalized
@@ -133,6 +148,7 @@ def paged_decode_attention(
     K, num_pages, P, _ = k_pool.shape
     assert N % K == 0
     G = N // K
+    assert G % q_blocks == 0
     assert 1 <= n_blocks <= table.shape[1]
     scale = scale if scale is not None else H ** -0.5
     sentinel = num_pages - 1
@@ -147,7 +163,7 @@ def paged_decode_attention(
     kernel = functools.partial(
         _paged_kernel,
         scale=scale, softcap=softcap, window=window,
-        page_size=P, sentinel=sentinel,
+        page_size=P, sentinel=sentinel, q_blocks=q_blocks,
     )
 
     def page_map(b, j, table_ref, last_ref, qpos_ref):

@@ -230,10 +230,15 @@ def test_engine_paged_long_capacity_backpressure():
             for i in range(8)
         ])
         # Page release happens at the device loop's next admission tick;
-        # give it a beat before snapshotting.
+        # give it a beat before snapshotting. The prefix index keeps the
+        # prompts' fully-covered pages pinned by design — every page is
+        # either free or deliberately cached, none leaked to dead slots.
         for _ in range(100):
             m = h.get_metrics()["backend"]
-            if m.get("kv_pages_free") == m.get("kv_pages_total"):
+            if (
+                m.get("kv_pages_free", 0) + m.get("prefix_pages", 0)
+                == m.get("kv_pages_total")
+            ):
                 break
             await asyncio.sleep(0.05)
         await h.stop()
@@ -242,7 +247,10 @@ def test_engine_paged_long_capacity_backpressure():
     outs, metrics = asyncio.run(main())
     assert all(isinstance(o, str) for o in outs) and len(outs) == 8
     assert metrics["kv_pages_total"] == 9
-    assert metrics["kv_pages_free"] == 9  # all released after completion
+    # All slot refs released; only the prefix cache's pins remain (the 8
+    # prompts are identical, so the pins converge on one chain).
+    assert metrics["kv_pages_free"] + metrics["prefix_pages"] == 9
+    assert metrics["prefix_pages"] <= 2
 
 
 def test_oversized_max_new_tokens_does_not_deadlock():
