@@ -51,7 +51,16 @@ class RegistrationRejected(ConnectionError):
 
 
 async def _send(writer: asyncio.StreamWriter, msg: Dict[str, Any]) -> None:
-    writer.write(json.dumps(msg, default=str).encode() + b"\n")
+    data = json.dumps(msg, default=str).encode() + b"\n"
+    if len(data) > _MAX_LINE:
+        # The peer's readline would raise at its limit and tear the
+        # session down; failing the SEND keeps the error with the
+        # oversized message instead of poisoning the connection.
+        raise ValueError(
+            f"control-plane message of {len(data)} bytes exceeds the "
+            f"{_MAX_LINE}-byte frame limit"
+        )
+    writer.write(data)
     await writer.drain()
 
 
@@ -120,18 +129,24 @@ class RemoteAgent:
         return float(self._stats.get("success_rate", 1.0))
 
     def evaluate_task_suitability(self, task: Task) -> float:
-        """Same shape as ``BaseAgent.evaluate_task_suitability``
-        (reference ``pilott/core/agent.py:549-575``), fed by
-        heartbeat-reported stats."""
+        """MIRRORS ``BaseAgent.evaluate_task_suitability`` term for term
+        (minus the tools set, unknowable remotely) so TaskRouter ranks
+        local and remote agents on one scale — a divergent formula
+        systematically biased routing in mixed deployments (advisor r3).
+        Reference shape: ``pilott/core/agent.py:549-575``."""
         if not self.status.is_available:
             return 0.0
         score = 0.7
         if task.type in self.config.specializations:
             score += 0.2
         caps = set(self.config.required_capabilities)
-        if caps and not set(task.required_capabilities) <= caps:
-            score -= 0.3
-        return max(0.0, min(1.0, score - 0.2 * self.load))
+        needed = set(task.required_capabilities)
+        if needed:
+            if not needed.issubset(caps):
+                return 0.1
+            score += 0.1
+        score -= 0.3 * self.load
+        return max(0.0, min(1.0, score))
 
     def heartbeat(self) -> float:
         return self._last_heartbeat
@@ -316,7 +331,11 @@ class ServeEndpoint:
                 else:
                     self._log.warning("unknown message type %r", kind)
         except (ConnectionError, asyncio.IncompleteReadError,
-                json.JSONDecodeError) as exc:
+                ValueError) as exc:
+            # ValueError covers json.JSONDecodeError AND the
+            # LimitOverrunError-wrapping readline raises on an oversized
+            # line — previously uncaught, which killed the handler task
+            # silently (advisor r3).
             if worker_id is not None:
                 self._log.warning(
                     "worker %s connection lost: %s", worker_id[:8], exc
@@ -450,10 +469,11 @@ class AgentWorker:
                 self._stopped.set()
                 break
             except (ConnectionError, OSError,
-                    asyncio.IncompleteReadError, json.JSONDecodeError) as exc:
-                # JSONDecodeError too: one garbage line from a crashing
-                # orchestrator must mean "reconnect", not a silently dead
-                # worker loop.
+                    asyncio.IncompleteReadError, ValueError) as exc:
+                # ValueError: garbage JSON from a crashing orchestrator
+                # AND readline's wrapped LimitOverrunError on an
+                # oversized line must both mean "reconnect", not a
+                # silently dead worker loop (advisor r3).
                 self._log.warning("control-plane session ended: %s", exc)
             if not self.reconnect or self._stopped.is_set():
                 break

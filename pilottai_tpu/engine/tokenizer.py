@@ -99,7 +99,10 @@ class HFTokenizer(Tokenizer):
             if len(aid) == 1:
                 self._anchor = (
                     aid[0],
-                    self._tok.decode([aid[0]], skip_special_tokens=False),
+                    self._tok.decode(
+                        [aid[0]], skip_special_tokens=False,
+                        clean_up_tokenization_spaces=False,
+                    ),
                 )
                 break
 
@@ -108,7 +111,13 @@ class HFTokenizer(Tokenizer):
         return ([self.bos_id] + ids) if add_bos else ids
 
     def decode(self, ids: Sequence[int]) -> str:
-        return self._tok.decode(list(ids), skip_special_tokens=True)
+        # No cleanup: emitted text must equal the concatenation of
+        # token_bytes, or grammar-masked output could be silently edited
+        # after the automaton validated it (e.g. ' ,' → ',').
+        return self._tok.decode(
+            list(ids), skip_special_tokens=True,
+            clean_up_tokenization_spaces=False,
+        )
 
     def token_bytes(self, i: int) -> Optional[bytes]:
         """Derive token i's decoded byte string by anchored difference:
@@ -121,7 +130,14 @@ class HFTokenizer(Tokenizer):
         if i in self._special_ids or self._anchor is None:
             return None
         anchor, anchor_text = self._anchor
-        joined = self._tok.decode([anchor, i], skip_special_tokens=False)
+        # clean_up_tokenization_spaces collapses e.g. ') ,' to '),' —
+        # space+punctuation tokens would lose their leading space and the
+        # JSON automaton's view of the byte stream would silently diverge
+        # from emitted text (advisor r3).
+        joined = self._tok.decode(
+            [anchor, i], skip_special_tokens=False,
+            clean_up_tokenization_spaces=False,
+        )
         if not joined.startswith(anchor_text):
             return None
         piece = joined[len(anchor_text):]
