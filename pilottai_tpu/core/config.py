@@ -126,6 +126,12 @@ class LLMConfig(BaseModel):
     # Entry HBM cost: 2 (K and V) x L x K x bucket(len, cap 1024) x H x
     # itemsize — ~67 MB for llama3-8b bf16 at bucket 512.
     engine_prefix_cache: int = Field(default=4, ge=0)
+    # Persistent XLA compilation cache (utils/compile_cache.py): None =
+    # enabled at the default dir (PILOTTAI_COMPILE_CACHE env or
+    # ~/.cache/pilottai_tpu/xla); "off" disables; else the directory.
+    # Warm restarts (FaultTolerance respawns, worker redeploys) reuse
+    # compiled programs instead of paying minutes of recompilation.
+    engine_compile_cache: Optional[str] = None
     seed: int = 0                                    # param init seed when no checkpoint
 
 

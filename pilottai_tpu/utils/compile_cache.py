@@ -1,0 +1,112 @@
+"""Persistent XLA compilation cache for warm engine restarts.
+
+Every engine boot compiles the same programs: the prefill bucket ladder,
+the fused decode chunk, the admission variants. On the TPU that cost
+~2.4 minutes of dead time per process start (round-3 bench tail:
+"engine up in 141.7s") — paid again on every FaultTolerance respawn and
+every worker redeploy, because nothing persisted the executables.
+
+This module points JAX's persistent compilation cache at a durable
+directory and exposes a hit counter so restart paths can *assert* they
+reused it instead of hoping. Serving engines call
+:func:`enable_compilation_cache` before their first dispatch
+(``engine/native.py``); anything else (bench, trainers, workers) can
+too — the cache is process-global and idempotent.
+
+Resolution order for the directory: explicit argument, then the
+``PILOTTAI_COMPILE_CACHE`` env var, then ``~/.cache/pilottai_tpu/xla``.
+Entries are keyed by program + topology + compiler version, so a stale
+cache is never wrong, only useless.
+
+No reference counterpart (the reference compiles nothing); this is
+TPU-operational surface. VERDICT r3 next-step 4.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from pathlib import Path
+from typing import Optional
+
+from pilottai_tpu.utils.logging import get_logger
+from pilottai_tpu.utils.metrics import global_metrics
+
+_lock = threading.Lock()
+_enabled_dir: Optional[str] = None
+_listener_installed = False
+
+HIT_METRIC = "engine.compile_cache_hits"
+
+
+def default_cache_dir() -> str:
+    return os.environ.get(
+        "PILOTTAI_COMPILE_CACHE",
+        os.path.join(
+            os.path.expanduser("~"), ".cache", "pilottai_tpu", "xla"
+        ),
+    )
+
+
+def _install_hit_listener() -> None:
+    """Count persistent-cache hits into the global metrics registry via
+    jax's monitoring events (the only stable signal the cache exposes)."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    try:
+        import jax._src.monitoring as mon
+
+        def _on_event(name: str, **kwargs) -> None:
+            if "compilation_cache" in name and "hit" in name:
+                global_metrics.inc(HIT_METRIC)
+
+        mon.register_event_listener(_on_event)
+        _listener_installed = True
+    except Exception:  # noqa: BLE001 — metrics are best-effort
+        pass
+
+
+def enable_compilation_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Enable JAX's persistent compilation cache (idempotent; returns the
+    active directory, or None when disabling failed/was requested).
+
+    ``cache_dir`` of ``"off"`` disables nothing retroactively — callers
+    that do not want the cache simply never call this."""
+    global _enabled_dir
+    if cache_dir == "off":
+        return None
+    with _lock:
+        path = str(Path(cache_dir or default_cache_dir()).expanduser())
+        if _enabled_dir == path:
+            _install_hit_listener()
+            return path
+        try:
+            import jax
+
+            Path(path).mkdir(parents=True, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", path)
+            # Cache everything: through a remote tunnel even sub-second
+            # compiles beat a round trip, and entry-size floors would
+            # silently skip the small admission variants.
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        except Exception as exc:  # noqa: BLE001 — cache is an optimization
+            get_logger("utils.compile_cache").warning(
+                "persistent compilation cache unavailable: %s", exc
+            )
+            return None
+        _enabled_dir = path
+        _install_hit_listener()
+        get_logger("utils.compile_cache").info(
+            "persistent compilation cache at %s", path
+        )
+        return path
+
+
+def cache_hits() -> int:
+    return int(global_metrics.get(HIT_METRIC) or 0)
+
+
+__all__ = ["enable_compilation_cache", "cache_hits", "default_cache_dir",
+           "HIT_METRIC"]
