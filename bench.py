@@ -31,8 +31,6 @@ import statistics
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-
 import jax
 
 MAX_NEW_TOKENS = 48    # JSON-ish agent-step reply length
