@@ -126,6 +126,14 @@ class LLMConfig(BaseModel):
     # Entry HBM cost: 2 (K and V) x L x K x bucket(len, cap 1024) x H x
     # itemsize — ~67 MB for llama3-8b bf16 at bucket 512.
     engine_prefix_cache: int = Field(default=4, ge=0)
+    # int8 KV cache ("int8" or None): panels stored int8 with symmetric
+    # per-token-per-head scales (ops/kvcache.py:quantize_kv). Doubles
+    # resident context per HBM GB everywhere; the decode-bandwidth win
+    # (int8-sized cache reads) is realized on the paged-Pallas path,
+    # where dequant happens in-VMEM — XLA paths may materialize
+    # dequantized panels once per chunk. ~1e-3 relative attention error;
+    # composes with paged KV, speculation and prefix caching.
+    engine_kv_quantize: Optional[str] = None
     # Persistent XLA compilation cache (utils/compile_cache.py): None =
     # enabled at the default dir (PILOTTAI_COMPILE_CACHE env or
     # ~/.cache/pilottai_tpu/xla); "off" disables; else the directory.

@@ -128,6 +128,7 @@ class ContinuousBatcher:
         json_tables: Optional[Tuple[Any, Any]] = None,
         speculate: int = 0,
         prefix_cache: int = 4,  # mirrors LLMConfig.engine_prefix_cache
+        kv_quantize: bool = False,  # int8 cache panels + per-token scales
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -143,6 +144,15 @@ class ContinuousBatcher:
         if on_tpu is None:
             on_tpu = jax.default_backend() == "tpu"
         self.on_tpu = on_tpu
+        # int8 KV: doubles resident context per HBM GB (~1e-3 relative
+        # attention error). The decode-bandwidth win lands on the paged
+        # Pallas kernel (in-VMEM dequant, int8-sized HBM streams); XLA
+        # paths dequantize panels at chunk scope, so their win is
+        # capacity, not per-step traffic. The dense Pallas kernel
+        # (opt-in A/B only) predates scales — force the XLA path.
+        self.kv_quantize = bool(kv_quantize)
+        if self.kv_quantize and not paged and use_pallas:
+            use_pallas = False
         if use_pallas is None:
             if paged:
                 # The paged kernel is the point of paging on TPU: its VMEM
@@ -162,6 +172,7 @@ class ContinuousBatcher:
                     os.environ.get("PILOTTAI_DECODE_PALLAS", "").lower()
                     in ("1", "true", "yes")
                     and self.on_tpu
+                    and not self.kv_quantize
                     and decode_shapes_ok(
                         self.max_seq_len, cfg.head_dim,
                         jnp.dtype(cache_dtype).itemsize,
@@ -804,7 +815,17 @@ class ContinuousBatcher:
             seen.add(ids)
             try:
                 pb = self._bucket(len(ids))
-                ks, vs = export_prefix(self.cache.layers, idx, p_bucket=pb)
+                # Quantized caches export in float32: dequant→requantize
+                # is lossless only when nothing rounds in between — a
+                # bf16 store entry would re-quantize to slightly
+                # different int8 on the hit path and break repeat
+                # determinism (review finding). Costs 2x entry HBM.
+                export_dtype = (
+                    jnp.float32 if self.kv_quantize else self.cache_dtype
+                )
+                ks, vs = export_prefix(
+                    self.cache, idx, p_bucket=pb, dtype=export_dtype
+                )
                 store.store(ids, ks, vs, pb)
                 for p in store.lcp_candidates(ids):
                     pb2 = self._bucket(p)
@@ -1028,7 +1049,7 @@ class ContinuousBatcher:
             self.cache = PagedKVCache.create(
                 self.cfg.n_layers, self.n_slots, self.num_pages,
                 self.page_size, self.cfg.n_kv_heads, self.cfg.head_dim,
-                dtype=self.cache_dtype,
+                dtype=self.cache_dtype, quantized=self.kv_quantize,
             )
             self.alloc = PageAllocator(
                 self.num_pages, self.page_size, self.n_slots,
@@ -1043,7 +1064,7 @@ class ContinuousBatcher:
             self.cache = KVCache.create(
                 self.cfg.n_layers, self.n_slots, self.max_seq_len,
                 self.cfg.n_kv_heads, self.cfg.head_dim,
-                dtype=self.cache_dtype,
+                dtype=self.cache_dtype, quantized=self.kv_quantize,
             )
             self.alloc = None
         self.sampling = SamplingState.create(self.n_slots)

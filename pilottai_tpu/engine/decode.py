@@ -39,7 +39,13 @@ from pilottai_tpu.models.transformer import (
     _unembed,
     forward_prefill,
 )
-from pilottai_tpu.ops.kvcache import KVCache, write_chunk_rows, write_prompts
+from pilottai_tpu.ops.kvcache import (
+    KVCache,
+    dequantize_kv,
+    quantize_kv,
+    write_chunk_rows,
+    write_prompts,
+)
 from pilottai_tpu.ops.paged import (
     PagedKVCache,
     gather_pages,
@@ -51,6 +57,15 @@ from pilottai_tpu.ops.pallas.decode_attention import decode_attention
 from pilottai_tpu.ops.pallas.paged_attention import paged_decode_attention
 
 NEG_INF = -2.0**30
+
+
+def _dequant_pair(k, v, scales, dtype):
+    """Return full-precision (k, v) panels: identity for unquantized
+    caches, fused broadcast-dequant for int8 ones (``scales`` is the
+    matching (k_scale, v_scale) pair)."""
+    if scales is None:
+        return k, v
+    return dequantize_kv(k, scales[0], dtype), dequantize_kv(v, scales[1], dtype)
 
 
 class DecodeState(NamedTuple):
@@ -209,6 +224,7 @@ def decode_chunk(
     """
     B = dstate.tokens.shape[0]
     paged = isinstance(cache, PagedKVCache)
+    kv_scales = None  # scale pools for the Pallas paged kernel only
     if paged:
         assert table is not None, "paged decode needs the block table"
         P = cache.page_size
@@ -217,36 +233,52 @@ def decode_chunk(
         n_blocks = -(-Sb // P)
         if use_pallas:
             prefix_panels = cache.layers     # pools; kernel reads via table
+            kv_scales = cache.scales         # int8 pools dequant in-kernel
         else:
             # XLA fallback: materialize bounded dense panels ONCE per
             # chunk (pool contents are frozen during the scan — decode
             # K/V goes to the ring until chunk end), then run the same
             # dense prefix attention as the unpaged path.
             prefix_panels = tuple(
-                (
+                _dequant_pair(
                     gather_pages(k_, table, n_blocks),
                     gather_pages(v_, table, n_blocks),
+                    None if cache.scales is None else (
+                        gather_pages(cache.scales[l][0], table, n_blocks),
+                        gather_pages(cache.scales[l][1], table, n_blocks),
+                    ),
+                    cfg.dtype,
                 )
-                for (k_, v_) in cache.layers
+                for l, (k_, v_) in enumerate(cache.layers)
             )
     else:
         S = cache.max_len
         Sb = S if prefix_bound is None else max(1, min(prefix_bound, S))
         # Bounded read-only views for the prefix attention (writes at chunk
-        # end still land in the full panels).
+        # end still land in the full panels; the int8 dequant multiply
+        # fuses into the attention contraction, so HBM reads stay small).
         prefix_panels = tuple(
-            (
+            _dequant_pair(
                 jax.lax.slice_in_dim(k_, 0, Sb, axis=2),
                 jax.lax.slice_in_dim(v_, 0, Sb, axis=2),
+                None if cache.scales is None else (
+                    jax.lax.slice_in_dim(cache.scales[l][0], 0, Sb, axis=2),
+                    jax.lax.slice_in_dim(cache.scales[l][1], 0, Sb, axis=2),
+                ),
+                cfg.dtype,
             )
-            for (k_, v_) in cache.layers
+            for l, (k_, v_) in enumerate(cache.layers)
         )
     start = cache.lengths                    # [B] frozen during the chunk
     windows = cfg.window_sizes()
     qscale = cfg.query_scale if cfg.query_scale is not None else cfg.head_dim**-0.5
     G = cfg.n_heads // cfg.n_kv_heads
     batch_shape = (B, cfg.n_kv_heads, n_steps, cfg.head_dim)
-    cache_dtype = cache.layers[0][0].dtype
+    # Rings hold fresh in-chunk K/V in compute precision even when the
+    # resident cache is int8 (they are quantized at the chunk-end write).
+    cache_dtype = (
+        cfg.dtype if cache.scales is not None else cache.layers[0][0].dtype
+    )
     rings = tuple(
         (jnp.zeros(batch_shape, cache_dtype), jnp.zeros(batch_shape, cache_dtype))
         for _ in range(cfg.n_layers)
@@ -284,6 +316,8 @@ def decode_chunk(
                     qf, layer_k, layer_v, table, prefix_last,
                     q_positions=pos, n_blocks=n_blocks,
                     scale=qscale, softcap=cfg.attn_softcap, window=window,
+                    k_scales=None if kv_scales is None else kv_scales[l][0],
+                    v_scales=None if kv_scales is None else kv_scales[l][1],
                 )
             elif use_pallas and not paged:
                 acc_p, m_p, l_p = decode_attention(
@@ -562,6 +596,7 @@ def decode_chunk_spec(
     D = draft_len
     assert D >= 2, "draft_len < 2 is plain decode_chunk"
     paged = isinstance(cache, PagedKVCache)
+    kv_scales = None
     if paged:
         assert table is not None, "paged decode needs the block table"
         P = cache.page_size
@@ -570,30 +605,44 @@ def decode_chunk_spec(
         n_blocks = -(-Sb // P)
         if use_pallas:
             prefix_panels = cache.layers     # pools; kernel reads via table
+            kv_scales = cache.scales
         else:
             prefix_panels = tuple(
-                (
+                _dequant_pair(
                     gather_pages(k_, table, n_blocks),
                     gather_pages(v_, table, n_blocks),
+                    None if cache.scales is None else (
+                        gather_pages(cache.scales[l][0], table, n_blocks),
+                        gather_pages(cache.scales[l][1], table, n_blocks),
+                    ),
+                    cfg.dtype,
                 )
-                for (k_, v_) in cache.layers
+                for l, (k_, v_) in enumerate(cache.layers)
             )
     else:
         S = cache.max_len
         Sb = S if prefix_bound is None else max(1, min(prefix_bound, S))
         prefix_panels = tuple(
-            (
+            _dequant_pair(
                 jax.lax.slice_in_dim(k_, 0, Sb, axis=2),
                 jax.lax.slice_in_dim(v_, 0, Sb, axis=2),
+                None if cache.scales is None else (
+                    jax.lax.slice_in_dim(cache.scales[l][0], 0, Sb, axis=2),
+                    jax.lax.slice_in_dim(cache.scales[l][1], 0, Sb, axis=2),
+                ),
+                cfg.dtype,
             )
-            for (k_, v_) in cache.layers
+            for l, (k_, v_) in enumerate(cache.layers)
         )
     start = cache.lengths
     windows = cfg.window_sizes()
     qscale = cfg.query_scale if cfg.query_scale is not None else cfg.head_dim**-0.5
     G = cfg.n_heads // cfg.n_kv_heads
     R = n_steps * D
-    cache_dtype = cache.layers[0][0].dtype
+    # Rings stay in compute precision; the chunk-end write quantizes.
+    cache_dtype = (
+        cfg.dtype if cache.scales is not None else cache.layers[0][0].dtype
+    )
     ring_shape = (B, cfg.n_kv_heads, R, cfg.head_dim)
     rings = tuple(
         (jnp.zeros(ring_shape, cache_dtype), jnp.zeros(ring_shape, cache_dtype))
@@ -637,6 +686,8 @@ def decode_chunk_spec(
                     layer_k, layer_v, table, prefix_last,
                     q_positions=pos, n_blocks=n_blocks, q_blocks=D,
                     scale=qscale, softcap=cfg.attn_softcap, window=window,
+                    k_scales=None if kv_scales is None else kv_scales[l][0],
+                    v_scales=None if kv_scales is None else kv_scales[l][1],
                 )
                 pstats = (
                     acc_p.reshape(B, cfg.n_kv_heads, G, D, cfg.head_dim),
@@ -972,7 +1023,8 @@ def admit_group_prefix(
     (~33 TFLOP, the dominant share of the agent-step wave measured on
     v5e) collapses to a single position."""
     A, Tt = tail_tokens.shape
-    cache_dtype = cache.layers[0][0].dtype
+    quantized = cache.scales is not None
+    cache_dtype = cfg.dtype if quantized else cache.layers[0][0].dtype
     logits, ks, vs = _tail_prefill_core(
         params, cfg, prefix_ks, prefix_vs, prefix_len,
         tail_tokens, tail_lens, cache_dtype,
@@ -980,14 +1032,43 @@ def admit_group_prefix(
 
     # Cache install: prefix panels (shared) + tail (per slot). Padding
     # rows route to row 0's slot and are overwritten by its later write
-    # (write_prompts' reversed-dus trick).
+    # (write_prompts' reversed-dus trick). Quantized caches re-quantize
+    # the store entries on the way in — lossless ONLY because the store
+    # exports in float32 (a bf16 round would shift the recomputed scale
+    # and break hit-path determinism), so quantize from the raw entry,
+    # never from a cache_dtype cast.
     live = tail_lens > 0
     safe_slots = jnp.where(live, slots, slots[0])
     plen_start = jnp.clip(prefix_len, 0, cache.max_len - 1)
     new_layers = []
+    new_scales = [] if quantized else None
     for l, (k_panel, v_panel) in enumerate(cache.layers):
-        pk = prefix_ks[l].astype(cache_dtype)[None]     # [1, K, P, H]
-        pv = prefix_vs[l].astype(cache_dtype)[None]
+        pk = prefix_ks[l][None]                         # [1, K, P, H]
+        pv = prefix_vs[l][None]
+        tk, tv = ks[l], vs[l]                           # [A, K, Tt, H]
+        if quantized:
+            pk, pk_s = quantize_kv(pk)
+            pv, pv_s = quantize_kv(pv)
+            tk, tk_s = quantize_kv(tk)
+            tv, tv_s = quantize_kv(tv)
+            ks_panel, vs_panel = cache.scales[l]
+            for a in reversed(range(A)):
+                sstart = (safe_slots[a], 0, 0)
+                ks_panel = jax.lax.dynamic_update_slice(ks_panel, pk_s, sstart)
+                vs_panel = jax.lax.dynamic_update_slice(vs_panel, pv_s, sstart)
+                tstart = (safe_slots[a], 0, plen_start)
+                ks_panel = jax.lax.dynamic_update_slice(
+                    ks_panel, tk_s[a][None], tstart
+                )
+                vs_panel = jax.lax.dynamic_update_slice(
+                    vs_panel, tv_s[a][None], tstart
+                )
+            new_scales.append((ks_panel, vs_panel))
+        else:
+            pk = pk.astype(cache_dtype)
+            pv = pv.astype(cache_dtype)
+            tk = tk.astype(cache_dtype)
+            tv = tv.astype(cache_dtype)
         for a in reversed(range(A)):
             start = (safe_slots[a], 0, 0, 0)
             k_panel = jax.lax.dynamic_update_slice(k_panel, pk, start)
@@ -995,10 +1076,10 @@ def admit_group_prefix(
             # Scan outputs are already K-major: ks[l][a] is [K, Tt, H].
             tstart = (safe_slots[a], 0, plen_start, 0)
             k_panel = jax.lax.dynamic_update_slice(
-                k_panel, ks[l][a][None], tstart
+                k_panel, tk[a][None], tstart
             )
             v_panel = jax.lax.dynamic_update_slice(
-                v_panel, vs[l][a][None], tstart
+                v_panel, tv[a][None], tstart
             )
         new_layers.append((k_panel, v_panel))
     new_lengths = cache.lengths
@@ -1007,7 +1088,10 @@ def admit_group_prefix(
         new_lengths = jax.lax.dynamic_update_slice(
             new_lengths, full_lens[a][None], (safe_slots[a],)
         )
-    cache = cache._replace(layers=tuple(new_layers), lengths=new_lengths)
+    cache = cache._replace(
+        layers=tuple(new_layers), lengths=new_lengths,
+        scales=tuple(new_scales) if new_scales is not None else None,
+    )
 
     sampling = admit_sampling(
         sampling, slots, temps, topks, topps, seeds, eos, jsonm
@@ -1070,14 +1154,25 @@ def admit_group_prefix_paged(
     Pb = n_prefix_bucket * P
     # Gather the shared chain into stacked [L, K, Pb, H] panels
     # (sentinel-padded pages gather scratch garbage — masked by
-    # ``col < prefix_len`` in the tail attention).
-    pks = jnp.stack(
-        [kp[:, prefix_pages].reshape(K, Pb, H) for (kp, _) in cache.layers]
+    # ``col < prefix_len`` in the tail attention). int8 pools dequantize
+    # on the way out; the pages themselves stay quantized and untouched.
+    def _layer_panels(l, kp, vp):
+        pk = kp[:, prefix_pages].reshape(K, Pb, H)
+        pv = vp[:, prefix_pages].reshape(K, Pb, H)
+        sc = None if cache.scales is None else (
+            cache.scales[l][0][:, prefix_pages].reshape(K, Pb),
+            cache.scales[l][1][:, prefix_pages].reshape(K, Pb),
+        )
+        return _dequant_pair(pk, pv, sc, cfg.dtype)
+
+    panels = [
+        _layer_panels(l, kp, vp) for l, (kp, vp) in enumerate(cache.layers)
+    ]
+    pks = jnp.stack([p[0] for p in panels])
+    pvs = jnp.stack([p[1] for p in panels])
+    cache_dtype = (
+        cfg.dtype if cache.scales is not None else cache.layers[0][0].dtype
     )
-    pvs = jnp.stack(
-        [vp[:, prefix_pages].reshape(K, Pb, H) for (_, vp) in cache.layers]
-    )
-    cache_dtype = cache.layers[0][0].dtype
     logits, ks, vs = _tail_prefill_core(
         params, cfg, pks, pvs, prefix_len, tail_tokens, tail_lens,
         cache_dtype,
@@ -1113,21 +1208,36 @@ def admit_group_prefix_paged(
     return cache, dstate, sampling, first, history
 
 
-@partial(jax.jit, static_argnames=("p_bucket",))
-def export_prefix(layers, slot, p_bucket: int):
+@partial(jax.jit, static_argnames=("p_bucket", "dtype"))
+def export_prefix(cache: KVCache, slot, p_bucket: int, dtype=None):
     """Read one slot's first ``p_bucket`` cache rows out as stacked
     [L, K, p_bucket, H] arrays (the prefix-store entry payload). Runs
     right after the admission dispatch, before any decode chunk touches
-    the slot, so the rows hold exactly the prompt's K/V."""
+    the slot, so the rows hold exactly the prompt's K/V. int8 caches
+    export DEQUANTIZED panels: admit_group_prefix re-quantizes on
+    install, which round-trips losslessly (same scales recomputed)."""
     def grab(panel):
         K, _, H = panel.shape[1:]
         return jax.lax.dynamic_slice(
             panel, (slot, 0, 0, 0), (1, K, p_bucket, H)
         )[0]
 
-    ks = jnp.stack([grab(k) for k, _ in layers])
-    vs = jnp.stack([grab(v) for _, v in layers])
-    return ks, vs
+    def grab_scale(panel):
+        K = panel.shape[1]
+        return jax.lax.dynamic_slice(
+            panel, (slot, 0, 0), (1, K, p_bucket)
+        )[0]
+
+    dt = dtype if dtype is not None else jnp.float32
+    ks_l, vs_l = [], []
+    for l, (k, v) in enumerate(cache.layers):
+        gk, gv = grab(k), grab(v)
+        if cache.scales is not None:
+            gk = dequantize_kv(gk, grab_scale(cache.scales[l][0]), dt)
+            gv = dequantize_kv(gv, grab_scale(cache.scales[l][1]), dt)
+        ks_l.append(gk)
+        vs_l.append(gv)
+    return jnp.stack(ks_l), jnp.stack(vs_l)
 
 
 def install_history(

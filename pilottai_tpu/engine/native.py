@@ -180,6 +180,11 @@ class NativeEngine(LLMBackend):
                     "back to unconstrained sampling", exc,
                 )
                 self._json_tables = None
+        if self.config.engine_kv_quantize not in (None, "int8"):
+            raise ValueError(
+                f"unknown engine_kv_quantize mode "
+                f"{self.config.engine_kv_quantize!r}; supported: 'int8'"
+            )
         max_seq = self.config.engine_max_seq or min(self.model_cfg.max_seq_len, 2048)
         # Placement flows from the params' NamedShardings; jit propagates
         # them through the cache and activations, no mesh context needed.
@@ -202,6 +207,7 @@ class NativeEngine(LLMBackend):
             json_tables=self._json_tables,
             speculate=self.config.engine_speculate,
             prefix_cache=self.config.engine_prefix_cache,
+            kv_quantize=self.config.engine_kv_quantize == "int8",
         )
         self.batcher.start()
         self.batcher.warmup()

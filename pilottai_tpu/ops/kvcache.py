@@ -32,6 +32,27 @@ import jax
 import jax.numpy as jnp
 
 
+def quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-token-per-head int8: ``x[..., H] -> (q int8[..., H],
+    scale f32[...])``. Round-trips losslessly through dequantize →
+    requantize (the recomputed scale is bit-identical), which is what
+    lets the prefix store hand full-precision panels around while the
+    resident cache stays int8."""
+    s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
+    s = jnp.maximum(s, 1e-8)
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / s[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, s
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """Inverse of :func:`quantize_kv`; XLA fuses the broadcast multiply
+    into the consuming attention contraction, so the HBM read stays
+    int8-sized."""
+    return (q.astype(jnp.float32) * scale[..., None].astype(jnp.float32)).astype(dtype)
+
+
 class KVCache(NamedTuple):
     layers: Tuple[Tuple[jax.Array, jax.Array], ...]  # per-layer (k, v) [B, K, S, H]
     lengths: jax.Array                               # [B] int32 — valid entries
@@ -70,13 +91,27 @@ class KVCache(NamedTuple):
         n_kv_heads: int,
         head_dim: int,
         dtype=jnp.bfloat16,
+        quantized: bool = False,
     ) -> "KVCache":
         shape = (n_slots, n_kv_heads, max_len, head_dim)
+        store_dtype = jnp.int8 if quantized else dtype
         layers = tuple(
-            (jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype))
+            (jnp.zeros(shape, dtype=store_dtype),
+             jnp.zeros(shape, dtype=store_dtype))
             for _ in range(n_layers)
         )
-        return cls(layers=layers, lengths=jnp.zeros((n_slots,), dtype=jnp.int32))
+        scales = (
+            tuple(
+                (jnp.zeros(shape[:-1], jnp.float32),
+                 jnp.zeros(shape[:-1], jnp.float32))
+                for _ in range(n_layers)
+            )
+            if quantized else None
+        )
+        return cls(
+            layers=layers, lengths=jnp.zeros((n_slots,), dtype=jnp.int32),
+            scales=scales,
+        )
 
 
 def write_prompts(
@@ -102,10 +137,23 @@ def write_prompts(
     # and its later write overwrites the padding garbage.
     safe_slots = jnp.where(lengths > 0, slots, slots[0])
     new_layers = []
+    new_scales = [] if cache.scales is not None else None
     for layer_idx, (k, v) in enumerate(cache.layers):
         # [A, T, K, H] -> [A, K, T, H] to match the K-major panels.
         k_new = jnp.swapaxes(ks[layer_idx], 1, 2)
         v_new = jnp.swapaxes(vs[layer_idx], 1, 2)
+        if cache.scales is not None:
+            k_new, ksc = quantize_kv(k_new)
+            v_new, vsc = quantize_kv(v_new)
+            ks_p, vs_p = cache.scales[layer_idx]
+            for a in reversed(range(A)):
+                sstart = (safe_slots[a], 0, 0)
+                ks_p = jax.lax.dynamic_update_slice(ks_p, ksc[a][None], sstart)
+                vs_p = jax.lax.dynamic_update_slice(vs_p, vsc[a][None], sstart)
+            new_scales.append((ks_p, vs_p))
+        else:
+            k_new = k_new.astype(k.dtype)
+            v_new = v_new.astype(v.dtype)
         for a in reversed(range(A)):
             start = (safe_slots[a], 0, 0, 0)
             k = jax.lax.dynamic_update_slice(k, k_new[a][None], start)
@@ -116,7 +164,10 @@ def write_prompts(
         new_lengths = jax.lax.dynamic_update_slice(
             new_lengths, jnp.maximum(lengths[a], 0)[None], (safe_slots[a],)
         )
-    return cache._replace(layers=tuple(new_layers), lengths=new_lengths)
+    return cache._replace(
+        layers=tuple(new_layers), lengths=new_lengths,
+        scales=tuple(new_scales) if new_scales is not None else None,
+    )
 
 
 def write_chunk_rows(
@@ -138,14 +189,33 @@ def write_chunk_rows(
     pos = jnp.where(j < accepted[:, None], start[:, None] + j, S)  # [B, n]
     bidx = jnp.arange(B)[:, None]
     new_layers = []
-    for (k, v), rk, rv in zip(cache.layers, ring_ks, ring_vs):
+    new_scales = [] if cache.scales is not None else None
+    for li, ((k, v), rk, rv) in enumerate(zip(cache.layers, ring_ks, ring_vs)):
+        if cache.scales is not None:
+            rk, ksc = quantize_kv(rk)                        # [B, K, n]
+            rv, vsc = quantize_kv(rv)
+            ks_p, vs_p = cache.scales[li]
+            ks_p = ks_p.at[bidx, :, pos].set(
+                ksc.transpose(0, 2, 1), mode="drop"
+            )
+            vs_p = vs_p.at[bidx, :, pos].set(
+                vsc.transpose(0, 2, 1), mode="drop"
+            )
+            new_scales.append((ks_p, vs_p))
         # Advanced indices (bidx, pos) broadcast to [B, n]; the kv-head
         # slice rides along -> update values [B, n, K, H].
-        k = k.at[bidx, :, pos].set(rk.transpose(0, 2, 1, 3), mode="drop")
-        v = v.at[bidx, :, pos].set(rv.transpose(0, 2, 1, 3), mode="drop")
+        k = k.at[bidx, :, pos].set(
+            rk.transpose(0, 2, 1, 3).astype(k.dtype), mode="drop"
+        )
+        v = v.at[bidx, :, pos].set(
+            rv.transpose(0, 2, 1, 3).astype(v.dtype), mode="drop"
+        )
         new_layers.append((k, v))
     new_lengths = jnp.minimum(cache.lengths + accepted, S)
-    return cache._replace(layers=tuple(new_layers), lengths=new_lengths)
+    return cache._replace(
+        layers=tuple(new_layers), lengths=new_lengths,
+        scales=tuple(new_scales) if new_scales is not None else None,
+    )
 
 
 def free_slots(cache: KVCache, slots: jax.Array) -> KVCache:

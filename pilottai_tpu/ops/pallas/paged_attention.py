@@ -43,17 +43,20 @@ def _paged_kernel(
     q_ref,      # VMEM (1, K, G, H)
     k_ref,      # VMEM (K, 1, P, H) — one page, all kv heads
     v_ref,      # VMEM (K, 1, P, H)
-    acc_ref,    # VMEM (1, K, G, H) fp32 — revisited across the page dim
-    m_ref,      # VMEM (1, K, G, 1) fp32
-    l_ref,      # VMEM (1, K, G, 1) fp32
-    *,
+    *rest,      # [ks_ref (K,1,P,1), vs_ref (K,1,P,1) when quantized,]
+                # acc_ref (1,K,G,H) f32, m_ref (1,K,G,1), l_ref (1,K,G,1)
     scale: float,
     softcap: float,
     window: int,
     page_size: int,
     sentinel: int,
     q_blocks: int,
+    quantized: bool,
 ):
+    if quantized:
+        ks_ref, vs_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        acc_ref, m_ref, l_ref = rest
     b = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -80,6 +83,13 @@ def _paged_kernel(
         q = q_ref[0]                                          # [K, G, H]
         k = k_ref[:, 0]                                       # [K, P, H]
         v = v_ref[:, 0]
+        if quantized:
+            # In-VMEM dequant: the HBM→VMEM stream stays int8-sized.
+            # Scale blocks ride as (K, 1, P, 1) — the trailing singleton
+            # satisfies the TPU lowering's last-two-dims constraint.
+            k = k.astype(jnp.float32) * ks_ref[:, 0]
+            v = v.astype(jnp.float32) * vs_ref[:, 0]
+            q = q.astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k,
             dimension_numbers=(((2,), (2,)), ((0,), (0,))),
@@ -139,6 +149,8 @@ def paged_decode_attention(
     softcap: float = 0.0,
     window: int = 0,
     q_blocks: int = 1,   # static — queries per head row (speculation's D)
+    k_scales: Optional[jax.Array] = None,  # [K, num_pages, P] — int8 pools
+    v_scales: Optional[jax.Array] = None,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Ragged paged GQA decode attention. Returns unnormalized
@@ -160,10 +172,13 @@ def paged_decode_attention(
     q_positions = jnp.asarray(q_positions, jnp.int32).reshape(B)
     table = jnp.asarray(table, jnp.int32)
 
+    quantized = k_scales is not None
+    assert (k_scales is None) == (v_scales is None)
     kernel = functools.partial(
         _paged_kernel,
         scale=scale, softcap=softcap, window=window,
         page_size=P, sentinel=sentinel, q_blocks=q_blocks,
+        quantized=quantized,
     )
 
     def page_map(b, j, table_ref, last_ref, qpos_ref):
@@ -171,14 +186,28 @@ def paged_decode_attention(
         # memory; the kernel's `live` predicate skips the compute.
         return (0, jnp.minimum(table_ref[b, j], sentinel), 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, K, G, H), lambda b, j, *_: (b, 0, 0, 0)),
+        pl.BlockSpec((K, 1, P, H), page_map),
+        pl.BlockSpec((K, 1, P, H), page_map),
+    ]
+    operands = [qg, k_pool, v_pool]
+    if quantized:
+        # Trailing singleton: TPU lowering requires the last two block
+        # dims be (8k, 128k) or equal the array dims — (P, 1) qualifies.
+        in_specs += [
+            pl.BlockSpec((K, 1, P, 1), page_map),
+            pl.BlockSpec((K, 1, P, 1), page_map),
+        ]
+        operands += [
+            k_scales.astype(jnp.float32)[..., None],
+            v_scales.astype(jnp.float32)[..., None],
+        ]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,  # table, last, qpos in SMEM
         grid=(B, n_blocks),
-        in_specs=[
-            pl.BlockSpec((1, K, G, H), lambda b, j, *_: (b, 0, 0, 0)),
-            pl.BlockSpec((K, 1, P, H), page_map),
-            pl.BlockSpec((K, 1, P, H), page_map),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, K, G, H), lambda b, j, *_: (b, 0, 0, 0)),
             pl.BlockSpec((1, K, G, 1), lambda b, j, *_: (b, 0, 0, 0)),
@@ -194,7 +223,7 @@ def paged_decode_attention(
             jax.ShapeDtypeStruct((B, K, G, 1), jnp.float32),
         ),
         interpret=interpret,
-    )(table, last_valid, q_positions, qg, k_pool, v_pool)
+    )(table, last_valid, q_positions, *operands)
     return acc.reshape(B, N, H), m.reshape(B, N), l.reshape(B, N)
 
 

@@ -117,6 +117,81 @@ def test_paged_kernel_matches_gather(window, softcap):
     assert float(np.asarray(l)[~live].max(initial=0.0)) == 0.0
 
 
+def test_paged_kernel_q_blocks_matches_per_row_calls(
+):
+    """The speculative q_blocks path: D packed queries per head row must
+    equal D separate single-query kernel calls at shifted positions
+    (window exercises the per-row position offsets)."""
+    from pilottai_tpu.engine.decode import _prefix_stats_dense
+
+    rng = np.random.default_rng(2)
+    B, K, P, H, D = 4, 2, 16, 64, 3
+    k_pool, v_pool, table, k_dense, v_dense, lengths = _mk_paged(rng)
+    G = 2
+    q = jnp.asarray(rng.normal(size=(B, K, G, D, H)), jnp.float32)
+    last = lengths - 1
+    qpos = lengths
+    scale = H ** -0.5
+
+    acc, m, l = paged_decode_attention(
+        q.reshape(B, K * G * D, H), k_pool, v_pool, table, last,
+        q_positions=qpos, n_blocks=4, scale=scale, window=24,
+        q_blocks=D, interpret=True,
+    )
+    acc = np.asarray(acc).reshape(B, K, G, D, H)
+    m = np.asarray(m).reshape(B, K, G, D)
+    live = np.asarray(lengths) > 0
+    for d in range(D):
+        acc_r, m_r, _ = _prefix_stats_dense(
+            q[:, :, :, d],
+            gather_pages(k_pool, table, 4), gather_pages(v_pool, table, 4),
+            last, qpos + d, scale, 0.0, 24,
+        )
+        acc_r = np.asarray(acc_r).reshape(B, K, G, H)
+        np.testing.assert_allclose(
+            acc[live][:, :, :, d], acc_r[live], rtol=2e-4, atol=2e-4
+        )
+        np.testing.assert_allclose(
+            m[live][:, :, :, d], np.asarray(m_r).reshape(B, K, G)[live],
+            rtol=1e-5,
+        )
+
+
+def test_paged_kernel_int8_scales_match_dequant_oracle():
+    """Quantized pools + in-kernel dequant must agree with the dense
+    oracle run over explicitly dequantized panels."""
+    from pilottai_tpu.engine.decode import _prefix_stats_dense
+    from pilottai_tpu.ops.kvcache import dequantize_kv, quantize_kv
+
+    rng = np.random.default_rng(3)
+    B, K, P, H, N = 4, 2, 16, 64, 4
+    k_pool, v_pool, table, *_ , lengths = _mk_paged(rng)
+    kq, ksc = quantize_kv(k_pool)
+    vq, vsc = quantize_kv(v_pool)
+    q = jnp.asarray(rng.normal(size=(B, N, H)), jnp.float32)
+    last = lengths - 1
+    scale = H ** -0.5
+
+    acc, m, l = paged_decode_attention(
+        q, kq, vq, table, last, q_positions=lengths,
+        n_blocks=4, scale=scale, k_scales=ksc, v_scales=vsc,
+        interpret=True,
+    )
+    acc_r, m_r, l_r = _prefix_stats_dense(
+        q.reshape(B, K, N // K, H),
+        gather_pages(dequantize_kv(kq, ksc, jnp.float32), table, 4),
+        gather_pages(dequantize_kv(vq, vsc, jnp.float32), table, 4),
+        last, lengths, scale, 0.0, 0,
+    )
+    live = np.asarray(lengths) > 0
+    np.testing.assert_allclose(
+        np.asarray(acc)[live], np.asarray(acc_r)[live], rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(l)[live], np.asarray(l_r)[live], rtol=1e-4
+    )
+
+
 def test_gather_pages_reconstructs_dense():
     rng = np.random.default_rng(1)
     k_pool, _, table, k_dense, _, lengths = _mk_paged(rng)
