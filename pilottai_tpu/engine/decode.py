@@ -68,6 +68,20 @@ def _dequant_pair(k, v, scales, dtype):
     return dequantize_kv(k, scales[0], dtype), dequantize_kv(v, scales[1], dtype)
 
 
+def _bounded_panels(cache, l: int, op, dtype):
+    """Layer ``l``'s prefix K/V in compute precision: ``op`` bounds the
+    read (a dense ``slice_in_dim`` or a paged ``gather_pages`` — both
+    accept the [.., P, H] panels AND the [.., P] scale pools), and int8
+    caches dequantize through the matching scales. The ONE place the
+    panel/scale pairing lives — decode_chunk, decode_chunk_spec and the
+    paged prefix admission all read through it."""
+    k_, v_ = cache.layers[l]
+    sc = None if cache.scales is None else (
+        op(cache.scales[l][0]), op(cache.scales[l][1])
+    )
+    return _dequant_pair(op(k_), op(v_), sc, dtype)
+
+
 class DecodeState(NamedTuple):
     """Per-slot generation state living on device across chunks."""
 
@@ -240,16 +254,11 @@ def decode_chunk(
             # K/V goes to the ring until chunk end), then run the same
             # dense prefix attention as the unpaged path.
             prefix_panels = tuple(
-                _dequant_pair(
-                    gather_pages(k_, table, n_blocks),
-                    gather_pages(v_, table, n_blocks),
-                    None if cache.scales is None else (
-                        gather_pages(cache.scales[l][0], table, n_blocks),
-                        gather_pages(cache.scales[l][1], table, n_blocks),
-                    ),
+                _bounded_panels(
+                    cache, l, lambda a: gather_pages(a, table, n_blocks),
                     cfg.dtype,
                 )
-                for l, (k_, v_) in enumerate(cache.layers)
+                for l in range(cfg.n_layers)
             )
     else:
         S = cache.max_len
@@ -258,16 +267,11 @@ def decode_chunk(
         # end still land in the full panels; the int8 dequant multiply
         # fuses into the attention contraction, so HBM reads stay small).
         prefix_panels = tuple(
-            _dequant_pair(
-                jax.lax.slice_in_dim(k_, 0, Sb, axis=2),
-                jax.lax.slice_in_dim(v_, 0, Sb, axis=2),
-                None if cache.scales is None else (
-                    jax.lax.slice_in_dim(cache.scales[l][0], 0, Sb, axis=2),
-                    jax.lax.slice_in_dim(cache.scales[l][1], 0, Sb, axis=2),
-                ),
+            _bounded_panels(
+                cache, l, lambda a: jax.lax.slice_in_dim(a, 0, Sb, axis=2),
                 cfg.dtype,
             )
-            for l, (k_, v_) in enumerate(cache.layers)
+            for l in range(cfg.n_layers)
         )
     start = cache.lengths                    # [B] frozen during the chunk
     windows = cfg.window_sizes()
@@ -285,8 +289,8 @@ def decode_chunk(
     )
     prefix_last = start - 1                  # max valid prefix key index
 
-    def step(carry, i):
-        tokens, done, budget, offset, sampling, rings = carry
+    def step(carry):
+        i, tokens, done, budget, offset, sampling, rings, out_t, out_v = carry
         active = ~done
         pos = start + offset                 # current token's position
         x = _embed(cfg, params, tokens[:, None])          # [B, 1, E]
@@ -365,18 +369,28 @@ def decode_chunk(
         new_done = done | (active & (hit_eos | (new_budget <= 0) | ctx_full))
         new_tokens = jnp.where(active, sampled, tokens)
         new_offset = offset + active.astype(jnp.int32)
-        carry = (
-            new_tokens, new_done, new_budget, new_offset, sampling,
-            tuple(new_rings),
+        out_t = jax.lax.dynamic_update_slice(out_t, sampled[None], (i, 0))
+        out_v = jax.lax.dynamic_update_slice(out_v, active[None], (i, 0))
+        return (
+            i + 1, new_tokens, new_done, new_budget, new_offset, sampling,
+            tuple(new_rings), out_t, out_v,
         )
-        return carry, (sampled, active)
 
     offset0 = jnp.zeros((B,), jnp.int32)
     carry0 = (
-        dstate.tokens, dstate.done, dstate.budget, offset0, sampling, rings
+        jnp.int32(0), dstate.tokens, dstate.done, dstate.budget, offset0,
+        sampling, rings,
+        jnp.zeros((n_steps, B), jnp.int32), jnp.zeros((n_steps, B), bool),
     )
-    (tokens, done, budget, offset, sampling, rings), (out_toks, out_valid) = (
-        jax.lax.scan(step, carry0, jnp.arange(n_steps))
+    # while_loop with all-done early exit (see decode_chunk_spec): each
+    # step streams the full weight set, so steps past the last active
+    # slot are pure waste — the dispatch now pays only for steps used.
+    (
+        _, tokens, done, budget, offset, sampling, rings, out_toks, out_valid,
+    ) = jax.lax.while_loop(
+        lambda c: (c[0] < n_steps) & ~jnp.all(c[2]),
+        step,
+        carry0,
     )
 
     if paged:
@@ -608,31 +622,21 @@ def decode_chunk_spec(
             kv_scales = cache.scales
         else:
             prefix_panels = tuple(
-                _dequant_pair(
-                    gather_pages(k_, table, n_blocks),
-                    gather_pages(v_, table, n_blocks),
-                    None if cache.scales is None else (
-                        gather_pages(cache.scales[l][0], table, n_blocks),
-                        gather_pages(cache.scales[l][1], table, n_blocks),
-                    ),
+                _bounded_panels(
+                    cache, l, lambda a: gather_pages(a, table, n_blocks),
                     cfg.dtype,
                 )
-                for l, (k_, v_) in enumerate(cache.layers)
+                for l in range(cfg.n_layers)
             )
     else:
         S = cache.max_len
         Sb = S if prefix_bound is None else max(1, min(prefix_bound, S))
         prefix_panels = tuple(
-            _dequant_pair(
-                jax.lax.slice_in_dim(k_, 0, Sb, axis=2),
-                jax.lax.slice_in_dim(v_, 0, Sb, axis=2),
-                None if cache.scales is None else (
-                    jax.lax.slice_in_dim(cache.scales[l][0], 0, Sb, axis=2),
-                    jax.lax.slice_in_dim(cache.scales[l][1], 0, Sb, axis=2),
-                ),
+            _bounded_panels(
+                cache, l, lambda a: jax.lax.slice_in_dim(a, 0, Sb, axis=2),
                 cfg.dtype,
             )
-            for l, (k_, v_) in enumerate(cache.layers)
+            for l in range(cfg.n_layers)
         )
     start = cache.lengths
     windows = cfg.window_sizes()
@@ -651,8 +655,11 @@ def decode_chunk_spec(
     prefix_last = start - 1
     bidx = jnp.arange(B)
 
-    def step(carry, _):
-        tokens, done, budget, offset, sampling, history, rings = carry
+    def step(carry):
+        (
+            i, tokens, done, budget, offset, sampling, history, rings,
+            out_toks, out_valid,
+        ) = carry
         active = ~done
         pos = start + offset
         drafts = _ngram_drafts(history, pos, tokens, D - 1)
@@ -813,21 +820,39 @@ def decode_chunk_spec(
             )
             out_rings.append((rk, rv))
 
-        carry = (
-            new_tokens, new_done, new_budget, new_offset, sampling, history,
-            tuple(out_rings),
+        out_toks = jax.lax.dynamic_update_slice(
+            out_toks, emitted[None], (i, 0, 0)
         )
-        return carry, (emitted, emit_mask)
+        out_valid = jax.lax.dynamic_update_slice(
+            out_valid, emit_mask[None], (i, 0, 0)
+        )
+        return (
+            i + 1, new_tokens, new_done, new_budget, new_offset, sampling,
+            history, tuple(out_rings), out_toks, out_valid,
+        )
 
     offset0 = jnp.zeros((B,), jnp.int32)
     carry0 = (
-        dstate.tokens, dstate.done, dstate.budget, offset0, sampling,
-        history, rings,
+        jnp.int32(0), dstate.tokens, dstate.done, dstate.budget, offset0,
+        sampling, history, rings,
+        jnp.zeros((n_steps, B, D), jnp.int32),
+        jnp.zeros((n_steps, B, D), bool),
     )
+    # while_loop, not scan: a verify-block costs one full weight pass
+    # (the whole point of speculation is that decode is weight-stream
+    # bound), so when every slot is done/budget-exhausted mid-chunk the
+    # remaining blocks are pure waste — measured on v5e as the dominant
+    # overhead above the bandwidth floor at wave tails. Early exit makes
+    # a generous chunk_size free: the dispatch pays for the blocks the
+    # slowest slot actually needed.
     (
-        (tokens, done, budget, offset, sampling, history, rings),
-        (out_toks, out_valid),
-    ) = jax.lax.scan(step, carry0, jnp.arange(n_steps))
+        _, tokens, done, budget, offset, sampling, history, rings,
+        out_toks, out_valid,
+    ) = jax.lax.while_loop(
+        lambda c: (c[0] < n_steps) & ~jnp.all(c[2]),
+        step,
+        carry0,
+    )
 
     # [n, B, D] -> [n*D, B] block-major so the host fold sees the plain
     # chunk's [rows, B] contract.
@@ -1156,17 +1181,13 @@ def admit_group_prefix_paged(
     # (sentinel-padded pages gather scratch garbage — masked by
     # ``col < prefix_len`` in the tail attention). int8 pools dequantize
     # on the way out; the pages themselves stay quantized and untouched.
-    def _layer_panels(l, kp, vp):
-        pk = kp[:, prefix_pages].reshape(K, Pb, H)
-        pv = vp[:, prefix_pages].reshape(K, Pb, H)
-        sc = None if cache.scales is None else (
-            cache.scales[l][0][:, prefix_pages].reshape(K, Pb),
-            cache.scales[l][1][:, prefix_pages].reshape(K, Pb),
-        )
-        return _dequant_pair(pk, pv, sc, cfg.dtype)
+    def _chain_gather(a):
+        # Works for [K, pages, P, H] pools and [K, pages, P] scale pools.
+        return a[:, prefix_pages].reshape((K, Pb) + a.shape[3:])
 
     panels = [
-        _layer_panels(l, kp, vp) for l, (kp, vp) in enumerate(cache.layers)
+        _bounded_panels(cache, l, _chain_gather, cfg.dtype)
+        for l in range(cfg.n_layers)
     ]
     pks = jnp.stack([p[0] for p in panels])
     pvs = jnp.stack([p[1] for p in panels])
