@@ -17,9 +17,15 @@ with an unsuccessful :class:`TaskResult`, which flows into Serve's normal
 retry path and re-routes to a healthy agent; Serve's journal covers
 orchestrator death (``checkpoint/journal.py``).
 
-Trust model: the listener is meant for a private interconnect (TPU-pod
-DCN / VPC). An optional shared ``token`` rejects accidental cross-talk;
-it is not cryptographic authentication.
+Trust model (docs/SERVING.md "Security"): the listener is meant for a
+private interconnect (TPU-pod DCN / VPC). Two layers, both optional:
+``token`` rejects accidental cross-talk (NOT cryptographic); ``secret``
+enables HMAC-SHA256 frame signing with timestamp + nonce replay
+rejection — authenticity and integrity, but NOT confidentiality (frames
+travel in cleartext; wrap the link in TLS/WireGuard when the network is
+not trusted). Execution is at-least-once; workers dedupe re-delivered
+tasks by id (a cached successful result is returned instead of
+re-running side-effecting tools — see AgentWorker._execute).
 
 Reference intent with no implementation behind it:
 ``pilott/pyproject.toml:19`` (websockets dep),
@@ -29,9 +35,12 @@ Reference intent with no implementation behind it:
 from __future__ import annotations
 
 import asyncio
+import hashlib
+import hmac as _hmac
 import json
 import time
 import uuid
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 from pilottai_tpu.core.agent import BaseAgent
@@ -50,7 +59,65 @@ class RegistrationRejected(ConnectionError):
     hammer the endpoint forever."""
 
 
-async def _send(writer: asyncio.StreamWriter, msg: Dict[str, Any]) -> None:
+class FrameAuth:
+    """HMAC-SHA256 frame signing for the control plane.
+
+    Each outgoing frame gains ``_ts`` (sender clock), ``_nonce`` and
+    ``_sig`` = HMAC(secret, canonical-json of the frame minus ``_sig``).
+    Verification rejects bad signatures, frames older than ``max_skew``
+    seconds, and replayed nonces (bounded memory). This authenticates
+    the peer and protects integrity; it does NOT encrypt — put TLS or a
+    WireGuard tunnel underneath when the wire itself is untrusted."""
+
+    def __init__(self, secret: str, max_skew: float = 60.0) -> None:
+        self._key = secret.encode()
+        self.max_skew = max_skew
+        # nonce -> arrival time, insertion-ordered. Eviction is by AGE:
+        # every nonce is remembered for the full max_skew window (a
+        # count-capped set could roll a nonce out while its frame's
+        # timestamp was still valid, re-opening replay — review
+        # finding). Memory is bounded by frame rate x max_skew.
+        self._seen: "OrderedDict[str, float]" = OrderedDict()
+
+    def _mac(self, msg: Dict[str, Any]) -> str:
+        payload = json.dumps(
+            msg, default=str, sort_keys=True, separators=(",", ":")
+        ).encode()
+        return _hmac.new(self._key, payload, hashlib.sha256).hexdigest()
+
+    def sign(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(msg)
+        out["_ts"] = time.time()
+        out["_nonce"] = uuid.uuid4().hex
+        out["_sig"] = self._mac(out)
+        return out
+
+    def verify(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        sig = msg.pop("_sig", None)
+        if sig is None or not _hmac.compare_digest(sig, self._mac(msg)):
+            raise ConnectionError("control-plane frame failed HMAC check")
+        ts = float(msg.pop("_ts", 0.0))
+        nonce = str(msg.pop("_nonce", ""))
+        if abs(time.time() - ts) > self.max_skew:
+            raise ConnectionError("control-plane frame outside clock skew")
+        now = time.time()
+        while self._seen:
+            _, t0 = next(iter(self._seen.items()))
+            if now - t0 <= self.max_skew:
+                break
+            self._seen.popitem(last=False)
+        if not nonce or nonce in self._seen:
+            raise ConnectionError("control-plane frame replayed")
+        self._seen[nonce] = now
+        return msg
+
+
+async def _send(
+    writer: asyncio.StreamWriter, msg: Dict[str, Any],
+    auth: Optional[FrameAuth] = None,
+) -> None:
+    if auth is not None:
+        msg = auth.sign(msg)
     data = json.dumps(msg, default=str).encode() + b"\n"
     if len(data) > _MAX_LINE:
         # The peer's readline would raise at its limit and tear the
@@ -64,11 +131,17 @@ async def _send(writer: asyncio.StreamWriter, msg: Dict[str, Any]) -> None:
     await writer.drain()
 
 
-async def _recv(reader: asyncio.StreamReader) -> Dict[str, Any]:
+async def _recv(
+    reader: asyncio.StreamReader,
+    auth: Optional[FrameAuth] = None,
+) -> Dict[str, Any]:
     line = await reader.readline()
     if not line:
         raise ConnectionError("peer closed")
-    return json.loads(line)
+    msg = json.loads(line)
+    if auth is not None:
+        msg = auth.verify(msg)
+    return msg
 
 
 class RemoteAgent:
@@ -238,11 +311,15 @@ class ServeEndpoint:
     """TCP listener that attaches remote workers to a running Serve."""
 
     def __init__(self, serve, host: str = "127.0.0.1", port: int = 0,
-                 token: Optional[str] = None) -> None:
+                 token: Optional[str] = None,
+                 secret: Optional[str] = None) -> None:
         self.serve = serve
         self.host = host
         self.port = port
         self.token = token
+        # HMAC frame signing (FrameAuth): authenticity + integrity +
+        # replay rejection when both sides share ``secret``.
+        self._auth = FrameAuth(secret) if secret else None
         self._server: Optional[asyncio.base_events.Server] = None
         self._writers: Dict[str, asyncio.StreamWriter] = {}
         self._proxies: Dict[str, List[RemoteAgent]] = {}
@@ -275,11 +352,12 @@ class ServeEndpoint:
                       writer: asyncio.StreamWriter) -> None:
         worker_id = None
         try:
-            msg = await _recv(reader)
+            msg = await _recv(reader, self._auth)
             if msg.get("type") != "register" or (
                 self.token is not None and msg.get("token") != self.token
             ):
-                await _send(writer, {"type": "error", "error": "bad register"})
+                await _send(writer, {"type": "error", "error": "bad register"},
+                            self._auth)
                 writer.close()
                 return
             worker_id = msg["worker_id"]
@@ -298,13 +376,13 @@ class ServeEndpoint:
                 self.serve.add_agent(proxy)
                 proxies.append(proxy)
             self._proxies[worker_id] = proxies
-            await _send(writer, {"type": "registered"})
+            await _send(writer, {"type": "registered"}, self._auth)
             self._log.info(
                 "worker %s registered %d agents", worker_id[:8], len(proxies)
             )
             global_metrics.inc("control_plane.workers_registered")
             while True:
-                msg = await _recv(reader)
+                msg = await _recv(reader, self._auth)
                 kind = msg.get("type")
                 if kind == "heartbeat":
                     now = time.time()
@@ -347,6 +425,14 @@ class ServeEndpoint:
             # must not tear down the NEW session it no longer owns.
             if worker_id is not None and self._writers.get(worker_id) is writer:
                 await self._drop_worker(worker_id, "worker connection lost")
+            elif worker_id is None:
+                # Never registered (bad token / failed HMAC / garbage):
+                # close the transport here or stop()'s wait_closed blocks
+                # on the half-open connection forever.
+                try:
+                    writer.close()
+                except Exception:  # noqa: BLE001 — already gone
+                    pass
 
     async def _drop_worker(self, worker_id: str, reason: str) -> None:
         writer = self._writers.pop(worker_id, None)
@@ -385,7 +471,7 @@ class ServeEndpoint:
                 "req_id": req_id,
                 "agent_id": proxy.id,
                 "task": task.model_dump(mode="json"),
-            })
+            }, self._auth)
             result = await asyncio.wait_for(fut, timeout=task.timeout)
         except asyncio.TimeoutError:
             self._pending.pop(req_id, None)
@@ -415,7 +501,9 @@ class AgentWorker:
                  worker_id: Optional[str] = None,
                  heartbeat_interval: float = 1.0,
                  token: Optional[str] = None,
-                 reconnect: bool = True) -> None:
+                 reconnect: bool = True,
+                 secret: Optional[str] = None,
+                 result_cache: int = 512) -> None:
         self.host = host
         self.port = port
         self.worker_id = worker_id or str(uuid.uuid4())
@@ -429,6 +517,18 @@ class AgentWorker:
         # Strong refs to in-flight executions: the loop's task refs are
         # weak, and stop() must be able to wait for them.
         self._inflight: set = set()
+        self._auth = FrameAuth(secret) if secret else None
+        # Idempotent re-delivery: at-least-once means a task whose result
+        # was lost in transit (or whose endpoint timed out) can be routed
+        # here AGAIN after it already ran. Side-effecting tools must not
+        # run twice, so successful results are cached by task id and
+        # returned verbatim on re-delivery; a concurrently in-flight
+        # duplicate awaits the first execution instead of starting a
+        # second. Failed attempts are NOT cached — a retry after genuine
+        # failure should re-execute.
+        self._result_cache_cap = result_cache
+        self._results_done: "OrderedDict[str, TaskResult]" = OrderedDict()
+        self._results_running: Dict[str, asyncio.Future] = {}
         self._log = get_logger("agent_worker", agent_id=self.worker_id[:8])
 
     async def start(self) -> None:
@@ -501,8 +601,8 @@ class AgentWorker:
                 }
                 for a in self.agents.values()
             ],
-        })
-        ack = await _recv(reader)
+        }, self._auth)
+        ack = await _recv(reader, self._auth)
         if ack.get("type") != "registered":
             raise RegistrationRejected(f"registration rejected: {ack}")
         self._log.info("registered with orchestrator %s:%d", self.host, self.port)
@@ -513,7 +613,7 @@ class AgentWorker:
         hb = asyncio.create_task(self._heartbeat_loop(writer))
         try:
             while True:
-                msg = await _recv(reader)
+                msg = await _recv(reader, self._auth)
                 if msg.get("type") == "execute":
                     t = asyncio.get_running_loop().create_task(
                         self._execute(writer, msg)
@@ -539,32 +639,64 @@ class AgentWorker:
                     "type": "heartbeat",
                     "worker_id": self.worker_id,
                     "agents": stats,
-                })
+                }, self._auth)
             except ConnectionError:
                 return
             await asyncio.sleep(self.heartbeat_interval)
 
     async def _execute(self, writer: asyncio.StreamWriter,
                        msg: Dict[str, Any]) -> None:
-        try:
-            task = Task.model_validate(msg["task"])
-            agent = self.agents.get(msg["agent_id"])
-            if agent is None:
-                result = TaskResult(
-                    success=False,
-                    error=f"no agent {msg['agent_id'][:8]} on this worker",
-                )
-            else:
-                result = await agent.execute_task(task)
-        except Exception as exc:  # noqa: BLE001 — report, don't die
-            result = TaskResult(success=False, error=str(exc))
+        result = await self._execute_idempotent(msg)
         try:
             await _send(writer, {
                 "type": "result",
                 "req_id": msg["req_id"],
                 "result": result.model_dump(mode="json"),
-            })
+            }, self._auth)
         except ConnectionError:
             self._log.warning(
                 "result for %s lost (connection closed)", msg["req_id"][:16]
             )
+
+    async def _execute_idempotent(self, msg: Dict[str, Any]) -> TaskResult:
+        """Run the task exactly once per worker even under at-least-once
+        delivery: a re-delivered id returns the cached successful result
+        (side-effecting tools must not run twice); a duplicate arriving
+        while the first copy is still executing awaits it. Failures are
+        never cached: a deliberate retry after failure re-executes."""
+        task_id = str(msg.get("task", {}).get("id", msg.get("req_id")))
+        cached = self._results_done.get(task_id)
+        if cached is not None:
+            self._results_done.move_to_end(task_id)
+            global_metrics.inc("control_plane.deduped_redeliveries")
+            self._log.info("re-delivery of %s served from cache", task_id[:8])
+            return cached
+        running = self._results_running.get(task_id)
+        if running is not None:
+            global_metrics.inc("control_plane.deduped_redeliveries")
+            return await asyncio.shield(running)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._results_running[task_id] = fut
+        try:
+            try:
+                task = Task.model_validate(msg["task"])
+                agent = self.agents.get(msg["agent_id"])
+                if agent is None:
+                    result = TaskResult(
+                        success=False,
+                        error=f"no agent {msg['agent_id'][:8]} on this worker",
+                    )
+                else:
+                    result = await agent.execute_task(task)
+            except Exception as exc:  # noqa: BLE001 — report, don't die
+                result = TaskResult(success=False, error=str(exc))
+            if result.success:
+                self._results_done[task_id] = result
+                while len(self._results_done) > self._result_cache_cap:
+                    self._results_done.popitem(last=False)
+            fut.set_result(result)
+            return result
+        finally:
+            self._results_running.pop(task_id, None)
+            if not fut.done():
+                fut.set_result(TaskResult(success=False, error="cancelled"))

@@ -404,3 +404,119 @@ async def test_sigkill_worker_reroutes_to_healthy_agent(tmp_path):
             proc.kill()
         await endpoint.stop()
         await serve.stop()
+
+
+# --------------------------------------------------------------------- #
+# Idempotent re-delivery + HMAC frames (VERDICT r3 next-step 8)
+# --------------------------------------------------------------------- #
+
+def test_frame_auth_sign_verify_tamper_replay():
+    from pilottai_tpu.distributed.control_plane import FrameAuth
+
+    a = FrameAuth("s3cret")
+    b = FrameAuth("s3cret")
+    signed = a.sign({"type": "execute", "x": 1})
+    assert b.verify(dict(signed)) == {"type": "execute", "x": 1}
+    # Replay of the same nonce is rejected.
+    with pytest.raises(ConnectionError):
+        b.verify(dict(signed))
+    # Tampering breaks the MAC.
+    evil = a.sign({"type": "execute", "x": 1})
+    evil["x"] = 2
+    with pytest.raises(ConnectionError):
+        b.verify(evil)
+    # Wrong key fails.
+    with pytest.raises(ConnectionError):
+        FrameAuth("other").verify(a.sign({"type": "hb"}))
+    # Stale timestamp fails.
+    stale = a.sign({"type": "hb"})
+    stale["_ts"] = time.time() - 3600
+    stale["_sig"] = a._mac({k: v for k, v in stale.items() if k != "_sig"})
+    with pytest.raises(ConnectionError):
+        b.verify(stale)
+
+
+@pytest.mark.asyncio
+async def test_hmac_gates_registration():
+    """Matching secrets register and execute; a wrong secret never gets a
+    proxy installed (frames fail verification at the endpoint)."""
+    serve = _serve()
+    await serve.start()
+    endpoint = ServeEndpoint(serve, secret="hmac-key")
+    await endpoint.start()
+    good = AgentWorker(
+        "127.0.0.1", endpoint.port, [_mock_agent()],
+        heartbeat_interval=0.05, secret="hmac-key",
+    )
+    bad = AgentWorker(
+        "127.0.0.1", endpoint.port, [_mock_agent(role="intruder")],
+        heartbeat_interval=0.05, secret="wrong-key", reconnect=False,
+    )
+    await good.start()
+    await bad.start()
+    try:
+        deadline = time.time() + 10
+        while not serve.agents and time.time() < deadline:
+            await asyncio.sleep(0.05)
+        assert serve.agents, "good worker never registered"
+        task = await serve.add_task("authenticated execution")
+        result = await serve.wait_for(task.id, timeout=30)
+        assert result.success
+        await asyncio.sleep(0.3)
+        assert all(
+            getattr(a, "role", "") != "intruder"
+            for a in serve.agents.values()
+        ), "unauthenticated worker got a proxy installed"
+    finally:
+        await bad.stop()
+        await good.stop()
+        await endpoint.stop()
+        await serve.stop()
+
+
+@pytest.mark.asyncio
+async def test_redelivered_task_executes_tools_exactly_once():
+    """At-least-once delivery: the same task id delivered again (lost
+    result / endpoint timeout / reroute back after reconnect) must NOT
+    re-run side-effecting work — the worker serves the cached result."""
+    serve = _serve()
+    await serve.start()
+    endpoint = ServeEndpoint(serve)
+    await endpoint.start()
+    agent = _mock_agent()
+    calls = {"n": 0}
+    real_execute = agent.execute_task
+
+    async def counting_execute(task):
+        calls["n"] += 1
+        return await real_execute(task)
+
+    agent.execute_task = counting_execute
+    worker = AgentWorker(
+        "127.0.0.1", endpoint.port, [agent], heartbeat_interval=0.05,
+    )
+    await worker.start()
+    try:
+        deadline = time.time() + 10
+        while not serve.agents and time.time() < deadline:
+            await asyncio.sleep(0.05)
+        proxy = next(iter(serve.agents.values()))
+        task = Task(description="side-effecting work", type="generic")
+
+        r1 = await endpoint.execute(proxy, task)
+        assert r1.success and calls["n"] == 1
+        # Re-delivery of the SAME task id (simulates a retry after the
+        # first result was lost in transit).
+        r2 = await endpoint.execute(proxy, task)
+        assert r2.success
+        assert calls["n"] == 1, "re-delivered task re-executed the agent"
+        assert r2.output == r1.output
+
+        # A DIFFERENT task id still executes.
+        other = Task(description="new work", type="generic")
+        r3 = await endpoint.execute(proxy, other)
+        assert r3.success and calls["n"] == 2
+    finally:
+        await worker.stop()
+        await endpoint.stop()
+        await serve.stop()
