@@ -126,6 +126,12 @@ class LLMConfig(BaseModel):
     # Entry HBM cost: 2 (K and V) x L x K x bucket(len, cap 1024) x H x
     # itemsize — ~67 MB for llama3-8b bf16 at bucket 512.
     engine_prefix_cache: int = Field(default=4, ge=0)
+    # Adaptive draft-model speculation: >0 enables shallow-layer
+    # self-drafting (the target's own first N layers + unembed propose
+    # drafts — LayerSkip-style, no second checkpoint, no extra HBM) for
+    # slots whose n-gram acceptance collapses on novel text
+    # (engine/decode.py:_model_drafts). Requires engine_speculate >= 2.
+    engine_draft_layers: int = Field(default=0, ge=0)
     # int8 KV cache ("int8" or None): panels stored int8 with symmetric
     # per-token-per-head scales (ops/kvcache.py:quantize_kv). Doubles
     # resident context per HBM GB everywhere; the decode-bandwidth win

@@ -28,6 +28,7 @@ All shapes static → zero recompiles at steady state.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -129,6 +130,7 @@ class ContinuousBatcher:
         speculate: int = 0,
         prefix_cache: int = 4,  # mirrors LLMConfig.engine_prefix_cache
         kv_quantize: bool = False,  # int8 cache panels + per-token scales
+        draft_layers: int = 0,  # shallow-layer self-drafting (adaptive)
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -166,8 +168,6 @@ class ContinuousBatcher:
                 # Pallas prefix kernel at both S=512 and S=2048 — the
                 # kernel stays available for A/B via
                 # PILOTTAI_DECODE_PALLAS=1.
-                import os
-
                 use_pallas = (
                     os.environ.get("PILOTTAI_DECODE_PALLAS", "").lower()
                     in ("1", "true", "yes")
@@ -203,12 +203,30 @@ class ContinuousBatcher:
         # prefixes by construction) don't short-circuit into the
         # tail-prefill path.
         self._warming = False
+        # HBM budget for transiently materialized dense prefix panels on
+        # the paged path (see _dispatch_chunk); beyond it the Pallas
+        # per-page kernel takes over.
+        self._gather_budget = int(
+            os.environ.get("PILOTTAI_GATHER_BUDGET", 5 * 1024**3)
+        )
         # Observed tokens-per-block EMA (1.0 = no acceptance; up to D).
         # Drives the in-flight token estimates: dispatching assuming no
         # acceptance wastes whole weight passes on no-op chunks (measured
         # 4x wave time on v5e), assuming full acceptance stalls the
         # pipeline when drafts miss.
         self._spec_rate = 1.0
+        # Adaptive draft source (engine/decode.py:_model_drafts): slots
+        # whose PER-SLOT acceptance EMA collapses under n-gram drafting
+        # (novel text — nothing in history to copy) switch to
+        # shallow-layer model drafting; hysteresis keeps flappers stable.
+        self.draft_layers = (
+            min(draft_layers, cfg.n_layers - 1)
+            if draft_layers > 0 and self.speculate else 0
+        )
+        self._slot_rate = np.full(
+            (n_slots,), float(max(self.speculate, 1)), np.float32
+        )
+        self._draft_on = np.zeros((n_slots,), bool)
 
         self.cache_dtype = cache_dtype
         # Paged KV: shared page pool + host-side block table/allocator
@@ -767,6 +785,11 @@ class ContinuousBatcher:
                     request=req, prompt_len=len(req.prompt_ids)
                 )
                 self._gen[idx] += 1
+                # Fresh occupant: optimistic n-gram first (its lookups
+                # are free); the per-slot EMA demotes to model drafting
+                # only if this request's output proves unpredictable.
+                self._slot_rate[idx] = float(max(self.speculate, 1))
+                self._draft_on[idx] = False
             self._first_reads.append(
                 ([(idx, self._gen[idx]) for idx, _ in group], first)
             )
@@ -922,6 +945,21 @@ class ContinuousBatcher:
         table = (
             jnp.asarray(self.alloc.table) if self.alloc is not None else None
         )
+        # Paged prefix reads: the per-page Pallas kernel streams only the
+        # pages a slot owns, but pays a per-grid-cell latency that
+        # dominates at serving-sized bounds (profiled on v5e: ~2x block
+        # time at a 2K bound vs materializing dense panels once per
+        # chunk and letting XLA's dense attention read them). Use the
+        # gather fallback while the transient panels fit comfortably in
+        # HBM; switch to the kernel only at bounds where they would not.
+        use_pallas_now = self.use_pallas
+        if self.paged and self.use_pallas:
+            gather_bytes = (
+                2 * self.cfg.n_layers * self.n_slots * self.cfg.n_kv_heads
+                * prefix_bound * self.cfg.head_dim
+                * jnp.dtype(self.cfg.dtype).itemsize
+            )
+            use_pallas_now = gather_bytes > self._gather_budget
         # Token-mask tables ride along only while a live slot constrains
         # (see _prefill_group). Lock-free read is safe: slots are INSTALLED
         # on this thread (so a constraining slot is always seen), and the
@@ -943,13 +981,18 @@ class ContinuousBatcher:
                     self.sampling, self.history, self.chunk_size,
                     self.speculate, prefix_bound=prefix_bound,
                     json_tables=chunk_json, table=table,
-                    use_pallas=self.paged and self.use_pallas,
+                    use_pallas=self.paged and use_pallas_now,
+                    draft_layers=self.draft_layers,
+                    draft_mode=(
+                        jnp.asarray(self._draft_on)
+                        if self.draft_layers else None
+                    ),
                 )
             else:
                 toks, valid, self.cache, self.dstate, self.sampling = (
                     decode_chunk(
                         self.params, self.cfg, self.cache, self.dstate,
-                        self.sampling, self.chunk_size, self.use_pallas,
+                        self.sampling, self.chunk_size, use_pallas_now,
                         prefix_bound=prefix_bound, table=table,
                         json_tables=chunk_json,
                     )
@@ -977,6 +1020,11 @@ class ContinuousBatcher:
         toks_h = np.asarray(fetched[0])
         valid_h = np.asarray(fetched[1])
         n, B = toks_h.shape
+        if self.speculate and self.draft_layers:
+            D = self.speculate
+            blk3 = valid_h.reshape(self.chunk_size, D, B)
+            slot_blocks = blk3.any(axis=1).sum(axis=0)       # [B]
+            slot_tokens = valid_h.sum(axis=0)
         with self._lock:
             # First tokens were sampled before this chunk ran — fold them
             # first so token order inside each slot is right.
@@ -986,6 +1034,26 @@ class ContinuousBatcher:
                 slot = self._slots[b]
                 if slot is None or gen_stamp[b] != self._gen[b]:
                     continue
+                if self.speculate and self.draft_layers and slot_blocks[b]:
+                    # Per-slot acceptance EMA + hysteresis for the draft
+                    # source — under the lock AND behind the generation
+                    # stamp, so a late chunk from an evicted request can
+                    # never demote the slot's new occupant to the paid
+                    # model-draft mode (review finding). Thresholds scale
+                    # with D: at small D the absolute 3.0 hand-back was
+                    # unreachable and draft mode latched on forever.
+                    obs_b = slot_tokens[b] / slot_blocks[b]
+                    self._slot_rate[b] = (
+                        0.5 * self._slot_rate[b] + 0.5 * obs_b
+                    )
+                    D = self.speculate
+                    enter = 1.0 + 0.125 * D
+                    if not self._draft_on[b] and self._slot_rate[b] < enter:
+                        self._draft_on[b] = True
+                    elif self._draft_on[b] and (
+                        self._slot_rate[b] > enter + 0.25 * D
+                    ):
+                        self._draft_on[b] = False
                 # This chunk's contribution leaves the in-flight ledger
                 # whether or not tokens landed (same occupant only).
                 slot.est_pending = max(0.0, slot.est_pending - est)

@@ -82,6 +82,24 @@ def _bounded_panels(cache, l: int, op, dtype):
     return _dequant_pair(op(k_), op(v_), sc, dtype)
 
 
+def _layer_tail(cfg: ModelConfig, lp, x: jax.Array, attn: jax.Array) -> jax.Array:
+    """Everything after a layer's attention weights: projection,
+    optional post-norms, residual, MLP, residual. ONE definition shared
+    by the plain chunk, the speculative chunk, the shallow-layer draft
+    and the tail prefill — the draft's documented invariant ('the draft
+    computes exactly the target's shallow prefix') depends on these
+    staying in lockstep (review finding)."""
+    out = _attn_out(cfg, lp["attn"], attn)
+    if cfg.post_norms:
+        out = rms_norm(out, lp["ln1_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
+    x = x + out
+    h = rms_norm(x, lp["ln2"]["scale"], cfg.rms_eps, cfg.rms_offset)
+    out, _ = _mlp(cfg, lp, h)
+    if cfg.post_norms:
+        out = rms_norm(out, lp["ln2_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
+    return x + out
+
+
 class DecodeState(NamedTuple):
     """Per-slot generation state living on device across chunks."""
 
@@ -341,19 +359,10 @@ def decode_chunk(
             )
             attn = _combine_stats(acc_p, m_p, l_p, acc_c, m_c, l_c)
 
-            out = _attn_out(cfg, p, attn.astype(x.dtype)[:, None])
-            if cfg.post_norms:
-                out = rms_norm(
-                    out, lp["ln1_post"]["scale"], cfg.rms_eps, cfg.rms_offset
-                )
-            x_res = x + out
-            h = rms_norm(x_res, lp["ln2"]["scale"], cfg.rms_eps, cfg.rms_offset)
-            out, _ = _mlp(cfg, lp, h)
-            if cfg.post_norms:
-                out = rms_norm(
-                    out, lp["ln2_post"]["scale"], cfg.rms_eps, cfg.rms_offset
-                )
-            x = x_res + out
+            x = _layer_tail(
+                cfg, lp, x,
+                attn.astype(x.dtype).reshape(B, 1, cfg.n_heads, cfg.head_dim),
+            )
             new_rings.append((rk, rv))
 
         h = rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps, cfg.rms_offset)
@@ -460,6 +469,123 @@ def _ngram_drafts(
     return jnp.where(found[:, None], drafts, 0)
 
 
+def _model_drafts(
+    params,
+    cfg: ModelConfig,
+    draft_layers: int,
+    n_draft: int,
+    cur: jax.Array,      # [B] current token
+    pos: jax.Array,      # [B] its absolute position
+    prefix_panels,       # per-layer bounded panels (or pools when paged)
+    rings,               # per-layer (rk, rv) chunk rings [B, K, R, H]
+    start: jax.Array,    # [B] slot length at chunk start
+    offset: jax.Array,   # [B] valid ring rows
+    last: jax.Array,     # [B] max valid prefix key index
+    paged_kernel,        # None, or dict(table=, n_blocks=, kv_scales=)
+    windows,
+    qscale: float,
+) -> jax.Array:
+    """Self-speculative drafting: run the target model's own FIRST
+    ``draft_layers`` layers (plus final norm + unembed — weights shared,
+    zero extra HBM) autoregressively for ``n_draft`` steps. This is the
+    draft-model path for traffic the n-gram can't predict (novel prose,
+    first-time prompts): a shallow prefix of the network agrees with the
+    full forward far more often than a history lookup does, at
+    ``draft_layers / n_layers`` of a weight pass per draft token
+    (LayerSkip-style early-exit drafting; see PAPERS.md).
+
+    The draft attends exactly what the verify pass will: bounded prefix
+    panels + the chunk ring + its own in-block buffer — so the layers it
+    DOES run compute the same K/V the target would for those tokens.
+    Draft quality only affects speed, never output: acceptance still
+    compares the target's masked greedy rows against these proposals."""
+    B = cur.shape[0]
+    K = cfg.n_kv_heads
+    G = cfg.n_heads // cfg.n_kv_heads
+    H = cfg.head_dim
+    cache_dtype = rings[0][0].dtype
+    bufs = tuple(
+        (jnp.zeros((B, K, n_draft, H), cache_dtype),
+         jnp.zeros((B, K, n_draft, H), cache_dtype))
+        for _ in range(draft_layers)
+    )
+
+    def dstep(carry, j):
+        tok, bufs = carry
+        qpos = pos + j                       # input token's position
+        x = _embed(cfg, params, tok[:, None])
+        sin, cos = rope_tables(qpos[:, None], cfg.head_dim, cfg.rope_theta)
+        new_bufs = []
+        for l in range(draft_layers):
+            lp = jax.tree.map(lambda a: a[l], params["layers"])
+            window = int(windows[l])
+            rk, rv = rings[l]
+            bk, bv = bufs[l]
+            h = rms_norm(x, lp["ln1"]["scale"], cfg.rms_eps, cfg.rms_offset)
+            q, k, v = _qkv(cfg, lp["attn"], h, sin, cos)
+            # Write THIS token's K/V before attending (count j+1): the
+            # verify pass's in-block mask (e <= d) includes self, and the
+            # draft must compute exactly the target's shallow prefix or
+            # acceptance silently degrades (review finding).
+            bk = jax.lax.dynamic_update_slice(
+                bk, k[:, 0][:, :, None].astype(bk.dtype), (0, 0, j, 0)
+            )
+            bv = jax.lax.dynamic_update_slice(
+                bv, v[:, 0][:, :, None].astype(bv.dtype), (0, 0, j, 0)
+            )
+            qf = q[:, 0]                                   # [B, N, H]
+            qg = qf.reshape(B, K, G, H)
+            if paged_kernel is not None:
+                sc = paged_kernel["kv_scales"]
+                acc_p, m_p, l_p = paged_decode_attention(
+                    qf, prefix_panels[l][0], prefix_panels[l][1],
+                    paged_kernel["table"], last, q_positions=qpos,
+                    n_blocks=paged_kernel["n_blocks"], scale=qscale,
+                    softcap=cfg.attn_softcap, window=window,
+                    k_scales=None if sc is None else sc[l][0],
+                    v_scales=None if sc is None else sc[l][1],
+                )
+                acc_p = acc_p.reshape(B, K, G, H)
+                m_p = m_p.reshape(B, K, G)
+                l_p = l_p.reshape(B, K, G)
+            else:
+                acc_p, m_p, l_p = _prefix_stats_dense(
+                    qg, prefix_panels[l][0], prefix_panels[l][1],
+                    last, qpos, qscale, cfg.attn_softcap, window,
+                )
+                acc_p = acc_p.reshape(B, K, G, H)
+                m_p = m_p.reshape(B, K, G)
+                l_p = l_p.reshape(B, K, G)
+            acc_r, m_r, l_r = _ragged_stats(
+                qg, rk, rv, offset, start, qpos,
+                qscale, cfg.attn_softcap, window,
+            )
+            acc_b, m_b, l_b = _ragged_stats(
+                qg, bk, bv, jnp.full((B,), j + 1, jnp.int32), pos, qpos,
+                qscale, cfg.attn_softcap, window,
+            )
+            acc, m, lsum = _merge_stats(acc_p, m_p, l_p, acc_r, m_r, l_r)
+            acc, _, lsum = _merge_stats(acc, m, lsum, acc_b, m_b, l_b)
+            attn = acc / jnp.maximum(lsum, 1e-30)[..., None]
+            x = _layer_tail(
+                cfg, lp, x,
+                attn.astype(x.dtype).reshape(B, 1, cfg.n_heads, H),
+            )
+            new_bufs.append((bk, bv))
+        h = rms_norm(
+            x, params["final_norm"]["scale"], cfg.rms_eps, cfg.rms_offset
+        )
+        nxt = jnp.argmax(_unembed(cfg, params, h)[:, 0], axis=-1).astype(
+            jnp.int32
+        )
+        return (nxt, tuple(new_bufs)), nxt
+
+    (_, _), drafts = jax.lax.scan(
+        dstep, (cur, bufs), jnp.arange(n_draft)
+    )
+    return drafts.T                                        # [B, n_draft]
+
+
 def _merge_stats(acc_a, m_a, l_a, acc_b, m_b, l_b):
     """Unnormalized online-softmax merge over disjoint key sets (the
     normalizing division happens once, after the last merge)."""
@@ -467,6 +593,44 @@ def _merge_stats(acc_a, m_a, l_a, acc_b, m_b, l_b):
     wa = jnp.where(m_a > NEG_INF / 2, jnp.exp(m_a - m), 0.0)
     wb = jnp.where(m_b > NEG_INF / 2, jnp.exp(m_b - m), 0.0)
     return acc_a * wa[..., None] + acc_b * wb[..., None], m, l_a * wa + l_b * wb
+
+
+def _ragged_stats(
+    qg: jax.Array,     # [B, K, G, H] single-position queries
+    ks: jax.Array,     # [B, K, N, H] — row r valid iff r < count[b]
+    vs: jax.Array,
+    count: jax.Array,  # [B] valid rows
+    pos0: jax.Array,   # [B] absolute position of row 0 (sliding window)
+    qpos: jax.Array,   # [B] query positions
+    scale: float,
+    softcap: float,
+    window: int,
+):
+    """Online-softmax partials over a per-slot ragged key buffer — the
+    generic form of ``_ring_stats`` (whose validity is a shared scalar).
+    Used by the shallow-layer draft for both the chunk ring and its own
+    in-block buffer."""
+    B, K, G, H = qg.shape
+    N = ks.shape[2]
+    s = jnp.einsum(
+        "bkgh,bknh->bkgn", qg, ks, preferred_element_type=jnp.float32
+    ) * scale
+    if softcap > 0.0:
+        s = jnp.tanh(s / softcap) * softcap
+    r = jnp.arange(N)[None, None, None, :]
+    mask = r < count[:, None, None, None]
+    if window > 0:
+        kpos = pos0[:, None, None, None] + r
+        mask &= (qpos[:, None, None, None] - kpos) < window
+    s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.where(m[..., None] > NEG_INF / 2, jnp.exp(s - m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum(
+        "bkgn,bknh->bkgh", p.astype(vs.dtype), vs,
+        preferred_element_type=jnp.float32,
+    )
+    return acc, m, l
 
 
 def _spec_block_attn(
@@ -571,7 +735,10 @@ def _spec_block_attn(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "n_steps", "draft_len", "prefix_bound", "use_pallas"),
+    static_argnames=(
+        "cfg", "n_steps", "draft_len", "prefix_bound", "use_pallas",
+        "draft_layers",
+    ),
     donate_argnames=("cache", "dstate", "sampling", "history"),
 )
 def decode_chunk_spec(
@@ -588,6 +755,10 @@ def decode_chunk_spec(
     table: Optional[jax.Array] = None,  # [B, max_pages] — paged cache only
     use_pallas: bool = False,           # paged prefix reads via the Pallas
                                         # kernel (TPU); else gather fallback
+    draft_layers: int = 0,   # >0: shallow-layer self-drafting available
+    draft_mode: Optional[jax.Array] = None,  # [B] bool — slots whose
+                                        # drafts come from the model
+                                        # instead of the n-gram lookup
 ) -> Tuple[jax.Array, jax.Array, KVCache, DecodeState, SamplingState, jax.Array]:
     """Speculative fused chunk: ``n_steps`` verify-blocks of ``draft_len``
     tokens per dispatch. Same contract as ``decode_chunk`` except the
@@ -663,6 +834,31 @@ def decode_chunk_spec(
         active = ~done
         pos = start + offset
         drafts = _ngram_drafts(history, pos, tokens, D - 1)
+        if draft_layers > 0:
+            # Adaptive drafting: slots whose n-gram acceptance EMA
+            # collapsed (host-side hysteresis, engine/batcher.py) draft
+            # through the model's own first layers instead. lax.cond
+            # skips the shallow forward entirely while every slot is
+            # still n-gram-happy.
+            pk_info = (
+                {"table": table, "n_blocks": n_blocks,
+                 "kv_scales": kv_scales}
+                if (paged and use_pallas) else None
+            )
+            mode = (
+                draft_mode if draft_mode is not None
+                else jnp.zeros((B,), bool)
+            )
+            mdrafts = jax.lax.cond(
+                jnp.any(mode),
+                lambda: _model_drafts(
+                    params, cfg, draft_layers, D - 1, tokens, pos,
+                    prefix_panels, rings, start, offset, prefix_last,
+                    pk_info, windows, qscale,
+                ),
+                lambda: drafts,
+            )
+            drafts = jnp.where(mode[:, None], mdrafts, drafts)
         blk = jnp.concatenate([tokens[:, None], drafts], axis=1)  # [B, D]
         pvec = pos[:, None] + jnp.arange(D)[None, :]
         x = _embed(cfg, params, blk)                              # [B, D, E]
@@ -713,21 +909,10 @@ def decode_chunk_spec(
                     prefix_last, start, offset, pvec,
                     qscale, cfg.attn_softcap, window,
                 )
-            out = _attn_out(cfg, p, attn.astype(x.dtype).reshape(
-                B, D, cfg.n_heads, cfg.head_dim
-            ))
-            if cfg.post_norms:
-                out = rms_norm(
-                    out, lp["ln1_post"]["scale"], cfg.rms_eps, cfg.rms_offset
-                )
-            x_res = x + out
-            h = rms_norm(x_res, lp["ln2"]["scale"], cfg.rms_eps, cfg.rms_offset)
-            out, _ = _mlp(cfg, lp, h)
-            if cfg.post_norms:
-                out = rms_norm(
-                    out, lp["ln2_post"]["scale"], cfg.rms_eps, cfg.rms_offset
-                )
-            x = x_res + out
+            x = _layer_tail(
+                cfg, lp, x,
+                attn.astype(x.dtype).reshape(B, D, cfg.n_heads, cfg.head_dim),
+            )
             new_rings.append((blk_k, blk_v))
 
         h = rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps, cfg.rms_offset)
@@ -992,17 +1177,10 @@ def _tail_prefill_core(
                 qg, pk, pv, blk_k, blk_v, prefix_len, tail_lens,
                 qscale, cfg.attn_softcap, 0,
             )
-        out = _attn_out(cfg, lp["attn"], attn.astype(x.dtype).reshape(
-            A, Tt, cfg.n_heads, cfg.head_dim
-        ))
-        if cfg.post_norms:
-            out = rms_norm(out, lp["ln1_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
-        x = x + out
-        h = rms_norm(x, lp["ln2"]["scale"], cfg.rms_eps, cfg.rms_offset)
-        out, _ = _mlp(cfg, lp, h)
-        if cfg.post_norms:
-            out = rms_norm(out, lp["ln2_post"]["scale"], cfg.rms_eps, cfg.rms_offset)
-        x = x + out
+        x = _layer_tail(
+            cfg, lp, x,
+            attn.astype(x.dtype).reshape(A, Tt, cfg.n_heads, cfg.head_dim),
+        )
         return x, (blk_k, blk_v)
 
     x, (ks, vs) = jax.lax.scan(

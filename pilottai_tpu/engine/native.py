@@ -208,6 +208,7 @@ class NativeEngine(LLMBackend):
             speculate=self.config.engine_speculate,
             prefix_cache=self.config.engine_prefix_cache,
             kv_quantize=self.config.engine_kv_quantize == "int8",
+            draft_layers=self.config.engine_draft_layers,
         )
         self.batcher.start()
         self.batcher.warmup()

@@ -1,0 +1,148 @@
+"""Adaptive draft-model speculation (shallow-layer self-drafting).
+
+VERDICT r3 next-step 3: n-gram drafting collapses to ~1 token/pass on
+novel text. The draft-model path runs the target's own first N layers +
+unembed as the drafter (engine/decode.py:_model_drafts). The safety
+invariant is the same as all speculation here: draft SOURCE can never
+change output — acceptance compares the target's own masked greedy rows
+against the proposal — so every test pins bit-parity with the plain
+chunk while the drafts come from the model.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pilottai_tpu.engine.decode import (
+    decode_chunk,
+    decode_chunk_spec,
+)
+from pilottai_tpu.models.common import init_params
+from pilottai_tpu.models.registry import get_model_config
+from tests.test_speculative import PROMPTS, _admit, _collect
+
+
+@pytest.mark.parametrize("model", ["llama-tiny", "gemma-tiny"])
+def test_model_draft_greedy_parity(model):
+    """draft_mode=ON for every slot: the stream must still be
+    bit-identical to the plain chunk (gemma covers the sliding-window +
+    softcap branches of the draft's three-source attention)."""
+    cfg = get_model_config(model)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    budgets = [25, 25, 25]
+
+    c1, d1, s1, _, f1 = _admit(cfg, params, PROMPTS, budgets)
+    plain = [[] for _ in range(4)]
+    for _ in range(4):
+        t, v, c1, d1, s1 = decode_chunk(
+            params, cfg, c1, d1, s1, 8, use_pallas=False
+        )
+        for b, seq in enumerate(_collect(t, v, 4)):
+            plain[b].extend(seq)
+
+    c2, d2, s2, h2, f2 = _admit(cfg, params, PROMPTS, budgets)
+    np.testing.assert_array_equal(f1, f2)
+    spec = [[] for _ in range(4)]
+    for _ in range(4):
+        t, v, c2, d2, s2, h2 = decode_chunk_spec(
+            params, cfg, c2, d2, s2, h2, 8, 4,
+            draft_layers=2, draft_mode=jnp.ones((4,), bool),
+        )
+        for b, seq in enumerate(_collect(t, v, 4)):
+            spec[b].extend(seq)
+
+    for b in range(3):
+        assert spec[b] == plain[b], f"slot {b} diverged under model drafts"
+    np.testing.assert_array_equal(
+        np.asarray(c1.lengths), np.asarray(c2.lengths)
+    )
+
+
+def test_model_draft_mixed_mode_parity():
+    """Half the slots draft via the model, half via the n-gram — output
+    must still match the plain chunk slot for slot."""
+    cfg = get_model_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    budgets = [20, 20, 20]
+
+    c1, d1, s1, _, _ = _admit(cfg, params, PROMPTS, budgets)
+    plain = [[] for _ in range(4)]
+    for _ in range(3):
+        t, v, c1, d1, s1 = decode_chunk(
+            params, cfg, c1, d1, s1, 8, use_pallas=False
+        )
+        for b, seq in enumerate(_collect(t, v, 4)):
+            plain[b].extend(seq)
+
+    c2, d2, s2, h2, _ = _admit(cfg, params, PROMPTS, budgets)
+    mode = jnp.asarray([True, False, True, False])
+    spec = [[] for _ in range(4)]
+    for _ in range(3):
+        t, v, c2, d2, s2, h2 = decode_chunk_spec(
+            params, cfg, c2, d2, s2, h2, 8, 4,
+            draft_layers=2, draft_mode=mode,
+        )
+        for b, seq in enumerate(_collect(t, v, 4)):
+            spec[b].extend(seq)
+    for b in range(3):
+        assert spec[b] == plain[b], f"slot {b} diverged in mixed mode"
+
+
+def test_model_drafts_accept_on_shallow_agreement():
+    """A 2-layer draft of a 2-layer model IS the model (minus nothing):
+    drafts must be exact and acceptance full — the mechanism's upper
+    bound works. Uses a truncated-depth config so draft == target."""
+    cfg = get_model_config("llama-tiny")
+    assert cfg.n_layers >= 2
+    params = init_params(cfg, jax.random.PRNGKey(1), dtype=jnp.float32)
+    c, d, s, h, _ = _admit(cfg, params, [[7, 8, 9, 10, 11]], [40], n_slots=2)
+    emitted = 0
+    blocks = 0
+    for _ in range(4):
+        t, v, c, d, s, h = decode_chunk_spec(
+            params, cfg, c, d, s, h, 4, 4,
+            draft_layers=cfg.n_layers,  # full-depth draft == the target
+            draft_mode=jnp.asarray([True, True]),
+        )
+        vv = np.asarray(v)[:, 0]
+        emitted += int(vv.sum())
+        blocks += int(np.asarray(v).reshape(4, 4, 2)[:, :, 0].any(axis=1).sum())
+    # Full-depth drafts are exact: every non-terminal block accepts all
+    # D-1 drafts + bonus.
+    assert emitted / max(blocks, 1) >= 3.5, (emitted, blocks)
+
+
+@pytest.mark.asyncio
+async def test_engine_draft_layers_e2e_parity():
+    """Full engine with engine_draft_layers on: byte-identical output to
+    the plain engine, whatever the adaptive mode did internally."""
+    from pilottai_tpu.core.config import LLMConfig
+    from pilottai_tpu.engine.handler import LLMHandler
+    from pilottai_tpu.engine.types import ChatMessage, GenerationParams
+
+    async def run(draft_layers):
+        h = LLMHandler(LLMConfig(
+            model_name="llama-tiny", provider="cpu", engine_slots=2,
+            engine_max_seq=128, engine_chunk=4, dtype="float32",
+            engine_speculate=4, engine_draft_layers=draft_layers,
+        ))
+        await h.start()
+        try:
+            outs = []
+            for prompt in ("abc abc abc", "novel one-off text xyz"):
+                r = await h.generate_response(
+                    [ChatMessage(content=prompt)],
+                    params=GenerationParams(max_new_tokens=14,
+                                            temperature=0.0),
+                )
+                outs.append(r.content)
+            return outs
+        finally:
+            await h.stop()
+
+    plain = await run(0)
+    drafted = await run(2)
+    assert drafted == plain
