@@ -1,24 +1,42 @@
-"""Headline benchmark: agent-steps/sec/chip through the in-tree engine.
+"""Headline benchmark: agent-steps/sec/chip through the in-tree engine,
+plus orchestrator-level numbers through ``Serve`` itself.
 
 An "agent step" is one LLM call inside the agent's plan/act/evaluate loop
 (SURVEY.md §3.4: a simple task is ≥4 such calls; the reference pays a
 remote HTTPS round-trip per step, ``pilott/engine/llm.py:59``). Here the
 same step runs on local devices through the continuous batcher.
 
-Two sections on accelerator (VERDICT r2 next-step 3):
+Five sections on accelerator (VERDICT r3 next-steps 1, 2, 6, 9):
 
 * ``llama3-1b-byte`` — 32-way concurrency throughput section;
-* ``llama3-8b`` — the BASELINE.md north-star model, int8 weight-only +
-  speculative decoding, 8-way; its p50 vs the ≤500 ms target is the
-  headline (``vs_baseline`` = 500 / p50_8b — ≥1.0 means target met; the
-  reference publishes no numbers of its own, SURVEY.md §6).
+* ``llama3-8b-byte`` — the BASELINE.md north-star model, int8
+  weight-only + speculative decoding (D=6 verify blocks, early-exit
+  chunks), 8-way, over COLD prompts (every request's task suffix is
+  unique — prefix caching may share the page-aligned/LCP preamble, the
+  way real agent traffic shares the rules preamble, but no request is
+  an exact repeat); its p50 vs the ≤500 ms target is the headline
+  (``vs_baseline`` = 500 / p50_8b);
+* ``llama3-8b-byte @ 4K paged`` — the long-context serving path: paged
+  KV + int8 KV cache + speculation + block-granular prefix caching
+  composed (round 3 silently lost all three under paging);
+* ``pipeline`` — BASELINE config #3 end-to-end: Serve + manager + 3
+  specialist workers running the document pipeline on the real 1B
+  engine, task-completion p50 *through* ``Serve.execute``;
+* ``swarm`` — BASELINE config #4: 32 agents on one Serve sharing the
+  1B engine, agent LLM steps/s through the orchestrator.
 
 The TPU is reached through a shared tunnel whose latency oscillates
 between ~100 ms and multi-second stalls (see .claude/skills/verify
 gotchas); a single epoch can land in a bad window and misreport the
-engine by 5x. Each section therefore runs several epochs and reports the
-best one (peak sustained throughput) PLUS the median epoch and every
+engine by 5x. Engine sections therefore run several epochs and report
+the best one (peak sustained throughput) PLUS the median epoch and every
 epoch's rate, so the flattering statistic never stands alone.
+
+Perf note (round 4, measured on one v5e through the tunnel): the 8B
+decode device time sits near its bandwidth floor — ~14 ms per verify
+block (jax.profiler: 181 ms chunk + 27 ms admission per 8-way wave at
+acceptance ~3.7) — so wave latency ≈ device time + ~100-130 ms of
+tunnel round trips that co-located hardware would not pay.
 
 Prints ONE JSON line.
 """
@@ -31,20 +49,38 @@ import statistics
 import sys
 import time
 
+# Persistent compilation cache: the driver re-runs this benchmark every
+# round in a fresh process; warm boots cut 8B engine-up from ~140 s to
+# ~30 s (utils/compile_cache.py).
+os.environ.setdefault(
+    "PILOTTAI_COMPILE_CACHE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+
 import jax
 
 MAX_NEW_TOKENS = 48    # JSON-ish agent-step reply length
 TARGET_P50_MS = 500.0  # BASELINE.md north star for llama3-8b
 
-PROMPT = (
+PREAMBLE = (
     "Analyze the task and respond with JSON: "
     '{"requires_decomposition": false, "complexity": 3, '
-    '"estimated_resources": {"agents": 1}}. Task: summarize the quarterly '
-    "report into three bullet points for the executive team."
+    '"estimated_resources": {"agents": 1}}. Task: '
 )
 
 
-async def bench_model(cfg, concurrency, steps, epochs, n_chips=1):
+def _prompt(uid: int, pad_to: int = 0) -> str:
+    """Agent-step prompt with a UNIQUE task suffix (cold request). The
+    shared preamble mirrors real traffic (rules.yaml is byte-identical
+    across calls); ``pad_to`` repeats it to reach long-context sizes."""
+    pre = PREAMBLE
+    while pad_to and len(pre) < pad_to:
+        pre += PREAMBLE
+    return pre + f"summarize document {uid} for the executive team"
+
+
+async def bench_model(cfg, concurrency, steps, epochs, n_chips=1,
+                      pad_to=0):
     """Run one engine section; returns the result dict."""
     from pilottai_tpu.engine.handler import LLMHandler
     from pilottai_tpu.engine.types import GenerationParams
@@ -52,9 +88,13 @@ async def bench_model(cfg, concurrency, steps, epochs, n_chips=1):
 
     handler = LLMHandler(cfg)
     params = GenerationParams(max_new_tokens=MAX_NEW_TOKENS, temperature=0.0)
+    uid = [0]
 
     async def one_step():
-        return await handler.apredict(PROMPT, params=params)
+        uid[0] += 1
+        return await handler.apredict(
+            _prompt(uid[0], pad_to), params=params
+        )
 
     # Warmup: two full waves — the first compiles prefill buckets +
     # decode, the second the PREFIX-HIT admission variants and settles
@@ -105,8 +145,124 @@ async def bench_model(cfg, concurrency, steps, epochs, n_chips=1):
         "steps": len(latencies),
         "speculate": cfg.engine_speculate,
         "quantize": cfg.quantize,
+        "paged": bool(cfg.engine_paged_kv),
+        "kv_quantize": cfg.engine_kv_quantize,
         "epoch_steps_per_sec": epoch_rates,
     }
+
+
+async def bench_pipeline(provider: str, rounds: int = 4):
+    """BASELINE config #3 through the orchestrator: Serve + manager + 3
+    specialists on the document pipeline, real engine, measured at
+    ``Serve.execute`` granularity (routing, evaluation, retry and
+    journaling included)."""
+    from examples.document_pipeline.pipeline import (
+        SAMPLE_DOC,
+        build_pipeline,
+        stage_tasks,
+    )
+
+    serve, _memory = build_pipeline(provider=provider)
+    await serve.start()
+    try:
+        waves = []
+        task_lat = []
+        ok = total = 0
+        for r in range(rounds + 1):  # round 0 is warmup/compile
+            tasks = stage_tasks(
+                str(SAMPLE_DOC), f"What are the key findings? (round {r})"
+            )
+            t0 = time.perf_counter()
+            results = await serve.execute(list(tasks))
+            wall = time.perf_counter() - t0
+            if r > 0:
+                waves.append(wall)
+                ok += sum(1 for res in results if res.success)
+                total += len(results)
+                task_lat += [
+                    res.execution_time for res in results
+                    if res.execution_time
+                ]
+    finally:
+        await serve.stop()
+    gc.collect()
+    # Success is reported, not asserted: a random-weight model can fail
+    # a stage on content (tool orchestration still runs, evaluation and
+    # retry included) — the orchestrator path is what this measures.
+    return {
+        "pipeline_p50_ms": round(statistics.median(task_lat) * 1000.0, 1),
+        "pipeline_wall_s": round(statistics.median(waves), 2),
+        "pipeline_success": f"{ok}/{total}",
+        "rounds": rounds,
+        "stages_per_round": len(tasks),
+    }
+
+
+async def bench_swarm(model: str, provider: str, n_agents: int = 32,
+                      n_tasks: int = 96):
+    """BASELINE config #4 through the orchestrator: a swarm of agents on
+    one Serve sharing a single engine. Reports LLM agent-steps/s (the
+    analyze/evaluate/step calls Serve's task flow actually makes) and
+    task-completion p50 through ``Serve.execute_task``."""
+    from pilottai_tpu.core.agent import BaseAgent
+    from pilottai_tpu.core.config import AgentConfig, LLMConfig, ServeConfig
+    from pilottai_tpu.engine.handler import LLMHandler
+    from pilottai_tpu.serve import Serve
+    from pilottai_tpu.utils.metrics import global_metrics
+
+    llm = LLMHandler(LLMConfig(
+        model_name=model, provider=provider,
+        engine_slots=n_agents, engine_admit_batch=n_agents,
+        engine_max_seq=512, engine_chunk=16,
+        dtype="bfloat16" if provider == "tpu" else "float32",
+        quantize="int8" if provider == "tpu" else None,
+        engine_speculate=4,
+    ))
+    agents = [
+        BaseAgent(
+            config=AgentConfig(role=f"worker{i}", specializations=["generic"]),
+            llm=llm,
+        )
+        for i in range(n_agents)
+    ]
+    serve = Serve(
+        name="swarm-bench", agents=agents, manager_llm=llm,
+        config=ServeConfig(
+            decomposition_enabled=False, max_concurrent_tasks=n_agents,
+        ),
+    )
+    await serve.start()
+    try:
+        # Warmup wave (compiles + acceptance EMA).
+        await asyncio.gather(*[
+            serve.execute_task(f"warm task {i}") for i in range(n_agents)
+        ])
+        c0 = global_metrics.get("engine.completed")
+        t0 = time.perf_counter()
+        results = await asyncio.gather(*[
+            serve.execute_task(f"swarm task {i}: check inventory {i}")
+            for i in range(n_tasks)
+        ])
+        wall = time.perf_counter() - t0
+        llm_steps = global_metrics.get("engine.completed") - c0
+        lat = [r.execution_time for r in results if r.execution_time]
+        ok = sum(1 for r in results if r.success)
+    finally:
+        await serve.stop()
+    gc.collect()
+    return {
+        "swarm_steps_per_sec": round(llm_steps / wall, 2),
+        "swarm_task_p50_ms": round(statistics.median(lat) * 1000.0, 1),
+        "swarm_tasks_per_sec": round(n_tasks / wall, 2),
+        "swarm_success": f"{ok}/{n_tasks}",
+        "agents": n_agents,
+    }
+
+
+def _note(tag, payload):
+    """Section progress to stderr — a crash in a later section must not
+    lose the numbers already measured."""
+    print(f"[bench] {tag}: {json.dumps(payload)}", file=sys.stderr, flush=True)
 
 
 async def run_bench():
@@ -120,14 +276,17 @@ async def run_bench():
         provider="tpu" if on_accel else "cpu",
         engine_max_seq=512,
         dtype="bfloat16" if on_accel else "float32",
-        # Swept on v5e round 2 (chunk ∈ {8,12,16,24} × {bf16,int8}): int8
-        # + chunk 12 won; speculation (round 3) rides the same chunking.
-        engine_chunk=12,
         quantize="int8" if on_accel else None,
-        # n-gram verify-blocks: decode is weight-stream-bound, accepted
-        # drafts are ~free tokens (engine/decode.py:decode_chunk_spec).
-        engine_speculate=4,
     )
+
+    async def _section(tag, coro):
+        try:
+            sec = await coro
+            _note(tag, sec)
+            return sec
+        except Exception as exc:  # noqa: BLE001 — keep earlier sections
+            _note(f"{tag} FAILED", {"error": str(exc)})
+            return None
 
     # Section 1: 1B throughput model (byte vocab: runs without a
     # checkpoint download in the zero-egress environment).
@@ -135,29 +294,71 @@ async def run_bench():
         LLMConfig(
             model_name="llama3-1b-byte" if on_accel else "llama-tiny",
             engine_slots=32,
-            # One fused admission per 32-slot wave + chunk 14 so a wave's
-            # 48 tokens fit one dispatch (swept on v5e round 3:
-            # p50 403 -> ~207 ms vs round 2).
+            # One fused admission per 32-slot wave; early-exit chunks
+            # make a generous width free (decode stops at all-done).
             engine_admit_batch=32,
-            **{**common, "engine_chunk": 14},
+            engine_chunk=16,
+            engine_speculate=4,
+            **common,
         ),
         concurrency=32, steps=96, epochs=3, n_chips=n_chips,
     )
+    _note("1b", sec_1b)
 
-    # Section 2: the north-star model. int8 8B params stream at ~8 GB per
-    # token-pass; speculation is what breaks the one-token-per-pass
-    # bandwidth floor (VERDICT r2 Weak #2).
+    # Section 2: the north-star model over COLD prompts. D=6 verify
+    # blocks won the round-4 sweep (D 4/6/8 x chunk): acceptance ~3.7
+    # caps tokens/pass, early exit stops the chunk at all-done.
     sec_8b = None
+    sec_8b_long = None
     if on_accel:
-        sec_8b = await bench_model(
+        sec_8b = await _section("8b", bench_model(
             LLMConfig(
-                # chunk 14 x acceptance ~3.75 covers the whole 48-token
-                # step in ONE dispatch (swept 12/14/16 on v5e round 3).
                 model_name="llama3-8b-byte", engine_slots=8,
-                **{**common, "engine_chunk": 14},
+                engine_chunk=16, engine_speculate=6,
+                engine_draft_layers=2,
+                **common,
             ),
             concurrency=8, steps=32, epochs=2, n_chips=n_chips,
-        )
+        ))
+
+        # Section 3: long-context serving — the paged pool with every
+        # fast path composed (VERDICT r3 next-step 1 done-criterion:
+        # p50 within ~1.3x of the dense section).
+        sec_8b_long = await _section("8b-long", bench_model(
+            LLMConfig(
+                model_name="llama3-8b-byte", engine_slots=8,
+                engine_chunk=16, engine_speculate=6,
+                engine_draft_layers=2,
+                **{**common, "engine_max_seq": 4096},
+                # Page 64: the block-prefix tail a cold prompt must
+                # prefill is uniform(0, P) — page 128 measured ~80 ms
+                # slower p50 at 4K than 64 (round-4 A/B).
+                engine_paged_kv=True, engine_page_size=64,
+                engine_kv_quantize="int8",
+            ),
+            concurrency=8, steps=24, epochs=2, n_chips=n_chips,
+            pad_to=1200,  # ~1.2K-char shared preamble + unique tails
+        ))
+        if sec_8b_long is not None:
+            sec_8b_long["model"] = "llama3-8b-byte@4k-paged"
+
+    # Sections 4-5: orchestrator-level numbers (VERDICT r3 next-step 6).
+    provider = "tpu" if on_accel else "mock"
+    try:
+        sec_pipeline = await bench_pipeline(provider=provider)
+        _note("pipeline", sec_pipeline)
+    except Exception as exc:  # noqa: BLE001 — keep earlier sections
+        _note("pipeline FAILED", {"error": str(exc)})
+        sec_pipeline = {"pipeline_p50_ms": None, "pipeline_error": str(exc)}
+    sec_swarm = None
+    if on_accel:
+        try:
+            sec_swarm = await bench_swarm("llama3-1b-byte", "tpu")
+            _note("swarm", sec_swarm)
+        except Exception as exc:  # noqa: BLE001 — keep earlier sections
+            _note("swarm FAILED", {"error": str(exc)})
+            sec_swarm = {"swarm_steps_per_sec": None,
+                         "swarm_error": str(exc)}
 
     headline = sec_8b or sec_1b
     out = {
@@ -168,10 +369,18 @@ async def run_bench():
         "vs_baseline": round(TARGET_P50_MS / headline["p50_step_ms"], 3),
         "p50_step_ms": sec_1b["p50_step_ms"],
         "p50_step_ms_8b": sec_8b["p50_step_ms"] if sec_8b else None,
+        "p50_step_ms_8b_long": (
+            sec_8b_long["p50_step_ms"] if sec_8b_long else None
+        ),
+        **sec_pipeline,
+        **(sec_swarm or {}),
         "provider": "tpu" if on_accel else "cpu",
         "n_chips": n_chips,
-        "models": {sec_1b["model"]: sec_1b,
-                   **({sec_8b["model"]: sec_8b} if sec_8b else {})},
+        "models": {
+            sec_1b["model"]: sec_1b,
+            **({sec_8b["model"]: sec_8b} if sec_8b else {}),
+            **({sec_8b_long["model"]: sec_8b_long} if sec_8b_long else {}),
+        },
     }
     print(json.dumps(out))
 
