@@ -163,6 +163,12 @@ async def bench_pipeline(provider: str, rounds: int = 4):
     )
 
     serve, _memory = build_pipeline(provider=provider)
+    # A random-weight model never emits the step-loop's task_complete
+    # signal, so default max_iterations (reference parity: 20) would
+    # turn every stage into 20 LLM calls and measure the cap, not the
+    # orchestrator. Two iterations is the realistic simple-task shape.
+    for a in serve.agents.values():
+        a.config.max_iterations = 2
     await serve.start()
     try:
         waves = []
@@ -212,7 +218,10 @@ async def bench_swarm(model: str, provider: str, n_agents: int = 32,
 
     llm = LLMHandler(LLMConfig(
         model_name=model, provider=provider,
-        engine_slots=n_agents, engine_admit_batch=n_agents,
+        # Swarm traffic trickles in (each task's calls are sequential),
+        # so admission groups stay small — admit_batch at n_agents would
+        # pad every 1-4 arrivals to 32 prefill rows.
+        engine_slots=n_agents, engine_admit_batch=8,
         engine_max_seq=512, engine_chunk=16,
         dtype="bfloat16" if provider == "tpu" else "float32",
         quantize="int8" if provider == "tpu" else None,
@@ -220,7 +229,10 @@ async def bench_swarm(model: str, provider: str, n_agents: int = 32,
     ))
     agents = [
         BaseAgent(
-            config=AgentConfig(role=f"worker{i}", specializations=["generic"]),
+            config=AgentConfig(
+                role=f"worker{i}", specializations=["generic"],
+                max_iterations=2,  # see bench_pipeline's note
+            ),
             llm=llm,
         )
         for i in range(n_agents)
@@ -277,6 +289,10 @@ async def run_bench():
         engine_max_seq=512,
         dtype="bfloat16" if on_accel else "float32",
         quantize="int8" if on_accel else None,
+        # First-wave compiles through the tunnel can exceed the default
+        # 120 s; a timeout there cancels and RE-SUBMITS the whole wave
+        # (measured as minutes of cascading retries in the 4K section).
+        timeout=600.0,
     )
 
     async def _section(tag, coro):
@@ -328,7 +344,6 @@ async def run_bench():
             LLMConfig(
                 model_name="llama3-8b-byte", engine_slots=8,
                 engine_chunk=16, engine_speculate=6,
-                engine_draft_layers=2,
                 **{**common, "engine_max_seq": 4096},
                 # Page 64: the block-prefix tail a cold prompt must
                 # prefill is uniform(0, P) — page 128 measured ~80 ms
