@@ -313,7 +313,11 @@ async def run_bench():
             # One fused admission per 32-slot wave; early-exit chunks
             # make a generous width free (decode stops at all-done).
             engine_admit_batch=32,
-            engine_chunk=16,
+            # Early-exit makes a generous chunk free: 24 blocks covers
+            # the slowest slot's 48 tokens in one dispatch even at the
+            # straggler's acceptance (round-4 A/B: beat chunk 12/16 at
+            # both D=4 and D=6).
+            engine_chunk=24,
             engine_speculate=4,
             **common,
         ),
