@@ -431,10 +431,12 @@ def decode_chunk(
 # draft costs speed, never correctness.
 #
 # Scope: greedy (temperature==0) slots speculate; sampled slots emit one
-# exact-semantics token per block (their PRNG stream advances once per
-# block rather than once per token, so sampled outputs differ from the
-# non-speculative engine; greedy outputs are bit-identical). Dense KV
-# only — the paged path keeps the plain chunk.
+# exact-semantics token per block. Sampled streams are ALSO
+# bit-identical to the non-speculative engine: a block advances the
+# PRNG exactly once (row 0's sample_core) and emits exactly one sampled
+# token, so the key sequence at emission points matches the plain
+# chunk's step-per-token advance (pinned by
+# tests/test_speculative.py::test_spec_sampled_slots_bit_identical).
 
 
 def _ngram_drafts(
@@ -767,8 +769,9 @@ def decode_chunk_spec(
 
     Greedy slots emit ``accepted + 1`` tokens per weight pass —
     bit-identical to the non-speculative chunk's output. Sampled slots
-    emit exactly one sampled token per block (identical distribution;
-    different PRNG stream).
+    emit exactly one sampled token per block, ALSO bit-identical: one
+    PRNG advance per block == one advance per emitted token, matching
+    the plain chunk's key sequence at every emission position.
 
     Works on BOTH caches: dense panels are read through bounded slices;
     paged pools through the block table — the extended Pallas paged

@@ -182,6 +182,38 @@ def test_spec_sampled_slots_stay_exact():
         assert all(0 <= t < cfg.vocab_size for t in seqs[b])
 
 
+def test_spec_sampled_slots_bit_identical():
+    """Sampled (temperature > 0) slots are BIT-IDENTICAL between the
+    plain and speculative chunks, not merely same-distribution: a spec
+    block advances the PRNG once and emits one sampled token, so the key
+    sequence at emission points equals the plain chunk's
+    advance-per-step. (Round-3 docs claimed divergence — wrong.)"""
+    cfg = get_model_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    temps = [1.3, 0.7, 2.0]
+
+    c1, d1, s1, _, f1 = _admit(cfg, params, PROMPTS, [15] * 3, temps=temps)
+    plain = [[] for _ in range(4)]
+    for _ in range(4):
+        t, v, c1, d1, s1 = decode_chunk(
+            params, cfg, c1, d1, s1, 4, use_pallas=False
+        )
+        for b, seq in enumerate(_collect(t, v, 4)):
+            plain[b].extend(seq)
+
+    c2, d2, s2, h2, f2 = _admit(cfg, params, PROMPTS, [15] * 3, temps=temps)
+    np.testing.assert_array_equal(f1, f2)
+    spec = [[] for _ in range(4)]
+    for _ in range(5):
+        t, v, c2, d2, s2, h2 = decode_chunk_spec(
+            params, cfg, c2, d2, s2, h2, 4, 4
+        )
+        for b, seq in enumerate(_collect(t, v, 4)):
+            spec[b].extend(seq)
+    for b in range(3):
+        assert spec[b] == plain[b], f"sampled slot {b} diverged"
+
+
 @pytest.mark.asyncio
 async def test_engine_spec_e2e_parity_and_json():
     """Full engine: engine_speculate=4 produces byte-identical greedy
