@@ -68,18 +68,22 @@ def _dequant_pair(k, v, scales, dtype):
     return dequantize_kv(k, scales[0], dtype), dequantize_kv(v, scales[1], dtype)
 
 
-def _bounded_panels(cache, l: int, op, dtype):
-    """Layer ``l``'s prefix K/V in compute precision: ``op`` bounds the
+def _bounded_panels(cache, l: int, op):
+    """Layer ``l``'s prefix K/V as ``(k, v, scales)``: ``op`` bounds the
     read (a dense ``slice_in_dim`` or a paged ``gather_pages`` — both
-    accept the [.., P, H] panels AND the [.., P] scale pools), and int8
-    caches dequantize through the matching scales. The ONE place the
-    panel/scale pairing lives — decode_chunk, decode_chunk_spec and the
-    paged prefix admission all read through it."""
+    accept the [.., P, H] panels AND the [.., P] scale pools). int8
+    caches return the RAW int8 panels plus ``(k_scale, v_scale)``; the
+    attention applies scales AFTER its dot products
+    (``q·(k·s) == s·(q·k)``, exactly), so per-block panel HBM reads stay
+    int8-sized instead of a materialized full-precision copy. The ONE
+    place the panel/scale pairing lives — decode_chunk,
+    decode_chunk_spec and the paged prefix admission all read through
+    it."""
     k_, v_ = cache.layers[l]
     sc = None if cache.scales is None else (
         op(cache.scales[l][0]), op(cache.scales[l][1])
     )
-    return _dequant_pair(op(k_), op(v_), sc, dtype)
+    return op(k_), op(v_), sc
 
 
 def _layer_tail(cfg: ModelConfig, lp, x: jax.Array, attn: jax.Array) -> jax.Array:
@@ -145,21 +149,28 @@ def release_decode(state: DecodeState, slots: jax.Array) -> DecodeState:
 
 def _prefix_stats_dense(
     qg: jax.Array,       # [B, K, G, H]
-    layer_k: jax.Array,  # [B, K, S, H]
+    layer_k: jax.Array,  # [B, K, S, H] (compute dtype, or int8 w/ scales)
     layer_v: jax.Array,
     last: jax.Array,     # [B] max valid key index (may be -1: empty)
     qpos: jax.Array,     # [B] query absolute position
     scale: float,
     softcap: float,
     window: int,
+    kv_scales=None,      # (k_scale [B,K,S], v_scale) for int8 panels
 ):
     """XLA fallback for the Pallas prefix kernel (CPU tests / tiny models).
-    Same (acc, m, l) contract."""
+    Same (acc, m, l) contract. int8 panels stream raw through the dots;
+    the per-position scales fold in after (before softcap), which is
+    algebraically exact and keeps HBM reads int8-sized."""
     B, K, G, H = qg.shape
     S = layer_k.shape[2]
+    if kv_scales is not None:
+        layer_k = layer_k.astype(qg.dtype)
     s = jnp.einsum(
         "bkgh,bksh->bkgs", qg, layer_k, preferred_element_type=jnp.float32
     ) * scale
+    if kv_scales is not None:
+        s = s * kv_scales[0][:, :, None, :]
     if softcap > 0.0:
         s = jnp.tanh(s / softcap) * softcap
     col = jnp.arange(S)[None, None, None, :]
@@ -172,10 +183,18 @@ def _prefix_stats_dense(
         m[..., None] > NEG_INF / 2, jnp.exp(s - m[..., None]), 0.0
     )
     l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum(
-        "bkgs,bksh->bkgh", p.astype(layer_v.dtype), layer_v,
-        preferred_element_type=jnp.float32,
-    )
+    if kv_scales is not None:
+        p = p * kv_scales[1][:, :, None, :]
+        layer_v = layer_v.astype(qg.dtype)
+        acc = jnp.einsum(
+            "bkgs,bksh->bkgh", p.astype(qg.dtype), layer_v,
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        acc = jnp.einsum(
+            "bkgs,bksh->bkgh", p.astype(layer_v.dtype), layer_v,
+            preferred_element_type=jnp.float32,
+        )
     return acc.reshape(B, K * G, H), m.reshape(B, K * G), l.reshape(B, K * G)
 
 
@@ -264,30 +283,32 @@ def decode_chunk(
         Sb = S if prefix_bound is None else max(1, min(prefix_bound, S))
         n_blocks = -(-Sb // P)
         if use_pallas:
-            prefix_panels = cache.layers     # pools; kernel reads via table
+            prefix_panels = tuple(
+                (k_, v_, None) for (k_, v_) in cache.layers
+            )                                # pools; kernel reads via table
             kv_scales = cache.scales         # int8 pools dequant in-kernel
         else:
-            # XLA fallback: materialize bounded dense panels ONCE per
-            # chunk (pool contents are frozen during the scan — decode
-            # K/V goes to the ring until chunk end), then run the same
-            # dense prefix attention as the unpaged path.
+            # XLA fallback: materialize bounded panels ONCE per chunk
+            # (pool contents are frozen during the scan — decode K/V
+            # goes to the ring until chunk end), then run the same
+            # dense prefix attention as the unpaged path; int8 panels
+            # gather raw with their scales (applied post-dot).
             prefix_panels = tuple(
                 _bounded_panels(
                     cache, l, lambda a: gather_pages(a, table, n_blocks),
-                    cfg.dtype,
                 )
                 for l in range(cfg.n_layers)
             )
     else:
         S = cache.max_len
         Sb = S if prefix_bound is None else max(1, min(prefix_bound, S))
-        # Bounded read-only views for the prefix attention (writes at chunk
-        # end still land in the full panels; the int8 dequant multiply
-        # fuses into the attention contraction, so HBM reads stay small).
+        # Bounded read-only views for the prefix attention (writes at
+        # chunk end still land in the full panels); int8 panels slice
+        # raw with their scales — applied after the dots, so per-step
+        # HBM reads stay int8-sized.
         prefix_panels = tuple(
             _bounded_panels(
                 cache, l, lambda a: jax.lax.slice_in_dim(a, 0, Sb, axis=2),
-                cfg.dtype,
             )
             for l in range(cfg.n_layers)
         )
@@ -318,7 +339,7 @@ def decode_chunk(
         for l in range(cfg.n_layers):
             lp = jax.tree.map(lambda a: a[l], params["layers"])
             window = int(windows[l])
-            layer_k, layer_v = prefix_panels[l]
+            layer_k, layer_v, layer_sc = prefix_panels[l]
             rk, rv = rings[l]
             p = lp["attn"]
 
@@ -352,6 +373,7 @@ def decode_chunk(
                     qf.reshape(B, cfg.n_kv_heads, G, cfg.head_dim),
                     layer_k, layer_v, prefix_last, pos,
                     qscale, cfg.attn_softcap, window,
+                    kv_scales=layer_sc,
                 )
             acc_c, m_c, l_c = _ring_stats(
                 qf.reshape(B, cfg.n_kv_heads, G, cfg.head_dim),
@@ -554,6 +576,7 @@ def _model_drafts(
                 acc_p, m_p, l_p = _prefix_stats_dense(
                     qg, prefix_panels[l][0], prefix_panels[l][1],
                     last, qpos, qscale, cfg.attn_softcap, window,
+                    kv_scales=prefix_panels[l][2],
                 )
                 acc_p = acc_p.reshape(B, K, G, H)
                 m_p = m_p.reshape(B, K, G)
@@ -653,6 +676,7 @@ def _spec_block_attn(
     prefix_stats: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
     # ^ precomputed (acc_p [B,K,G,D,H], m_p [B,K,G,D], l_p) — the Pallas
     # paged kernel's output; skips the dense prefix pass.
+    kv_scales=None,      # (k_scale [B,K,S], v_scale) for int8 panels
 ) -> jax.Array:
     """Three-source attention for a speculative block: bounded prefix
     panels + in-chunk ring (per-slot valid count) + the block itself
@@ -669,11 +693,16 @@ def _spec_block_attn(
     if prefix_stats is not None:
         acc_p, m_p, l_p = prefix_stats
     else:
-        # Prefix: every block query sees the whole valid prefix.
-        s = softcapped(jnp.einsum(
-            "bkgdh,bksh->bkgds", qg, layer_k,
+        # Prefix: every block query sees the whole valid prefix. int8
+        # panels stream raw; scales fold in after the dots (exact).
+        lk = layer_k.astype(qg.dtype) if kv_scales is not None else layer_k
+        s = jnp.einsum(
+            "bkgdh,bksh->bkgds", qg, lk,
             preferred_element_type=jnp.float32,
-        ) * scale)
+        ) * scale
+        if kv_scales is not None:
+            s = s * kv_scales[0][:, :, None, None, :]
+        s = softcapped(s)
         col = jnp.arange(layer_k.shape[2])[None, None, None, None, :]
         mask = col <= last[:, None, None, None, None]
         if window > 0:
@@ -684,8 +713,14 @@ def _spec_block_attn(
             m_p[..., None] > NEG_INF / 2, jnp.exp(s - m_p[..., None]), 0.0
         )
         l_p = jnp.sum(p, axis=-1)
+        if kv_scales is not None:
+            p = p * kv_scales[1][:, :, None, None, :]
+            lv = layer_v.astype(qg.dtype)
+        else:
+            lv = layer_v
         acc_p = jnp.einsum(
-            "bkgds,bksh->bkgdh", p.astype(layer_v.dtype), layer_v,
+            "bkgds,bksh->bkgdh", p.astype(qg.dtype if kv_scales is not None
+                                          else layer_v.dtype), lv,
             preferred_element_type=jnp.float32,
         )
 
@@ -792,13 +827,14 @@ def decode_chunk_spec(
         Sb = S if prefix_bound is None else max(1, min(prefix_bound, S))
         n_blocks = -(-Sb // P)
         if use_pallas:
-            prefix_panels = cache.layers     # pools; kernel reads via table
+            prefix_panels = tuple(
+                (k_, v_, None) for (k_, v_) in cache.layers
+            )                                # pools; kernel reads via table
             kv_scales = cache.scales
         else:
             prefix_panels = tuple(
                 _bounded_panels(
                     cache, l, lambda a: gather_pages(a, table, n_blocks),
-                    cfg.dtype,
                 )
                 for l in range(cfg.n_layers)
             )
@@ -808,7 +844,6 @@ def decode_chunk_spec(
         prefix_panels = tuple(
             _bounded_panels(
                 cache, l, lambda a: jax.lax.slice_in_dim(a, 0, Sb, axis=2),
-                cfg.dtype,
             )
             for l in range(cfg.n_layers)
         )
@@ -871,7 +906,7 @@ def decode_chunk_spec(
         for l in range(cfg.n_layers):
             lp = jax.tree.map(lambda a: a[l], params["layers"])
             window = int(windows[l])
-            layer_k, layer_v = prefix_panels[l]
+            layer_k, layer_v, layer_sc = prefix_panels[l]
             rk, rv = rings[l]
             p = lp["attn"]
 
@@ -911,6 +946,7 @@ def decode_chunk_spec(
                     qg, layer_k, layer_v, rk, rv, blk_k, blk_v,
                     prefix_last, start, offset, pvec,
                     qscale, cfg.attn_softcap, window,
+                    kv_scales=layer_sc,
                 )
             x = _layer_tail(
                 cfg, lp, x,
@@ -1366,10 +1402,10 @@ def admit_group_prefix_paged(
         # Works for [K, pages, P, H] pools and [K, pages, P] scale pools.
         return a[:, prefix_pages].reshape((K, Pb) + a.shape[3:])
 
-    panels = [
-        _bounded_panels(cache, l, _chain_gather, cfg.dtype)
-        for l in range(cfg.n_layers)
-    ]
+    panels = []
+    for l in range(cfg.n_layers):
+        k_, v_, sc = _bounded_panels(cache, l, _chain_gather)
+        panels.append(_dequant_pair(k_, v_, sc, cfg.dtype))
     pks = jnp.stack([p[0] for p in panels])
     pvs = jnp.stack([p[1] for p in panels])
     cache_dtype = (
