@@ -11,8 +11,9 @@ first-class citizens of routing, fault tolerance and retry.
 
 from pilottai_tpu.distributed.control_plane import (
     AgentWorker,
+    FrameAuth,
     RemoteAgent,
     ServeEndpoint,
 )
 
-__all__ = ["AgentWorker", "RemoteAgent", "ServeEndpoint"]
+__all__ = ["AgentWorker", "FrameAuth", "RemoteAgent", "ServeEndpoint"]
