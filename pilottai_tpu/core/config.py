@@ -108,6 +108,13 @@ class LLMConfig(BaseModel):
     engine_admit_batch: int = Field(default=8, ge=1)
     engine_max_seq: Optional[int] = None             # KV length cap (default model max)
     engine_chunk: int = Field(default=16, ge=1)      # decode tokens per dispatch
+    # Decode dispatch pipeline depth: chunks in flight before the device
+    # thread blocks on the reader. Each extra level hides one
+    # host↔device round trip behind compute — the lever when the chip
+    # sits behind a high-latency tunnel; early-exit chunks keep
+    # over-dispatched levels nearly free (a chunk whose slots are all
+    # done retires without running a weight pass).
+    engine_pipeline: int = Field(default=2, ge=1)
     # Paged KV cache (ops/paged.py): None = auto (paged when the per-slot
     # capacity is ≥ 4096 — that is where dense slots × max_seq reservation
     # stops fitting HBM). Pool size in pages; None = the HBM a dense

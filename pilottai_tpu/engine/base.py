@@ -35,6 +35,21 @@ class LLMBackend(abc.ABC):
     ) -> LLMResponse:
         """Run one chat generation."""
 
+    async def generate_stream(
+        self,
+        messages: Sequence[ChatMessage],
+        tools: Optional[Sequence[ToolSpec]] = None,
+        params: Optional[GenerationParams] = None,
+    ):
+        """Async generator of text deltas; concatenation equals the
+        ``generate()`` content for the same request. Default adapter:
+        one delta with the whole completion — backends with true
+        incremental output (the native engine streams per fused decode
+        chunk) override."""
+        response = await self.generate(messages, tools, params)
+        if response.content:
+            yield response.content
+
     async def start(self) -> None:  # noqa: B027 - optional lifecycle hook
         """Bring up device resources (compile, load weights)."""
 

@@ -81,6 +81,12 @@ class GenRequest:
     # Set by the caller (any thread) to abandon the request; the device loop
     # frees its slot at the next chunk boundary instead of decoding dead work.
     cancelled: bool = False
+    # Streaming: called from the READER thread with each batch of newly
+    # folded output tokens (eos/stop ids already filtered — exactly the
+    # ids the future's final result will contain, in order). Must be
+    # cheap and non-blocking (bridge to asyncio via
+    # ``loop.call_soon_threadsafe``); exceptions are swallowed.
+    on_tokens: Optional[Any] = None
 
 
 @dataclass
@@ -108,8 +114,6 @@ class _Slot:
 class ContinuousBatcher:
     """Slot-based continuous batching over jitted prefill / fused-decode."""
 
-    PIPELINE_DEPTH = 2
-
     def __init__(
         self,
         cfg: ModelConfig,
@@ -131,10 +135,12 @@ class ContinuousBatcher:
         prefix_cache: int = 4,  # mirrors LLMConfig.engine_prefix_cache
         kv_quantize: bool = False,  # int8 cache panels + per-token scales
         draft_layers: int = 0,  # shallow-layer self-drafting (adaptive)
+        pipeline_depth: int = 2,  # decode chunks in flight (tunnel hiding)
     ) -> None:
         self.cfg = cfg
         self.params = params
         self.n_slots = n_slots
+        self.PIPELINE_DEPTH = max(1, pipeline_depth)
         self.max_seq_len = min(max_seq_len or cfg.max_seq_len, cfg.max_seq_len)
         self.min_bucket = min_bucket
         self.chunk_size = chunk_size
@@ -857,10 +863,13 @@ class ContinuousBatcher:
                 self._log.warning("prefix export failed: %s", exc)
                 return
 
-    def _fold_first_tokens(self, groups, hosts: List[np.ndarray]) -> None:
+    def _fold_first_tokens(self, groups, hosts: List[np.ndarray]) -> List:
         """Fold prefill-sampled first tokens into their slots (lock held).
         Entries carry the admission generation, so a stale entry from a
-        failed/aborted generation can never feed the slot's next occupant."""
+        failed/aborted generation can never feed the slot's next occupant.
+        Returns ``(on_tokens, ids)`` stream emissions for the caller to
+        fire AFTER releasing the lock."""
+        emits: List = []
         for (rows, _), host in zip(groups, hosts):
             host = np.asarray(host)
             for row, (idx, gen) in enumerate(rows):
@@ -868,8 +877,16 @@ class ContinuousBatcher:
                 if slot is None or not slot.first_pending or gen != self._gen[idx]:
                     continue
                 slot.first_pending = False
-                slot.generated.append(int(host[row]))
+                tok = int(host[row])
+                slot.generated.append(tok)
+                req = slot.request
+                if (
+                    req.on_tokens is not None
+                    and tok != req.eos_id and tok not in req.stop_ids
+                ):
+                    emits.append((req.on_tokens, [tok]))
                 self._check_finished(idx)
+        return emits
 
     def _drain_first_reads(self) -> None:
         """Reader thread ONLY: fold pending first tokens outside a chunk
@@ -886,7 +903,8 @@ class ContinuousBatcher:
             return
         hosts = jax.device_get([f for _, f in groups])
         with self._lock:
-            self._fold_first_tokens(groups, hosts)
+            emits = self._fold_first_tokens(groups, hosts)
+        self._fire_stream(emits)
 
     def _check_finished(self, idx: int) -> None:
         """Apply host-side completion rules to a slot; complete + free it
@@ -1025,11 +1043,12 @@ class ContinuousBatcher:
             blk3 = valid_h.reshape(self.chunk_size, D, B)
             slot_blocks = blk3.any(axis=1).sum(axis=0)       # [B]
             slot_tokens = valid_h.sum(axis=0)
+        emits: List = []
         with self._lock:
             # First tokens were sampled before this chunk ran — fold them
             # first so token order inside each slot is right.
             if groups:
-                self._fold_first_tokens(groups, fetched[2:])
+                emits = self._fold_first_tokens(groups, fetched[2:])
             for b in range(B):
                 slot = self._slots[b]
                 if slot is None or gen_stamp[b] != self._gen[b]:
@@ -1060,13 +1079,21 @@ class ContinuousBatcher:
                 slot.hi_pending = max(0, slot.hi_pending - hi)
                 if slot.first_pending:
                     continue
+                req = slot.request
+                fresh: List[int] = []
                 for i in range(n):
                     if not valid_h[i, b]:
                         continue
-                    slot.generated.append(int(toks_h[i, b]))
+                    tok = int(toks_h[i, b])
+                    slot.generated.append(tok)
+                    if tok != req.eos_id and tok not in req.stop_ids:
+                        fresh.append(tok)
                     self._check_finished(b)
                     if self._slots[b] is None:
                         break
+                if fresh and req.on_tokens is not None:
+                    emits.append((req.on_tokens, fresh))
+        self._fire_stream(emits)
         if self.speculate:
             # Observed tokens-per-block over blocks that actually emitted
             # (done-slot and trailing no-op blocks excluded — counting
@@ -1080,6 +1107,16 @@ class ContinuousBatcher:
                 obs = min(max(obs, 0.5), float(D))
                 self._spec_rate = 0.5 * self._spec_rate + 0.5 * obs
         global_metrics.inc("engine.generated_tokens_device", int(valid_h.sum()))
+
+    def _fire_stream(self, emits: List) -> None:
+        """Fire streaming callbacks OUTSIDE the slot lock (reader thread).
+        A callback is user code bridging into an event loop; holding the
+        lock across it would let a slow consumer stall folding."""
+        for cb, ids in emits:
+            try:
+                cb(ids)
+            except Exception as exc:  # noqa: BLE001 — consumer's problem
+                self._log.warning("stream callback failed: %s", exc)
 
     def _read_loop(self) -> None:
         """Reader thread: blockingly reads dispatched chunks and resolves

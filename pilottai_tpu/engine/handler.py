@@ -119,19 +119,16 @@ class LLMHandler:
 
     # ------------------------------------------------------------------ #
 
-    async def generate_response(
+    def _normalize(
         self,
         messages: Sequence[ChatMessage | Dict[str, Any] | str],
-        tools: Optional[Sequence[ToolSpec | Dict[str, Any]]] = None,
-        params: Optional[GenerationParams] = None,
-        json_mode: Optional[bool] = None,
-    ) -> LLMResponse:
-        """Chat completion with retry/backoff (reference ``llm.py:38-66``).
-
-        ``json_mode`` overrides the config/params flag — protocol call
-        sites (rules.yaml prompts demand strict JSON) set it True to get
-        grammar-constrained decoding on byte-tokenizer engines.
-        """
+        tools: Optional[Sequence[ToolSpec | Dict[str, Any]]],
+        params: Optional[GenerationParams],
+        json_mode: Optional[bool],
+    ):
+        """One request-normalization path for the streaming AND
+        non-streaming calls — the two must never drift in default-params
+        or json_mode semantics."""
         msgs = [ChatMessage.coerce(m) for m in messages]
         specs = [
             t if isinstance(t, ToolSpec) else ToolSpec(**t) for t in (tools or [])
@@ -148,6 +145,22 @@ class LLMHandler:
             )
         if json_mode is not None and json_mode != params.json_mode:
             params = params.model_copy(update={"json_mode": json_mode})
+        return msgs, specs, params
+
+    async def generate_response(
+        self,
+        messages: Sequence[ChatMessage | Dict[str, Any] | str],
+        tools: Optional[Sequence[ToolSpec | Dict[str, Any]]] = None,
+        params: Optional[GenerationParams] = None,
+        json_mode: Optional[bool] = None,
+    ) -> LLMResponse:
+        """Chat completion with retry/backoff (reference ``llm.py:38-66``).
+
+        ``json_mode`` overrides the config/params flag — protocol call
+        sites (rules.yaml prompts demand strict JSON) set it True to get
+        grammar-constrained decoding on byte-tokenizer engines.
+        """
+        msgs, specs, params = self._normalize(messages, tools, params, json_mode)
 
         last_error: Optional[Exception] = None
         for attempt in range(self.config.retries + 1):
@@ -188,6 +201,69 @@ class LLMHandler:
         raise RuntimeError(
             f"LLM generation failed after {self.config.retries + 1} attempts"
         ) from last_error
+
+    async def astream(
+        self,
+        messages: Sequence[ChatMessage | Dict[str, Any] | str] | str,
+        tools: Optional[Sequence[ToolSpec | Dict[str, Any]]] = None,
+        params: Optional[GenerationParams] = None,
+        json_mode: Optional[bool] = None,
+    ):
+        """Streaming chat completion: an async generator of text deltas
+        whose concatenation equals ``generate_response(...).content`` for
+        the same request. No retry once tokens flow (a consumer has
+        already observed partial output — silently replaying from a
+        fresh sample would splice two generations); errors surface to
+        the consumer instead. ``config.timeout`` applies as an
+        INACTIVITY timeout — the longest wait for the next delta, not a
+        bound on the whole stream (a healthy stream of any length never
+        trips it; a wedged engine does, instead of pinning the
+        concurrency semaphore forever). The rpm limiter and semaphore
+        apply for the stream's whole lifetime."""
+        if isinstance(messages, str):
+            messages = [messages]
+        msgs, specs, params = self._normalize(messages, tools, params, json_mode)
+
+        if self._limiter:
+            await self._limiter.acquire()
+        async with self._semaphore:
+            with global_tracer.span(
+                "engine.generate_stream", model=self.config.model_name
+            ):
+                start = time.perf_counter()
+                n_chars = 0
+                agen = self.backend.generate_stream(
+                    msgs, specs or None, params
+                ).__aiter__()
+                failed = True  # timeout/backend error until proven otherwise
+                try:
+                    while True:
+                        try:
+                            delta = await asyncio.wait_for(
+                                agen.__anext__(), timeout=self.config.timeout
+                            )
+                        except StopAsyncIteration:
+                            break
+                        n_chars += len(delta)
+                        yield delta
+                    failed = False
+                except GeneratorExit:
+                    failed = False  # consumer chose to stop — not an error
+                    raise
+                finally:
+                    # Consumer break / timeout / error: close the backend
+                    # generator so its request is cancelled and the slot
+                    # freed (native engines cancel in their finally).
+                    await agen.aclose()
+                    # Metrics land on EVERY outcome (generate_response
+                    # parity: errors are counted, requests never vanish).
+                    global_metrics.observe(
+                        "engine.request_latency", time.perf_counter() - start
+                    )
+                    global_metrics.inc("engine.requests")
+                    global_metrics.inc("engine.stream_chars", n_chars)
+                    if failed:
+                        global_metrics.inc("engine.errors")
 
     async def apredict(self, prompt: str, **kwargs: Any) -> str:
         """Plain string-in/string-out (reference ``llm.py:181-199``)."""

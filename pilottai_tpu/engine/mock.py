@@ -102,6 +102,25 @@ class MockBackend(LLMBackend):
             latency=time.perf_counter() - start,
         )
 
+    async def generate_stream(
+        self,
+        messages: Sequence[ChatMessage],
+        tools: Optional[Sequence[ToolSpec]] = None,
+        params: Optional[GenerationParams] = None,
+    ):
+        """Word-granular streaming (whitespace kept on the leading word)
+        so consumer tests see real multi-delta behavior."""
+        response = await self.generate(messages, tools, params)
+        content = response.content
+        pos = 0
+        while pos < len(content):
+            nxt = content.find(" ", pos + 1)
+            nxt = len(content) if nxt < 0 else nxt
+            yield content[pos:nxt]
+            pos = nxt
+            if self.latency:
+                await asyncio.sleep(self.latency / max(len(content), 1))
+
     # ------------------------------------------------------------------ #
     # Protocol detection — keyed on the JSON contract fields each
     # rules.yaml template demands (pilottai_tpu/prompts/rules.yaml).

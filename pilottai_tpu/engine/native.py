@@ -19,7 +19,11 @@ import jax.numpy as jnp
 from pilottai_tpu.core.config import LLMConfig
 from pilottai_tpu.engine.base import LLMBackend, parse_tool_calls, render_chat
 from pilottai_tpu.engine.batcher import ContinuousBatcher, GenRequest
-from pilottai_tpu.engine.tokenizer import ByteTokenizer, load_tokenizer
+from pilottai_tpu.engine.tokenizer import (
+    ByteTokenizer,
+    IncrementalDecoder,
+    load_tokenizer,
+)
 from pilottai_tpu.engine.types import (
     ChatMessage,
     GenerationParams,
@@ -209,6 +213,7 @@ class NativeEngine(LLMBackend):
             prefix_cache=self.config.engine_prefix_cache,
             kv_quantize=self.config.engine_kv_quantize == "int8",
             draft_layers=self.config.engine_draft_layers,
+            pipeline_depth=self.config.engine_pipeline,
         )
         self.batcher.start()
         self.batcher.warmup()
@@ -222,18 +227,12 @@ class NativeEngine(LLMBackend):
 
     # ------------------------------------------------------------------ #
 
-    async def generate(
+    def _build_request(
         self,
         messages: Sequence[ChatMessage],
-        tools: Optional[Sequence[ToolSpec]] = None,
-        params: Optional[GenerationParams] = None,
-    ) -> LLMResponse:
-        if self.batcher is None:
-            await self.start()
-        assert self.batcher is not None
-        params = params or GenerationParams()
-        start = time.perf_counter()
-
+        tools: Optional[Sequence[ToolSpec]],
+        params: GenerationParams,
+    ) -> GenRequest:
         prompt = render_chat(messages)
         if tools:
             tool_desc = "\n".join(f"- {t.name}: {t.description}" for t in tools)
@@ -245,8 +244,7 @@ class NativeEngine(LLMBackend):
                 f"{prompt}"
             )
         prompt_ids = self.tokenizer.encode(prompt)
-
-        request = GenRequest(
+        return GenRequest(
             prompt_ids=prompt_ids,
             max_new_tokens=params.max_new_tokens,
             temperature=params.temperature,
@@ -262,6 +260,21 @@ class NativeEngine(LLMBackend):
                 or self._json_tables is not None
             ),
         )
+
+    async def generate(
+        self,
+        messages: Sequence[ChatMessage],
+        tools: Optional[Sequence[ToolSpec]] = None,
+        params: Optional[GenerationParams] = None,
+    ) -> LLMResponse:
+        if self.batcher is None:
+            await self.start()
+        assert self.batcher is not None
+        params = params or GenerationParams()
+        start = time.perf_counter()
+
+        request = self._build_request(messages, tools, params)
+        prompt_ids = request.prompt_ids
         future = self.batcher.submit(request)
         try:
             token_ids = await _to_asyncio_future(future)
@@ -291,6 +304,108 @@ class NativeEngine(LLMBackend):
             latency=time.perf_counter() - start,
             finish_reason="stop" if len(token_ids) < params.max_new_tokens else "length",
         )
+
+    async def generate_stream(
+        self,
+        messages: Sequence[ChatMessage],
+        tools: Optional[Sequence[ToolSpec]] = None,
+        params: Optional[GenerationParams] = None,
+    ):
+        """Async generator of text deltas: tokens surface as each fused
+        decode chunk folds on the host (every ``engine_chunk`` device
+        steps — streaming granularity IS the chunk, the latency/dispatch
+        trade the engine already makes), detokenized incrementally. The
+        concatenated deltas equal ``generate()``'s content for the same
+        request (same slot path, same sampler); stop-string truncation
+        included. Exiting the generator early cancels the request — the
+        device loop frees its slot at the next chunk boundary."""
+        if self.batcher is None:
+            await self.start()
+        assert self.batcher is not None
+        params = params or GenerationParams()
+        request = self._build_request(messages, tools, params)
+
+        loop = asyncio.get_running_loop()
+        q: "asyncio.Queue[Optional[list]]" = asyncio.Queue()
+        request.on_tokens = lambda ids: loop.call_soon_threadsafe(
+            q.put_nowait, list(ids)
+        )
+        future = self.batcher.submit(request)
+        afut = _to_asyncio_future(future)
+        # Wake the drain loop when generation ends (the final fold may
+        # emit nothing, e.g. a lone EOS).
+        afut.add_done_callback(lambda _f: q.put_nowait(None))
+
+        decoder = IncrementalDecoder(self.tokenizer)
+        # Stop strings can span delta boundaries: hold back the longest
+        # stop's len-1 tail until the stream ends.
+        holdback = max((len(s) for s in params.stop), default=0)
+        emitted = 0  # chars of decoder.text already yielded
+        n_seen = 0   # token ids already pushed into the decoder
+
+        try:
+            stopped = False
+            while True:
+                item = await q.get()
+                final = item is None and afut.done()
+                if item:
+                    n_seen += len(item)
+                    decoder.push(item)
+                if final:
+                    # The done sentinel can BEAT the last token batch into
+                    # this queue: the batcher resolves the future inside
+                    # its fold lock but fires ``on_tokens`` after
+                    # releasing it, and the event loop may run the
+                    # done-callback in the gap (observed on the real-TPU
+                    # path). The future's result is the authoritative
+                    # stream content (same ids, same filtering), so
+                    # reconcile against it instead of trusting arrival
+                    # order.
+                    if not afut.cancelled() and afut.exception() is None:
+                        ids = afut.result()
+                        if n_seen < len(ids):
+                            decoder.push(ids[n_seen:])
+                            n_seen = len(ids)
+                    decoder.flush()
+                text = decoder.text
+                # generate()'s one-pass list-order truncation loop is
+                # equivalent to cutting at the EARLIEST occurrence of any
+                # stop (each find runs on already-truncated text, so only
+                # ever-earlier positions apply). Streamed text can
+                # discover occurrences out of start-position order — a
+                # longer stop may complete later yet start earlier — but
+                # any occurrence not yet complete must start within the
+                # last ``holdback`` chars, so a cut at or before
+                # ``len(text) - holdback`` is committed.
+                cut = None
+                for stop in params.stop:
+                    pos = text.find(stop)
+                    if pos >= 0:
+                        cut = pos if cut is None else min(cut, pos)
+                if final:
+                    stopped = cut is not None
+                    safe = cut if cut is not None else len(text)
+                elif cut is not None and cut <= len(text) - holdback:
+                    stopped = True
+                    safe = cut
+                else:
+                    bound = len(text) if not holdback else max(
+                        emitted, len(text) - holdback
+                    )
+                    safe = bound if cut is None else min(cut, bound)
+                if safe > emitted:
+                    yield text[emitted:safe]
+                    emitted = safe
+                if stopped or final:
+                    break
+            # Surface generation errors (engine stopped, device failure).
+            if afut.done() and not afut.cancelled():
+                exc = afut.exception()
+                if exc is not None:
+                    raise exc
+        finally:
+            if not afut.done():
+                request.cancelled = True
 
     def get_metrics(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"backend": self.name, "model": self.model_cfg.name}

@@ -149,6 +149,59 @@ class HFTokenizer(Tokenizer):
             return None
 
 
+class IncrementalDecoder:
+    """Streaming detokenizer: ``push`` token ids as they arrive, get back
+    text deltas whose concatenation equals ``decode(all_ids)``.
+
+    Each push re-decodes the accumulated ids and emits the new suffix —
+    O(n²) over a response, irrelevant at agent-step lengths (≤ a few
+    hundred tokens) and the only strategy that is correct for ANY
+    tokenizer (subword merges can only be rendered once their
+    neighbours exist). Two holdbacks keep deltas append-only:
+
+    * a trailing U+FFFD is withheld — it is how a partial multi-byte
+      UTF-8 sequence renders before the next token completes it;
+    * if a new decode does NOT extend what was already emitted (a
+      tokenizer whose decode is not prefix-monotonic), the divergent
+      text is withheld until ``flush`` rather than emitted twice.
+    """
+
+    def __init__(self, tokenizer: Tokenizer) -> None:
+        self._tok = tokenizer
+        self._ids: List[int] = []
+        self._emitted = ""
+
+    def push(self, ids: Sequence[int]) -> str:
+        self._ids.extend(ids)
+        text = self._tok.decode(self._ids)
+        if not text.startswith(self._emitted):
+            return ""  # non-monotonic decode: defer to flush
+        safe = len(text)
+        while safe > len(self._emitted) and text[safe - 1] == "�":
+            safe -= 1
+        delta = text[len(self._emitted):safe]
+        self._emitted += delta
+        return delta
+
+    def flush(self) -> str:
+        """Emit everything still held back (stream end). After a
+        non-monotonic divergence the delta resumes from the longest
+        common prefix — the stream differs from ``decode(all)`` only
+        inside the divergent span, never by duplication."""
+        text = self._tok.decode(self._ids)
+        p = 0
+        limit = min(len(text), len(self._emitted))
+        while p < limit and text[p] == self._emitted[p]:
+            p += 1
+        delta = text[p:] if p < len(self._emitted) else text[len(self._emitted):]
+        self._emitted += delta
+        return delta
+
+    @property
+    def text(self) -> str:
+        return self._emitted
+
+
 def load_tokenizer(path: Optional[str] = None) -> Tokenizer:
     if path:
         return HFTokenizer(path)
