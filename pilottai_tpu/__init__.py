@@ -47,6 +47,7 @@ _LAZY = {
     "Tool": ("pilottai_tpu.tools.tool", "Tool"),
     "ToolRegistry": ("pilottai_tpu.tools.tool", "ToolRegistry"),
     "LLMHandler": ("pilottai_tpu.engine.handler", "LLMHandler"),
+    "APIServer": ("pilottai_tpu.server", "APIServer"),
     "EnhancedMemory": ("pilottai_tpu.memory.semantic", "EnhancedMemory"),
     "Embedder": ("pilottai_tpu.memory.embedder", "Embedder"),
     "KnowledgeManager": ("pilottai_tpu.knowledge.manager", "KnowledgeManager"),

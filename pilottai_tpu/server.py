@@ -1,0 +1,481 @@
+"""HTTP serving endpoint: OpenAI-compatible chat completions over the
+in-tree engine, plus orchestrator task submission.
+
+The reference FRAMEWORK is an API *client* (litellm → remote providers,
+``pilott/engine/llm.py:59``) and its only networked surface is a
+declared-but-unimplemented websocket config (``pilott/core/config.py:
+153-156``, SURVEY §2.12-i). This framework owns the inference path, so
+it can BE the provider: any OpenAI-SDK client (or plain HTTP) points at
+this endpoint and gets the native engine — continuous batching,
+speculation, prefix caching, grammar-masked JSON and SSE streaming
+included.
+
+Routes
+------
+* ``POST /v1/chat/completions`` — OpenAI wire format. ``stream: true``
+  returns Server-Sent Events chunks (``chat.completion.chunk`` deltas,
+  terminated by ``data: [DONE]``) fed by ``LLMHandler.astream``;
+  ``response_format: {"type": "json_object"}`` maps to the engine's
+  grammar-constrained ``json_mode``; ``tools`` (function specs) map to
+  ``ToolSpec`` and structured ``tool_calls`` come back in the message.
+* ``GET /v1/models`` — the registry's model list.
+* ``POST /v1/tasks`` — framework-specific: submit a task description to
+  an attached ``Serve`` orchestrator and wait for its ``TaskResult``
+  (503 when the server wraps a bare handler).
+* ``GET /healthz`` — liveness; ``GET /metrics`` — handler + global
+  metrics snapshot (JSON).
+
+Implementation is stdlib-asyncio only (``asyncio.start_server`` + a
+minimal HTTP/1.1 parser): SSE needs the event loop the engine's futures
+resolve on, which rules out the threaded ``http.server`` the metrics
+dashboard uses. One request per connection (``Connection: close``) —
+agent/SDK traffic reconnects per call and it keeps the parser honest.
+
+Auth mirrors the control plane's posture (``distributed/control_plane``):
+optional shared bearer token for private-network deployments; terminate
+TLS in front for anything else (documented in docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hmac
+import json
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from pilottai_tpu.engine.types import GenerationParams, ToolSpec
+from pilottai_tpu.utils.logging import get_logger
+from pilottai_tpu.utils.metrics import global_metrics
+
+_MAX_HEADER = 32 * 1024
+_MAX_BODY = 10 * 1024 * 1024
+
+_STATUS_TEXT = {
+    200: "OK", 400: "Bad Request", 401: "Unauthorized", 404: "Not Found",
+    405: "Method Not Allowed", 413: "Payload Too Large",
+    500: "Internal Server Error", 503: "Service Unavailable",
+}
+
+
+class _HttpError(Exception):
+    def __init__(self, status: int, message: str, kind: str = "invalid_request_error"):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+        self.kind = kind
+
+
+class APIServer:
+    """Serve an ``LLMHandler`` (and optionally a ``Serve``) over HTTP."""
+
+    def __init__(
+        self,
+        handler: Any,                    # LLMHandler (duck-typed for tests)
+        serve: Optional[Any] = None,     # Serve orchestrator for /v1/tasks
+        host: str = "127.0.0.1",
+        port: int = 0,
+        auth_token: Optional[str] = None,
+    ) -> None:
+        self.handler = handler
+        self.serve = serve
+        self.host = host
+        self.port = port
+        self.auth_token = auth_token
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._log = get_logger("server")
+
+    # ------------------------------------------------------------------ #
+
+    async def start(self) -> "APIServer":
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._log.info("API server on http://%s:%d", self.host, self.port)
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # ------------------------------------------------------------------ #
+    # HTTP plumbing
+    # ------------------------------------------------------------------ #
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            try:
+                method, path, headers, body = await self._read_request(reader)
+            except _HttpError as exc:
+                await self._send_error(writer, exc)
+                return
+            try:
+                self._check_auth(path, headers)
+                await self._route(method, path, headers, body, writer)
+            except _HttpError as exc:
+                await self._send_error(writer, exc)
+            except Exception as exc:  # noqa: BLE001 — request boundary
+                self._log.error("request failed: %s", exc, exc_info=True)
+                await self._send_error(
+                    writer, _HttpError(500, "internal error", "server_error")
+                )
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass  # client went away
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+    async def _read_request(
+        self, reader: asyncio.StreamReader
+    ) -> Tuple[str, str, Dict[str, str], bytes]:
+        try:
+            head = await asyncio.wait_for(
+                reader.readuntil(b"\r\n\r\n"), timeout=30.0
+            )
+        except (asyncio.LimitOverrunError, ValueError) as exc:
+            raise _HttpError(413, "headers too large") from exc
+        except asyncio.TimeoutError as exc:
+            raise _HttpError(400, "timed out reading request") from exc
+        if len(head) > _MAX_HEADER:
+            raise _HttpError(413, "headers too large")
+        lines = head.decode("latin-1").split("\r\n")
+        try:
+            method, path, _version = lines[0].split(" ", 2)
+        except ValueError as exc:
+            raise _HttpError(400, "malformed request line") from exc
+        headers: Dict[str, str] = {}
+        for line in lines[1:]:
+            if not line:
+                continue
+            k, _, v = line.partition(":")
+            headers[k.strip().lower()] = v.strip()
+        try:
+            length = int(headers.get("content-length", "0") or "0")
+        except ValueError as exc:
+            raise _HttpError(400, "invalid Content-Length") from exc
+        if length > _MAX_BODY:
+            raise _HttpError(413, "body too large")
+        if length:
+            # Same bound as the header read: a client that sends headers
+            # then withholds the body must not pin this connection task
+            # (slowloris).
+            try:
+                body = await asyncio.wait_for(
+                    reader.readexactly(length), timeout=30.0
+                )
+            except asyncio.TimeoutError as exc:
+                raise _HttpError(400, "timed out reading body") from exc
+        else:
+            body = b""
+        return method, path.split("?", 1)[0], headers, body
+
+    def _check_auth(self, path: str, headers: Dict[str, str]) -> None:
+        if self.auth_token is None or path == "/healthz":
+            return
+        got = headers.get("authorization", "")
+        if not hmac.compare_digest(got, f"Bearer {self.auth_token}"):
+            raise _HttpError(401, "missing or invalid bearer token",
+                             "authentication_error")
+
+    async def _send(
+        self,
+        writer: asyncio.StreamWriter,
+        status: int,
+        payload: Dict[str, Any],
+    ) -> None:
+        data = json.dumps(payload).encode()
+        writer.write(
+            f"HTTP/1.1 {status} {_STATUS_TEXT.get(status, '')}\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(data)}\r\n"
+            f"Connection: close\r\n\r\n".encode() + data
+        )
+        await writer.drain()
+
+    async def _send_error(self, writer: asyncio.StreamWriter, exc: _HttpError) -> None:
+        await self._send(
+            writer, exc.status,
+            {"error": {"message": exc.message, "type": exc.kind}},
+        )
+
+    # ------------------------------------------------------------------ #
+    # Routing
+    # ------------------------------------------------------------------ #
+
+    async def _route(
+        self,
+        method: str,
+        path: str,
+        headers: Dict[str, str],
+        body: bytes,
+        writer: asyncio.StreamWriter,
+    ) -> None:
+        if path == "/healthz" and method == "GET":
+            await self._send(writer, 200, {"status": "ok"})
+        elif path == "/metrics" and method == "GET":
+            await self._send(writer, 200, {
+                "handler": _jsonable(self.handler.get_metrics()),
+                "global": _jsonable(global_metrics.snapshot()),
+            })
+        elif path == "/v1/models" and method == "GET":
+            await self._send(writer, 200, self._models())
+        elif path == "/v1/chat/completions":
+            if method != "POST":
+                raise _HttpError(405, "POST required")
+            await self._chat_completions(_parse_json(body), writer)
+        elif path == "/v1/tasks":
+            if method != "POST":
+                raise _HttpError(405, "POST required")
+            await self._submit_task(_parse_json(body), writer)
+        else:
+            raise _HttpError(404, f"no route for {method} {path}")
+
+    def _models(self) -> Dict[str, Any]:
+        try:
+            from pilottai_tpu.models.registry import list_models
+
+            names = list_models()
+        except Exception:  # noqa: BLE001 — registry is engine-optional
+            names = []
+        configured = getattr(
+            getattr(self.handler, "config", None), "model_name", None
+        )
+        if configured and configured not in names:
+            names = [configured] + names
+        return {
+            "object": "list",
+            "data": [{"id": n, "object": "model", "owned_by": "pilottai-tpu"}
+                     for n in names],
+        }
+
+    # ------------------------------------------------------------------ #
+    # /v1/chat/completions
+    # ------------------------------------------------------------------ #
+
+    def _gen_params(self, req: Dict[str, Any]) -> Tuple[
+        List[Dict[str, Any]], Optional[List[ToolSpec]], GenerationParams
+    ]:
+        messages = req.get("messages")
+        if not isinstance(messages, list) or not messages:
+            raise _HttpError(400, "'messages' must be a non-empty list")
+        normed = []
+        for m in messages:
+            if not isinstance(m, dict) or "content" not in m:
+                raise _HttpError(400, "each message needs 'role' and 'content'")
+            # OpenAI's own wire shape uses content: null on assistant
+            # tool-call turns — normalize rather than 500 downstream.
+            normed.append({
+                "role": str(m.get("role") or "user"),
+                "content": "" if m["content"] is None else str(m["content"]),
+            })
+        messages = normed
+        tools = None
+        if req.get("tools"):
+            tools = []
+            for t in req["tools"]:
+                fn = t.get("function", t) if isinstance(t, dict) else {}
+                if not isinstance(fn, dict) or not fn.get("name"):
+                    raise _HttpError(400, "each tool needs function.name")
+                params_schema = fn.get("parameters") or {}
+                if not isinstance(params_schema, dict):
+                    raise _HttpError(400, "tool parameters must be an object")
+                tools.append(ToolSpec(
+                    name=str(fn["name"]),
+                    description=str(fn.get("description", "")),
+                    parameters=params_schema,
+                ))
+        stop = req.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        if not isinstance(stop, list):
+            raise _HttpError(400, "'stop' must be a string or list")
+        rf = req.get("response_format") or {}
+        if not isinstance(rf, dict):
+            raise _HttpError(400, "'response_format' must be an object")
+        try:
+            # Client values are untrusted: a non-numeric temperature or
+            # seed is a 400 invalid_request_error (OpenAI parity), not a
+            # 500 from int()/pydantic deep in the handler.
+            params = GenerationParams(
+                max_new_tokens=int(
+                    req.get("max_tokens")
+                    or req.get("max_completion_tokens") or 256
+                ),
+                temperature=float(req.get("temperature", 0.7)),
+                top_k=int(req.get("top_k", 0)),
+                top_p=float(req.get("top_p", 1.0)),
+                seed=int(req["seed"]) if req.get("seed") is not None else None,
+                stop=[str(s) for s in stop],
+                json_mode=rf.get("type") == "json_object",
+            )
+        except (TypeError, ValueError) as exc:
+            # (pydantic's ValidationError subclasses ValueError)
+            raise _HttpError(400, f"invalid sampling parameter: {exc}") from exc
+        return messages, tools, params
+
+    async def _chat_completions(
+        self, req: Dict[str, Any], writer: asyncio.StreamWriter
+    ) -> None:
+        messages, tools, params = self._gen_params(req)
+        model = req.get("model") or getattr(
+            getattr(self.handler, "config", None), "model_name", "default"
+        )
+        rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        created = int(time.time())
+
+        if req.get("stream"):
+            writer.write(
+                b"HTTP/1.1 200 OK\r\n"
+                b"Content-Type: text/event-stream\r\n"
+                b"Cache-Control: no-cache\r\n"
+                b"Connection: close\r\n\r\n"
+            )
+            await writer.drain()
+
+            def chunk(delta: Dict[str, Any], finish: Optional[str]) -> bytes:
+                return (
+                    "data: " + json.dumps({
+                        "id": rid, "object": "chat.completion.chunk",
+                        "created": created, "model": model,
+                        "choices": [{
+                            "index": 0, "delta": delta,
+                            "finish_reason": finish,
+                        }],
+                    }) + "\n\n"
+                ).encode()
+
+            # SSE errors can't change the status line anymore — they
+            # surface as an error event before [DONE].
+            try:
+                writer.write(chunk({"role": "assistant"}, None))
+                text_parts: List[str] = []
+                async for delta in self.handler.astream(
+                    messages, tools=tools, params=params
+                ):
+                    text_parts.append(delta)
+                    writer.write(chunk({"content": delta}, None))
+                    await writer.drain()
+                # Streamed function calling: the engine's tool protocol
+                # is JSON text, so calls are parseable only once the
+                # stream ends — emit them as one final tool_calls delta
+                # (clients that only read content still saw the text).
+                finish = "stop"
+                if tools:
+                    from pilottai_tpu.engine.base import parse_tool_calls
+
+                    calls = parse_tool_calls(
+                        "".join(text_parts), [t.name for t in tools]
+                    )
+                    if calls:
+                        finish = "tool_calls"
+                        writer.write(chunk({"tool_calls": [{
+                            "index": i, "id": tc.id, "type": "function",
+                            "function": {
+                                "name": tc.name,
+                                "arguments": json.dumps(tc.arguments),
+                            },
+                        } for i, tc in enumerate(calls)]}, None))
+                writer.write(chunk({}, finish))
+            except (ConnectionError, asyncio.CancelledError):
+                raise  # client gone / shutdown: astream's finally cancels
+            except Exception as exc:  # noqa: BLE001 — surface in-band
+                self._log.error("stream failed: %s", exc, exc_info=True)
+                writer.write((
+                    "data: " + json.dumps({
+                        "error": {"message": str(exc), "type": "server_error"}
+                    }) + "\n\n"
+                ).encode())
+            writer.write(b"data: [DONE]\n\n")
+            await writer.drain()
+            return
+
+        response = await self.handler.generate_response(
+            messages, tools=tools, params=params
+        )
+        message: Dict[str, Any] = {
+            "role": "assistant", "content": response.content,
+        }
+        if response.tool_calls:
+            message["tool_calls"] = [{
+                "id": tc.id, "type": "function",
+                "function": {
+                    "name": tc.name,
+                    "arguments": json.dumps(tc.arguments),
+                },
+            } for tc in response.tool_calls]
+        await self._send(writer, 200, {
+            "id": rid, "object": "chat.completion",
+            "created": created, "model": response.model or model,
+            "choices": [{
+                "index": 0, "message": message,
+                "finish_reason": response.finish_reason or "stop",
+            }],
+            "usage": {
+                "prompt_tokens": response.usage.prompt_tokens,
+                "completion_tokens": response.usage.completion_tokens,
+                "total_tokens": response.usage.total_tokens,
+            },
+        })
+
+    # ------------------------------------------------------------------ #
+    # /v1/tasks
+    # ------------------------------------------------------------------ #
+
+    async def _submit_task(
+        self, req: Dict[str, Any], writer: asyncio.StreamWriter
+    ) -> None:
+        if self.serve is None:
+            raise _HttpError(
+                503, "no orchestrator attached to this endpoint",
+                "server_error",
+            )
+        task = req.get("task") or req.get("description")
+        if not task:
+            raise _HttpError(400, "'task' (or 'description') is required")
+        timeout = req.get("timeout")
+        try:
+            timeout = float(timeout) if timeout is not None else None
+        except (TypeError, ValueError) as exc:
+            raise _HttpError(400, "'timeout' must be a number") from exc
+        result = await self.serve.execute_task(task, timeout=timeout)
+        await self._send(writer, 200, {
+            "object": "task.result",
+            "success": result.success,
+            "output": _jsonable(result.output),
+            "error": result.error,
+            "execution_time": result.execution_time,
+            "metadata": _jsonable(result.metadata),
+        })
+
+
+def _parse_json(body: bytes) -> Dict[str, Any]:
+    try:
+        data = json.loads(body or b"{}")
+    except json.JSONDecodeError as exc:
+        raise _HttpError(400, f"invalid JSON body: {exc}") from exc
+    if not isinstance(data, dict):
+        raise _HttpError(400, "request body must be a JSON object")
+    return data
+
+
+def _jsonable(value: Any) -> Any:
+    """Best-effort conversion to JSON-serializable structures (task
+    outputs and metrics may carry arbitrary objects)."""
+    try:
+        json.dumps(value)
+        return value
+    except (TypeError, ValueError):
+        if isinstance(value, dict):
+            return {str(k): _jsonable(v) for k, v in value.items()}
+        if isinstance(value, (list, tuple)):
+            return [_jsonable(v) for v in value]
+        return repr(value)

@@ -48,6 +48,17 @@ def test_incremental_decoder_flush_emits_trailing_partial():
     assert dec.flush() == "�"  # truncated sequence renders as U+FFFD
 
 
+def test_stop_cut_order_independent():
+    from pilottai_tpu.engine.native import _stop_cut
+
+    # Straddling stops: "cd"'s occurrence overlaps "bc"'s cut; the
+    # earliest occurrence wins regardless of list order.
+    assert _stop_cut("abcd", ["cd", "bc"]) == 1
+    assert _stop_cut("abcd", ["bc", "cd"]) == 1
+    assert _stop_cut("abcd", ["xy"]) is None
+    assert _stop_cut("abcd", []) is None
+
+
 # ----------------------- mock backend streaming ------------------------ #
 
 @pytest.mark.asyncio
@@ -150,10 +161,13 @@ async def test_native_stream_stop_string(tiny_handler):
     if len(full) < 4:
         pytest.skip("reply too short to carve a stop string from")
     stop = full[2:4]
-    stopped = full[: full.find(stop)]
     params2 = params.model_copy(update={"stop": [stop]})
+    expect = (
+        await tiny_handler.generate_response(msgs, params=params2)
+    ).content
+    assert expect == full[: full.find(stop)]
     deltas = [d async for d in tiny_handler.astream(msgs, params=params2)]
-    assert "".join(deltas) == stopped
+    assert "".join(deltas) == expect
 
 
 @pytest.mark.asyncio
@@ -166,12 +180,10 @@ async def test_native_stream_multi_stop_parity(tiny_handler):
     if len(full) < 6:
         pytest.skip("reply too short to carve two stop strings from")
     stops = [full[4:6], full[1:3]]  # second stop cuts EARLIER than first
-    expect = full
-    for s in stops:
-        pos = expect.find(s)
-        if pos >= 0:
-            expect = expect[:pos]
     params2 = params.model_copy(update={"stop": stops})
+    expect = (
+        await tiny_handler.generate_response(msgs, params=params2)
+    ).content
     deltas = [d async for d in tiny_handler.astream(msgs, params=params2)]
     assert "".join(deltas) == expect
 
@@ -187,15 +199,14 @@ async def test_native_stream_overlapping_stops_parity(tiny_handler):
     if len(full) < 8:
         pytest.skip("reply too short to carve overlapping stops from")
     stops = [full[1:7], full[4:6]]  # long starts at 1, short inside it
-    # Expected = generate()'s own one-pass list-order truncation (with
-    # repetitive model text the carved stops may occur even earlier than
-    # where they were carved from — parity, not position, is the claim).
-    expect = full
-    for s in stops:
-        pos = expect.find(s)
-        if pos >= 0:
-            expect = expect[:pos]
     params2 = params.model_copy(update={"stop": stops})
+    # Expected = generate_response's own truncation for the SAME stops
+    # (with repetitive model text the carved stops may occur even
+    # earlier than where they were carved — parity, not position, is
+    # the claim).
+    expect = (
+        await tiny_handler.generate_response(msgs, params=params2)
+    ).content
     deltas = [d async for d in tiny_handler.astream(msgs, params=params2)]
     assert "".join(deltas) == expect
 
