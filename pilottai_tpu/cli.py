@@ -1,0 +1,173 @@
+"""Command-line entry point: launch the serving endpoint, list models.
+
+The reference has no CLI at all (its README Quick Start is a Python
+snippet, ``/root/reference/README.md:83-100``); an installable serving
+framework needs a launchable server. ``pip install pilottai-tpu`` puts
+``pilottai-tpu`` on PATH (pyproject ``[project.scripts]``):
+
+    pilottai-tpu serve --model llama3-8b-byte --quantize int8 --port 8000
+    pilottai-tpu models
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+from typing import List, Optional
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pilottai-tpu",
+        description="TPU-native multi-agent LLM framework",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    s = sub.add_parser("serve", help="serve a model over HTTP (OpenAI wire)")
+    s.add_argument("--model", default="llama3-1b-byte",
+                   help="registry model name (see `pilottai-tpu models`)")
+    s.add_argument("--provider", default="tpu",
+                   choices=["tpu", "cpu", "mock"],
+                   help="tpu = attached accelerator; cpu = host jax; "
+                        "mock = deterministic protocol fake")
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=8000)
+    s.add_argument("--auth-token", default=None,
+                   help="require 'Authorization: Bearer <token>' on /v1/*")
+    s.add_argument("--checkpoint", default=None,
+                   help="HF safetensors directory (random init without)")
+    s.add_argument("--tokenizer", default=None,
+                   help="local HF tokenizer path (byte tokenizer without)")
+    s.add_argument("--quantize", default=None, choices=["int8"],
+                   help="weight-only int8 (fits llama3-8b on one 16GB chip)")
+    s.add_argument("--kv-quantize", default=None, choices=["int8"])
+    s.add_argument("--slots", type=int, default=8,
+                   help="continuous-batching slots")
+    s.add_argument("--max-seq", type=int, default=None,
+                   help="KV capacity per slot (>=4096 auto-enables paging)")
+    s.add_argument("--speculate", type=int, default=0,
+                   help="verify-block width D (0 = off)")
+    s.add_argument("--draft-layers", type=int, default=0,
+                   help="adaptive shallow-layer drafting (needs --speculate)")
+    s.add_argument("--chunk", type=int, default=16,
+                   help="decode blocks per dispatch")
+    s.add_argument("--embedder", default=None, metavar="MODEL",
+                   help="also serve /v1/embeddings with this encoder model")
+    s.add_argument("--embedder-checkpoint", default=None,
+                   help="HF safetensors for the embedder (random init "
+                        "without — fine for tests, wrong for production)")
+    s.add_argument("--embedder-tokenizer", default=None,
+                   help="local HF tokenizer path for the embedder")
+    s.add_argument("--dashboard-port", type=int, default=None,
+                   help="also start the HTML metrics dashboard")
+
+    sub.add_parser("models", help="list registry models")
+    return p
+
+
+async def run_serve(args, ready: Optional[asyncio.Event] = None,
+                    stop: Optional[asyncio.Event] = None) -> None:
+    """Bring up handler (+ optional embedder/dashboard) and serve until
+    ``stop`` is set (tests) or forever (CLI, until SIGINT)."""
+    from pilottai_tpu.core.config import LLMConfig
+    from pilottai_tpu.engine.handler import LLMHandler
+    from pilottai_tpu.server import APIServer
+
+    config = LLMConfig(
+        model_name=args.model,
+        provider=args.provider,
+        checkpoint_path=args.checkpoint,
+        tokenizer_path=args.tokenizer,
+        quantize=args.quantize,
+        engine_kv_quantize=args.kv_quantize,
+        engine_slots=args.slots,
+        engine_max_seq=args.max_seq,
+        engine_speculate=args.speculate,
+        engine_draft_layers=args.draft_layers,
+        engine_chunk=args.chunk,
+    )
+    handler = LLMHandler(config)
+    embedder = None
+    dashboard = None
+    server = None
+    # try/finally from the FIRST resource: a bad --checkpoint or a bound
+    # --port must not leak the dashboard thread or a half-started engine
+    # (and a programmatic caller waiting on ``ready`` gets the exception,
+    # not a hang).
+    try:
+        if args.embedder:
+            from pilottai_tpu.engine.tokenizer import load_tokenizer
+            from pilottai_tpu.memory.embedder import Embedder
+
+            if not args.embedder_checkpoint:
+                print(
+                    "warning: --embedder without --embedder-checkpoint "
+                    "uses RANDOM weights (test-only embeddings)",
+                    file=sys.stderr, flush=True,
+                )
+            embedder = Embedder(
+                model_name=args.embedder,
+                checkpoint_path=args.embedder_checkpoint,
+                tokenizer=(
+                    load_tokenizer(args.embedder_tokenizer)
+                    if args.embedder_tokenizer else None
+                ),
+            )
+        if args.dashboard_port is not None:
+            from pilottai_tpu.utils.dashboard import MetricsDashboard
+
+            dashboard = MetricsDashboard(
+                source=handler, host=args.host, port=args.dashboard_port
+            ).start()
+        # Compile/load BEFORE accepting traffic, so the first request
+        # isn't a minutes-long surprise (the persistent compile cache
+        # makes warm boots seconds). Same policy for the embedder: one
+        # warmup encode compiles its first length bucket.
+        if args.provider != "mock":
+            print(f"loading {args.model} ({args.provider})…",
+                  file=sys.stderr, flush=True)
+            await handler.start()
+        if embedder is not None:
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, embedder.encode, ["warmup"])
+        server = await APIServer(
+            handler, embedder=embedder,
+            host=args.host, port=args.port, auth_token=args.auth_token,
+        ).start()
+        print(f"serving {args.model} on http://{args.host}:{server.port}/v1",
+              file=sys.stderr, flush=True)
+        args._bound_port = server.port  # port 0 resolves here (tests read it)
+        if ready is not None:
+            ready.set()
+        if stop is not None:
+            await stop.wait()
+        else:
+            await asyncio.Event().wait()  # until SIGINT
+    finally:
+        if server is not None:
+            await server.stop()
+        if dashboard is not None:
+            dashboard.stop()
+        await handler.stop()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.command == "models":
+        from pilottai_tpu.models.registry import list_models
+
+        for name in list_models():
+            print(name)
+        return 0
+    if args.command == "serve":
+        try:
+            asyncio.run(run_serve(args))
+        except KeyboardInterrupt:
+            print("shutting down", file=sys.stderr)
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -74,12 +74,14 @@ class APIServer:
         self,
         handler: Any,                    # LLMHandler (duck-typed for tests)
         serve: Optional[Any] = None,     # Serve orchestrator for /v1/tasks
+        embedder: Optional[Any] = None,  # memory.Embedder for /v1/embeddings
         host: str = "127.0.0.1",
         port: int = 0,
         auth_token: Optional[str] = None,
     ) -> None:
         self.handler = handler
         self.serve = serve
+        self.embedder = embedder
         self.host = host
         self.port = port
         self.auth_token = auth_token
@@ -232,6 +234,10 @@ class APIServer:
             if method != "POST":
                 raise _HttpError(405, "POST required")
             await self._chat_completions(_parse_json(body), writer)
+        elif path == "/v1/embeddings":
+            if method != "POST":
+                raise _HttpError(405, "POST required")
+            await self._embeddings(_parse_json(body), writer)
         elif path == "/v1/tasks":
             if method != "POST":
                 raise _HttpError(405, "POST required")
@@ -423,6 +429,58 @@ class APIServer:
                 "prompt_tokens": response.usage.prompt_tokens,
                 "completion_tokens": response.usage.completion_tokens,
                 "total_tokens": response.usage.total_tokens,
+            },
+        })
+
+    # ------------------------------------------------------------------ #
+    # /v1/embeddings
+    # ------------------------------------------------------------------ #
+
+    async def _embeddings(
+        self, req: Dict[str, Any], writer: asyncio.StreamWriter
+    ) -> None:
+        if self.embedder is None:
+            raise _HttpError(
+                503, "no embedder attached to this endpoint", "server_error"
+            )
+        texts = req.get("input")
+        if isinstance(texts, str):
+            texts = [texts]
+        if (
+            not isinstance(texts, list) or not texts
+            or not all(isinstance(t, str) for t in texts)
+        ):
+            raise _HttpError(400, "'input' must be a string or list of strings")
+        # encode() is synchronous jit compute behind a thread lock — keep
+        # the event loop responsive (SURVEY §7 hard part 5).
+        loop = asyncio.get_running_loop()
+        vecs = await loop.run_in_executor(
+            None, self.embedder.encode, list(texts)
+        )
+        # Exact usage: what the encoder actually consumed (its own
+        # tokenizer, its own max_len truncation) — clients metering on
+        # the OpenAI usage field must not get a chars/4 guess.
+        tok = getattr(self.embedder, "tokenizer", None)
+        max_len = getattr(self.embedder, "max_len", None)
+        if tok is not None:
+            n_tokens = sum(
+                len(tok.encode(t)[:max_len] if max_len else tok.encode(t))
+                for t in texts
+            )
+        else:
+            n_tokens = sum(len(t) // 4 for t in texts)
+        await self._send(writer, 200, {
+            "object": "list",
+            "model": getattr(
+                getattr(self.embedder, "cfg", None), "name", "embedder"
+            ),
+            "data": [
+                {"object": "embedding", "index": i, "embedding": v.tolist()}
+                for i, v in enumerate(vecs)
+            ],
+            "usage": {
+                "prompt_tokens": n_tokens,
+                "total_tokens": n_tokens,
             },
         })
 

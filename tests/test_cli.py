@@ -1,0 +1,54 @@
+"""CLI tests: arg surface, model listing, and the serve loop end to end
+on the mock provider."""
+
+import asyncio
+import json
+
+import pytest
+
+from pilottai_tpu.cli import _build_parser, main, run_serve
+
+
+def test_models_command(capsys):
+    assert main(["models"]) == 0
+    out = capsys.readouterr().out.splitlines()
+    assert "llama3-8b-byte" in out and "gemma-2b" in out
+
+
+def test_serve_args_parse():
+    args = _build_parser().parse_args([
+        "serve", "--model", "llama3-8b-byte", "--quantize", "int8",
+        "--speculate", "6", "--max-seq", "4096", "--port", "9000",
+        "--auth-token", "t",
+    ])
+    assert args.model == "llama3-8b-byte"
+    assert args.quantize == "int8"
+    assert args.speculate == 6
+    assert args.max_seq == 4096
+
+
+@pytest.mark.asyncio
+async def test_serve_loop_mock_end_to_end():
+    args = _build_parser().parse_args(
+        ["serve", "--provider", "mock", "--port", "0",
+         "--dashboard-port", "0"]  # constructor kwargs regression
+    )
+    ready = asyncio.Event()
+    stop = asyncio.Event()
+    task = asyncio.create_task(run_serve(args, ready=ready, stop=stop))
+    await asyncio.wait_for(ready.wait(), timeout=30)
+    try:
+        from tests.test_server import _request
+
+        port = args._bound_port  # port 0 resolved at bind time
+        status, _, body = await _request(port, "GET", "/healthz")
+        assert status == 200 and json.loads(body)["status"] == "ok"
+        status, _, body = await _request(
+            port, "POST", "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "hello"}]},
+        )
+        assert status == 200
+        assert json.loads(body)["choices"][0]["message"]["content"]
+    finally:
+        stop.set()
+        await asyncio.wait_for(task, timeout=30)

@@ -329,6 +329,53 @@ async def test_native_engine_over_sse():
 
 
 @pytest.mark.asyncio
+async def test_embeddings_endpoint():
+    from pilottai_tpu.memory.embedder import Embedder
+
+    server = await APIServer(
+        _mock_handler(), embedder=Embedder(model_name="llama-tiny")
+    ).start()
+    try:
+        status, _, body = await _request(
+            server.port, "POST", "/v1/embeddings",
+            {"input": ["hello world", "quarterly report"]},
+        )
+        assert status == 200
+        data = json.loads(body)
+        assert data["object"] == "list" and len(data["data"]) == 2
+        vec = data["data"][0]["embedding"]
+        assert len(vec) > 8 and abs(sum(x * x for x in vec) - 1.0) < 1e-3
+        # Usage is the encoder's REAL token count (byte tokenizer ≈ one
+        # per char), not a chars/4 guess.
+        assert data["usage"]["prompt_tokens"] >= len("hello world")
+
+        # Single-string input form.
+        status, _, body = await _request(
+            server.port, "POST", "/v1/embeddings", {"input": "one text"}
+        )
+        assert status == 200 and len(json.loads(body)["data"]) == 1
+
+        status, _, _ = await _request(
+            server.port, "POST", "/v1/embeddings", {"input": []}
+        )
+        assert status == 400
+    finally:
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_embeddings_503_without_embedder():
+    server = await APIServer(_mock_handler()).start()
+    try:
+        status, _, _ = await _request(
+            server.port, "POST", "/v1/embeddings", {"input": "x"}
+        )
+        assert status == 503
+    finally:
+        await server.stop()
+
+
+@pytest.mark.asyncio
 async def test_json_mode_response_format():
     server = await APIServer(_mock_handler()).start()
     try:
