@@ -52,6 +52,9 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="adaptive shallow-layer drafting (needs --speculate)")
     s.add_argument("--chunk", type=int, default=16,
                    help="decode blocks per dispatch")
+    s.add_argument("--agents", type=int, default=0, metavar="N",
+                   help="attach a Serve orchestrator with N generic agents "
+                        "(enables /v1/tasks incl. SSE task streaming)")
     s.add_argument("--embedder", default=None, metavar="MODEL",
                    help="also serve /v1/embeddings with this encoder model")
     s.add_argument("--embedder-checkpoint", default=None,
@@ -91,6 +94,7 @@ async def run_serve(args, ready: Optional[asyncio.Event] = None,
     embedder = None
     dashboard = None
     server = None
+    serve = None
     # try/finally from the FIRST resource: a bad --checkpoint or a bound
     # --port must not leak the dashboard thread or a half-started engine
     # (and a programmatic caller waiting on ``ready`` gets the exception,
@@ -131,8 +135,28 @@ async def run_serve(args, ready: Optional[asyncio.Event] = None,
         if embedder is not None:
             loop = asyncio.get_running_loop()
             await loop.run_in_executor(None, embedder.encode, ["warmup"])
+        if args.agents > 0:
+            from pilottai_tpu.core.agent import BaseAgent
+            from pilottai_tpu.core.config import AgentConfig, ServeConfig
+            from pilottai_tpu.serve import Serve
+
+            serve = Serve(
+                name="pilottai-tpu",
+                manager_llm=handler,
+                agents=[
+                    BaseAgent(
+                        config=AgentConfig(
+                            role=f"worker{i}", specializations=["generic"],
+                        ),
+                        llm=handler,
+                    )
+                    for i in range(args.agents)
+                ],
+                config=ServeConfig(max_concurrent_tasks=args.agents),
+            )
+            await serve.start()
         server = await APIServer(
-            handler, embedder=embedder,
+            handler, serve=serve, embedder=embedder,
             host=args.host, port=args.port, auth_token=args.auth_token,
         ).start()
         print(f"serving {args.model} on http://{args.host}:{server.port}/v1",
@@ -147,6 +171,8 @@ async def run_serve(args, ready: Optional[asyncio.Event] = None,
     finally:
         if server is not None:
             await server.stop()
+        if serve is not None:
+            await serve.stop()
         if dashboard is not None:
             dashboard.stop()
         await handler.stop()

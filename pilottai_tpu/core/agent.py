@@ -482,6 +482,13 @@ class BaseAgent:
             if complete:
                 output = plan.get("output", output)
                 history.append({"action": "complete", "result": output})
+                if self.step_callback:
+                    maybe = self.step_callback(
+                        task.id,
+                        {"iteration": iteration, "action": "complete"},
+                    )
+                    if asyncio.iscoroutine(maybe):
+                        await maybe
                 break
             if action in tool_map:
                 try:

@@ -118,6 +118,9 @@ class Serve:
         self.name = name or self.config.name
         self.agents: Dict[str, BaseAgent] = {}
         for agent in agents or []:
+            # _wire_agent only binds methods; nothing it touches is
+            # evaluated until the callbacks actually fire.
+            self._wire_agent(agent)
             self.agents[agent.id] = agent
         self.manager_agent = manager_agent
         if manager_llm is None and llm_config is not None:
@@ -139,6 +142,9 @@ class Serve:
         self._blocked: Dict[str, Task] = {}
         self._waiters: Dict[str, asyncio.Future] = {}
         self._parent_children: Dict[str, List[str]] = {}
+        # Live task-event feeds (subscribe_events): task_id → queues.
+        # Subtask events roll up to the parent's subscribers too.
+        self._event_subs: Dict[str, List[asyncio.Queue]] = {}
 
         self.metrics: Dict[str, float] = {
             "tasks_received": 0, "tasks_completed": 0, "tasks_failed": 0,
@@ -173,10 +179,22 @@ class Serve:
     def add_agent(self, agent: BaseAgent) -> None:
         if agent.id in self.agents:
             raise ValueError(f"agent {agent.id} already added")
-        if agent.dependency_resolver is None:
-            agent.dependency_resolver = self.get_task
+        self._wire_agent(agent)
         self.agents[agent.id] = agent
         self.router.invalidate()
+
+    def _wire_agent(self, agent: BaseAgent) -> None:
+        """Attach orchestrator plumbing an agent needs: dependency
+        lookups and (unless the user installed their own) a step
+        callback feeding the task event bus."""
+        if agent.dependency_resolver is None:
+            agent.dependency_resolver = self.get_task
+        if agent.step_callback is None:
+            agent.step_callback = self._agent_step_event
+
+    def _agent_step_event(self, task_id: str, info: Dict[str, Any]) -> None:
+        task = self.all_tasks.get(task_id)
+        self._emit_event(task if task is not None else task_id, "step", **info)
 
     async def remove_agent(self, agent_id: str) -> Optional[BaseAgent]:
         agent = self.agents.pop(agent_id, None)
@@ -355,14 +373,72 @@ class Serve:
             kwargs.setdefault("payload", {}).update(payload)
         return Task(**kwargs)
 
+    def prepare_task(self, task: Task | Dict[str, Any] | str) -> Task:
+        """Coerce to a ``Task`` WITHOUT submitting — lets a caller
+        ``subscribe_events(task.id)`` before ``add_task`` so no lifecycle
+        event is missed (the API server's SSE task stream does this)."""
+        return self._coerce_task(task)
+
+    # ------------------------------------------------------------------ #
+    # Task event feed (observability, SURVEY §5.5): every lifecycle
+    # transition — received/analyzed/decomposed/queued/assigned/step/
+    # retry/completed — is emitted to subscribers of the task AND of its
+    # parent (so one subscription watches a whole decomposition).
+    # ------------------------------------------------------------------ #
+
+    def subscribe_events(
+        self, task_id: str, max_buffer: int = 256
+    ) -> asyncio.Queue:
+        """Live event feed for ``task_id`` (and its subtasks). Slow
+        consumers lose OLDEST events (drop-oldest ring), never block the
+        orchestrator."""
+        q: asyncio.Queue = asyncio.Queue(maxsize=max_buffer)
+        self._event_subs.setdefault(task_id, []).append(q)
+        return q
+
+    def unsubscribe_events(self, task_id: str, q: asyncio.Queue) -> None:
+        subs = self._event_subs.get(task_id)
+        if subs and q in subs:
+            subs.remove(q)
+            if not subs:
+                self._event_subs.pop(task_id, None)
+
+    def _emit_event(self, task: Task | str, event: str, **data: Any) -> None:
+        if not self._event_subs:
+            return
+        tid = task if isinstance(task, str) else task.id
+        parent = None if isinstance(task, str) else task.parent_task_id
+        payload = {"event": event, "task_id": tid, "ts": time.time(), **data}
+        for key in {tid, parent} - {None}:
+            for q in self._event_subs.get(key, ()):
+                try:
+                    q.put_nowait(payload)
+                except asyncio.QueueFull:
+                    try:
+                        q.get_nowait()
+                    except asyncio.QueueEmpty:
+                        pass
+                    try:
+                        q.put_nowait(payload)
+                    except asyncio.QueueFull:
+                        pass
+
     async def add_task(self, task: Task | Dict[str, Any] | str) -> Task:
         """Analyze, maybe decompose, and queue. Returns the (parent) Task."""
         task = self._coerce_task(task)
         self.all_tasks[task.id] = task
         self.metrics["tasks_received"] += 1
         self._waiters.setdefault(task.id, asyncio.get_running_loop().create_future())
+        self._emit_event(task, "received", description=task.description[:200])
 
         analysis = await self._analyze_task(task)
+        self._emit_event(
+            task, "analyzed",
+            complexity=task.complexity,
+            requires_decomposition=coerce_bool(
+                analysis.get("requires_decomposition", False)
+            ),
+        )
         if (
             self.config.decomposition_enabled
             and coerce_bool(analysis.get("requires_decomposition", False))
@@ -375,6 +451,7 @@ class Serve:
     async def _queue_task(self, task: Task) -> None:
         if self.journal is not None:
             self.journal.record_task(task)
+        self._emit_event(task, "queued", priority=str(task.priority))
         try:
             evicted = await self.task_queue.put(task)
         except asyncio.QueueFull:
@@ -445,6 +522,7 @@ class Serve:
             subtasks.append(sub)
         task.subtasks = [s.id for s in subtasks]
         self._parent_children[task.id] = [s.id for s in subtasks]
+        self._emit_event(task, "decomposed", subtasks=[s.id for s in subtasks])
         task.status = TaskStatus.BLOCKED
         if self.journal is not None:  # parents never pass through _queue_task
             self.journal.record_task(task)
@@ -585,6 +663,10 @@ class Serve:
                 )
                 return
             self.running_tasks[task.id] = task
+            self._emit_event(
+                task, "assigned",
+                agent_id=agent.id, agent_role=agent.config.role,
+            )
             try:
                 result = await agent.execute_task(task)
                 result = await self._maybe_retry(task, result)
@@ -632,6 +714,7 @@ class Serve:
             agent = await self._select_agent(task)
             if agent is None:
                 break
+            self._emit_event(task, "retry", attempt=retries, agent_id=agent.id)
             task.mark_started(agent_id=agent.id)
             result = await agent.execute_task(task)
             needs_retry = not result.success
@@ -655,6 +738,12 @@ class Serve:
 
         if self.journal is not None:
             self.journal.record_status(task)
+
+        self._emit_event(
+            task, "completed" if result.success else "failed",
+            success=result.success, error=result.error,
+            execution_time=result.execution_time,
+        )
 
         waiter = self._waiters.get(task.id)
         if waiter is not None and not waiter.done():

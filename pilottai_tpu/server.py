@@ -209,6 +209,37 @@ class APIServer:
             {"error": {"message": exc.message, "type": exc.kind}},
         )
 
+    # Shared SSE scaffolding — one definition for every streaming route
+    # (chat completions AND task streams), so status line, event shape
+    # and terminator can't drift apart.
+
+    @staticmethod
+    async def _sse_start(writer: asyncio.StreamWriter) -> None:
+        writer.write(
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: text/event-stream\r\n"
+            b"Cache-Control: no-cache\r\n"
+            b"Connection: close\r\n\r\n"
+        )
+        await writer.drain()
+
+    @staticmethod
+    def _sse_event(writer: asyncio.StreamWriter, payload: Dict[str, Any]) -> None:
+        writer.write(("data: " + json.dumps(payload) + "\n\n").encode())
+
+    def _sse_error(self, writer: asyncio.StreamWriter, exc: Exception) -> None:
+        """In-band error event: the 200 + SSE status line is already on
+        the wire, so errors can't change it anymore."""
+        self._log.error("stream failed: %s", exc, exc_info=True)
+        self._sse_event(
+            writer, {"error": {"message": str(exc), "type": "server_error"}}
+        )
+
+    @staticmethod
+    async def _sse_done(writer: asyncio.StreamWriter) -> None:
+        writer.write(b"data: [DONE]\n\n")
+        await writer.drain()
+
     # ------------------------------------------------------------------ #
     # Routing
     # ------------------------------------------------------------------ #
@@ -339,36 +370,26 @@ class APIServer:
         created = int(time.time())
 
         if req.get("stream"):
-            writer.write(
-                b"HTTP/1.1 200 OK\r\n"
-                b"Content-Type: text/event-stream\r\n"
-                b"Cache-Control: no-cache\r\n"
-                b"Connection: close\r\n\r\n"
-            )
-            await writer.drain()
+            await self._sse_start(writer)
 
-            def chunk(delta: Dict[str, Any], finish: Optional[str]) -> bytes:
-                return (
-                    "data: " + json.dumps({
-                        "id": rid, "object": "chat.completion.chunk",
-                        "created": created, "model": model,
-                        "choices": [{
-                            "index": 0, "delta": delta,
-                            "finish_reason": finish,
-                        }],
-                    }) + "\n\n"
-                ).encode()
+            def chunk(delta: Dict[str, Any], finish: Optional[str]) -> None:
+                self._sse_event(writer, {
+                    "id": rid, "object": "chat.completion.chunk",
+                    "created": created, "model": model,
+                    "choices": [{
+                        "index": 0, "delta": delta,
+                        "finish_reason": finish,
+                    }],
+                })
 
-            # SSE errors can't change the status line anymore — they
-            # surface as an error event before [DONE].
             try:
-                writer.write(chunk({"role": "assistant"}, None))
+                chunk({"role": "assistant"}, None)
                 text_parts: List[str] = []
                 async for delta in self.handler.astream(
                     messages, tools=tools, params=params
                 ):
                     text_parts.append(delta)
-                    writer.write(chunk({"content": delta}, None))
+                    chunk({"content": delta}, None)
                     await writer.drain()
                 # Streamed function calling: the engine's tool protocol
                 # is JSON text, so calls are parseable only once the
@@ -383,25 +404,19 @@ class APIServer:
                     )
                     if calls:
                         finish = "tool_calls"
-                        writer.write(chunk({"tool_calls": [{
+                        chunk({"tool_calls": [{
                             "index": i, "id": tc.id, "type": "function",
                             "function": {
                                 "name": tc.name,
                                 "arguments": json.dumps(tc.arguments),
                             },
-                        } for i, tc in enumerate(calls)]}, None))
-                writer.write(chunk({}, finish))
+                        } for i, tc in enumerate(calls)]}, None)
+                chunk({}, finish)
             except (ConnectionError, asyncio.CancelledError):
                 raise  # client gone / shutdown: astream's finally cancels
             except Exception as exc:  # noqa: BLE001 — surface in-band
-                self._log.error("stream failed: %s", exc, exc_info=True)
-                writer.write((
-                    "data: " + json.dumps({
-                        "error": {"message": str(exc), "type": "server_error"}
-                    }) + "\n\n"
-                ).encode())
-            writer.write(b"data: [DONE]\n\n")
-            await writer.drain()
+                self._sse_error(writer, exc)
+            await self._sse_done(writer)
             return
 
         response = await self.handler.generate_response(
@@ -504,15 +519,67 @@ class APIServer:
             timeout = float(timeout) if timeout is not None else None
         except (TypeError, ValueError) as exc:
             raise _HttpError(400, "'timeout' must be a number") from exc
+
+        def result_payload(result) -> Dict[str, Any]:
+            return {
+                "object": "task.result",
+                "success": result.success,
+                "output": _jsonable(result.output),
+                "error": result.error,
+                "execution_time": result.execution_time,
+                "metadata": _jsonable(result.metadata),
+            }
+
+        if req.get("stream"):
+            # Live lifecycle feed: subscribe BEFORE submitting so the
+            # received/analyzed/queued events aren't missed, then SSE
+            # every event (subtask events roll up) and close with the
+            # final result + [DONE]. Subscription and header flush both
+            # live INSIDE the try: a client that drops before the
+            # headers drain must still unsubscribe (leak regression).
+            task_obj = self.serve.prepare_task(task)
+            q = self.serve.subscribe_events(task_obj.id)
+            exec_task = None
+            getter = None
+            try:
+                await self._sse_start(writer)
+                exec_task = asyncio.ensure_future(
+                    self.serve.execute_task(task_obj, timeout=timeout)
+                )
+                while not exec_task.done():
+                    getter = asyncio.ensure_future(q.get())
+                    done, _ = await asyncio.wait(
+                        {getter, exec_task},
+                        return_when=asyncio.FIRST_COMPLETED,
+                    )
+                    if getter in done:
+                        self._sse_event(writer, _jsonable(getter.result()))
+                        getter = None
+                        await writer.drain()
+                    else:
+                        getter.cancel()
+                        getter = None
+                while not q.empty():  # events emitted before completion
+                    self._sse_event(writer, _jsonable(q.get_nowait()))
+                result = await exec_task
+                self._sse_event(writer, result_payload(result))
+            except (ConnectionError, asyncio.CancelledError):
+                raise
+            except Exception as exc:  # noqa: BLE001 — surface in-band
+                self._sse_error(writer, exc)
+            finally:
+                self.serve.unsubscribe_events(task_obj.id, q)
+                # Handler cancellation mid-asyncio.wait leaves BOTH
+                # futures pending — cancel whatever is still in flight.
+                if getter is not None and not getter.done():
+                    getter.cancel()
+                if exec_task is not None and not exec_task.done():
+                    exec_task.cancel()
+            await self._sse_done(writer)
+            return
+
         result = await self.serve.execute_task(task, timeout=timeout)
-        await self._send(writer, 200, {
-            "object": "task.result",
-            "success": result.success,
-            "output": _jsonable(result.output),
-            "error": result.error,
-            "execution_time": result.execution_time,
-            "metadata": _jsonable(result.metadata),
-        })
+        await self._send(writer, 200, result_payload(result))
 
 
 def _parse_json(body: bytes) -> Dict[str, Any]:

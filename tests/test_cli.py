@@ -31,7 +31,8 @@ def test_serve_args_parse():
 async def test_serve_loop_mock_end_to_end():
     args = _build_parser().parse_args(
         ["serve", "--provider", "mock", "--port", "0",
-         "--dashboard-port", "0"]  # constructor kwargs regression
+         "--dashboard-port", "0",  # constructor kwargs regression
+         "--agents", "1"]          # attaches a Serve → /v1/tasks works
     )
     ready = asyncio.Event()
     stop = asyncio.Event()
@@ -49,6 +50,10 @@ async def test_serve_loop_mock_end_to_end():
         )
         assert status == 200
         assert json.loads(body)["choices"][0]["message"]["content"]
+        status, _, body = await _request(
+            port, "POST", "/v1/tasks", {"task": "check the shelves"}
+        )
+        assert status == 200 and json.loads(body)["success"] is True
     finally:
         stop.set()
         await asyncio.wait_for(task, timeout=30)

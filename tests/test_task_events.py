@@ -1,0 +1,156 @@
+"""Task event bus: live lifecycle feed from Serve, rollup across
+decomposition, and the server's SSE task stream."""
+
+import asyncio
+import json
+
+import pytest
+
+from pilottai_tpu.core.agent import BaseAgent
+from pilottai_tpu.core.config import AgentConfig, LLMConfig, ServeConfig
+from pilottai_tpu.engine.handler import LLMHandler
+from pilottai_tpu.engine.mock import MockBackend
+from pilottai_tpu.serve import Serve
+
+
+def _mock_llm(**kwargs) -> LLMHandler:
+    return LLMHandler(LLMConfig(provider="mock"), backend=MockBackend(**kwargs))
+
+
+def _drain(q: asyncio.Queue):
+    out = []
+    while not q.empty():
+        out.append(q.get_nowait())
+    return out
+
+
+@pytest.mark.asyncio
+async def test_event_sequence_simple_task():
+    llm = _mock_llm()
+    serve = Serve(
+        name="events", manager_llm=llm,
+        agents=[BaseAgent(
+            config=AgentConfig(role="worker", specializations=["generic"]),
+            llm=llm,
+        )],
+        config=ServeConfig(decomposition_enabled=False),
+    )
+    await serve.start()
+    try:
+        task = serve.prepare_task("count the widgets")
+        q = serve.subscribe_events(task.id)
+        result = await serve.execute_task(task)
+        assert result.success
+        events = [e["event"] for e in _drain(q)]
+        # Core lifecycle, in order (step events may interleave).
+        order = [e for e in events
+                 if e in ("received", "analyzed", "queued", "assigned",
+                          "completed")]
+        assert order == ["received", "analyzed", "queued", "assigned",
+                         "completed"]
+        assert "step" in events  # agent step_callback wired by default
+    finally:
+        await serve.stop()
+        serve.unsubscribe_events(task.id, q)
+
+
+@pytest.mark.asyncio
+async def test_subtask_events_roll_up_to_parent():
+    def force_decomposition(prompt):
+        if '"requires_decomposition"' in prompt:
+            return {"requires_decomposition": True, "complexity": 7,
+                    "estimated_resources": {}}
+        return None  # fall through to protocol defaults (incl. subtasks)
+
+    llm = _mock_llm(responders=[force_decomposition])
+    serve = Serve(
+        name="rollup", manager_llm=llm,
+        agents=[BaseAgent(
+            config=AgentConfig(role="worker", specializations=["generic"]),
+            llm=llm,
+        )],
+        config=ServeConfig(decomposition_enabled=True),
+    )
+    await serve.start()
+    try:
+        task = serve.prepare_task("produce the annual report")
+        q = serve.subscribe_events(task.id)
+        result = await serve.execute_task(task, timeout=60)
+        assert result.success
+        events = _drain(q)
+        kinds = [e["event"] for e in events]
+        assert "decomposed" in kinds
+        # Subtask lifecycle surfaced through the PARENT subscription.
+        sub_ids = {e["task_id"] for e in events if e["task_id"] != task.id}
+        assert len(sub_ids) >= 3  # the mock decomposes into 3 subtasks
+        assert any(
+            e["event"] == "completed" and e["task_id"] in sub_ids
+            for e in events
+        )
+    finally:
+        await serve.stop()
+        serve.unsubscribe_events(task.id, q)
+
+
+@pytest.mark.asyncio
+async def test_slow_subscriber_drops_oldest_not_blocks():
+    llm = _mock_llm()
+    serve = Serve(
+        name="ring", manager_llm=llm,
+        agents=[BaseAgent(
+            config=AgentConfig(role="worker", specializations=["generic"]),
+            llm=llm,
+        )],
+        config=ServeConfig(decomposition_enabled=False),
+    )
+    await serve.start()
+    try:
+        task = serve.prepare_task("tiny buffer")
+        q = serve.subscribe_events(task.id, max_buffer=1)
+        result = await serve.execute_task(task)
+        assert result.success
+        events = _drain(q)
+        assert len(events) == 1  # ring kept only the newest
+        assert events[0]["event"] == "completed"
+    finally:
+        await serve.stop()
+        serve.unsubscribe_events(task.id, q)
+
+
+@pytest.mark.asyncio
+async def test_server_task_stream_sse():
+    from pilottai_tpu.server import APIServer
+    from tests.test_server import _request
+
+    llm = _mock_llm()
+    serve = Serve(
+        name="sse-tasks", manager_llm=llm,
+        agents=[BaseAgent(
+            config=AgentConfig(role="worker", specializations=["generic"]),
+            llm=llm,
+        )],
+        config=ServeConfig(decomposition_enabled=False),
+    )
+    await serve.start()
+    server = await APIServer(llm, serve=serve).start()
+    try:
+        status, hdrs, body = await _request(
+            server.port, "POST", "/v1/tasks",
+            {"task": "stream the lifecycle", "stream": True},
+        )
+        assert status == 200
+        assert hdrs["content-type"] == "text/event-stream"
+        events = [
+            line[len("data: "):]
+            for line in body.decode().split("\n")
+            if line.startswith("data: ")
+        ]
+        assert events[-1] == "[DONE]"
+        parsed = [json.loads(e) for e in events[:-1]]
+        kinds = [p.get("event") for p in parsed if "event" in p]
+        assert "received" in kinds and "completed" in kinds
+        final = parsed[-1]
+        assert final.get("object") == "task.result" and final["success"]
+    finally:
+        await server.stop()
+        await serve.stop()
