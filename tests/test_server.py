@@ -376,6 +376,18 @@ async def test_embeddings_503_without_embedder():
 
 
 @pytest.mark.asyncio
+async def test_serving_endpoint_example():
+    """The examples/serving_endpoint demo runs end to end on mock."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from examples.serving_endpoint.main import main as demo_main
+
+    assert await demo_main("mock", "llama3-1b-byte") == 0
+
+
+@pytest.mark.asyncio
 async def test_json_mode_response_format():
     server = await APIServer(_mock_handler()).start()
     try:
