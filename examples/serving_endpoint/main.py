@@ -136,7 +136,8 @@ async def main(provider: str, model: str) -> int:
               f"output={str(result['output'])[:60]!r}")
         return 0
     finally:
-        await server.stop()
+        if server is not None:
+            await server.stop()
         await serve.stop()
         await llm.stop()
 

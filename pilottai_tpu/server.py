@@ -72,14 +72,25 @@ class APIServer:
 
     def __init__(
         self,
-        handler: Any,                    # LLMHandler (duck-typed for tests)
+        handler: Any,                    # LLMHandler, or {model_name: LLMHandler}
         serve: Optional[Any] = None,     # Serve orchestrator for /v1/tasks
         embedder: Optional[Any] = None,  # memory.Embedder for /v1/embeddings
         host: str = "127.0.0.1",
         port: int = 0,
         auth_token: Optional[str] = None,
     ) -> None:
-        self.handler = handler
+        # Multi-model serving: a dict maps the request's ``model`` field
+        # to a handler (unknown names 404, OpenAI ``model_not_found``).
+        # A single handler serves every request regardless of ``model``
+        # — the common one-model deployment.
+        if isinstance(handler, dict):
+            if not handler:
+                raise ValueError("handler dict must not be empty")
+            self.handlers: Dict[str, Any] = dict(handler)
+            self.handler = next(iter(handler.values()))  # default
+        else:
+            self.handlers = {}
+            self.handler = handler
         self.serve = serve
         self.embedder = embedder
         self.host = host
@@ -87,6 +98,17 @@ class APIServer:
         self.auth_token = auth_token
         self._server: Optional[asyncio.AbstractServer] = None
         self._log = get_logger("server")
+
+    def _pick_handler(self, model: Optional[str]) -> Any:
+        if not self.handlers or model is None:
+            return self.handler
+        try:
+            return self.handlers[model]
+        except KeyError:
+            raise _HttpError(
+                404, f"model {model!r} not found; available: "
+                f"{sorted(self.handlers)}", "model_not_found",
+            ) from None
 
     # ------------------------------------------------------------------ #
 
@@ -255,8 +277,12 @@ class APIServer:
         if path == "/healthz" and method == "GET":
             await self._send(writer, 200, {"status": "ok"})
         elif path == "/metrics" and method == "GET":
+            handler_metrics = (
+                {n: _jsonable(h.get_metrics()) for n, h in self.handlers.items()}
+                if self.handlers else _jsonable(self.handler.get_metrics())
+            )
             await self._send(writer, 200, {
-                "handler": _jsonable(self.handler.get_metrics()),
+                "handler": handler_metrics,
                 "global": _jsonable(global_metrics.snapshot()),
             })
         elif path == "/v1/models" and method == "GET":
@@ -277,17 +303,21 @@ class APIServer:
             raise _HttpError(404, f"no route for {method} {path}")
 
     def _models(self) -> Dict[str, Any]:
-        try:
-            from pilottai_tpu.models.registry import list_models
+        if self.handlers:
+            # Multi-model mode: the servable set IS the route map.
+            names = sorted(self.handlers)
+        else:
+            try:
+                from pilottai_tpu.models.registry import list_models
 
-            names = list_models()
-        except Exception:  # noqa: BLE001 — registry is engine-optional
-            names = []
-        configured = getattr(
-            getattr(self.handler, "config", None), "model_name", None
-        )
-        if configured and configured not in names:
-            names = [configured] + names
+                names = list_models()
+            except Exception:  # noqa: BLE001 — registry is engine-optional
+                names = []
+            configured = getattr(
+                getattr(self.handler, "config", None), "model_name", None
+            )
+            if configured and configured not in names:
+                names = [configured] + names
         return {
             "object": "list",
             "data": [{"id": n, "object": "model", "owned_by": "pilottai-tpu"}
@@ -363,8 +393,9 @@ class APIServer:
         self, req: Dict[str, Any], writer: asyncio.StreamWriter
     ) -> None:
         messages, tools, params = self._gen_params(req)
+        handler = self._pick_handler(req.get("model"))
         model = req.get("model") or getattr(
-            getattr(self.handler, "config", None), "model_name", "default"
+            getattr(handler, "config", None), "model_name", "default"
         )
         rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
         created = int(time.time())
@@ -385,7 +416,7 @@ class APIServer:
             try:
                 chunk({"role": "assistant"}, None)
                 text_parts: List[str] = []
-                async for delta in self.handler.astream(
+                async for delta in handler.astream(
                     messages, tools=tools, params=params
                 ):
                     text_parts.append(delta)
@@ -419,7 +450,7 @@ class APIServer:
             await self._sse_done(writer)
             return
 
-        response = await self.handler.generate_response(
+        response = await handler.generate_response(
             messages, tools=tools, params=params
         )
         message: Dict[str, Any] = {

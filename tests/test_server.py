@@ -162,6 +162,57 @@ async def test_stream_with_tools_emits_tool_call_delta():
 
 
 @pytest.mark.asyncio
+async def test_multi_model_routing():
+    """A handler dict routes by the request's model field; unknown
+    models 404 with OpenAI's model_not_found type."""
+    server = await APIServer({
+        "alpha": LLMHandler(
+            LLMConfig(provider="mock", model_name="alpha"),
+            backend=MockBackend(script=["from alpha"], model_name="alpha"),
+        ),
+        "beta": LLMHandler(
+            LLMConfig(provider="mock", model_name="beta"),
+            backend=MockBackend(script=["from beta"], model_name="beta"),
+        ),
+    }).start()
+    try:
+        status, _, body = await _request(
+            server.port, "POST", "/v1/chat/completions",
+            {"model": "beta",
+             "messages": [{"role": "user", "content": "x"}]},
+        )
+        assert status == 200
+        assert json.loads(body)["choices"][0]["message"]["content"] == "from beta"
+
+        status, _, body = await _request(
+            server.port, "POST", "/v1/chat/completions",
+            {"model": "gamma",
+             "messages": [{"role": "user", "content": "x"}]},
+        )
+        assert status == 404
+        assert json.loads(body)["error"]["type"] == "model_not_found"
+
+        # /v1/models lists exactly the servable set.
+        status, _, body = await _request(server.port, "GET", "/v1/models")
+        ids = [m["id"] for m in json.loads(body)["data"]]
+        assert ids == ["alpha", "beta"]
+
+        # Omitted model falls to the default (first) handler.
+        status, _, body = await _request(
+            server.port, "POST", "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "x"}]},
+        )
+        assert status == 200
+        assert json.loads(body)["choices"][0]["message"]["content"] == "from alpha"
+
+        # Per-model metrics in multi-model mode.
+        status, _, body = await _request(server.port, "GET", "/metrics")
+        assert set(json.loads(body)["handler"]) == {"alpha", "beta"}
+    finally:
+        await server.stop()
+
+
+@pytest.mark.asyncio
 async def test_models_health_metrics():
     server = await APIServer(_mock_handler()).start()
     try:
