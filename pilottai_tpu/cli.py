@@ -65,8 +65,169 @@ def _build_parser() -> argparse.ArgumentParser:
     s.add_argument("--dashboard-port", type=int, default=None,
                    help="also start the HTML metrics dashboard")
 
+    t = sub.add_parser("train", help="mesh-parallel training run")
+    t.add_argument("--model", default="llama-tiny")
+    t.add_argument("--steps", type=int, default=100)
+    t.add_argument("--batch-size", type=int, default=8)
+    t.add_argument("--seq-len", type=int, default=128)
+    t.add_argument("--learning-rate", type=float, default=3e-4)
+    t.add_argument("--warmup-steps", type=int, default=10)
+    t.add_argument("--seed", type=int, default=0)
+    t.add_argument("--mesh", default=None, metavar="AXES",
+                   help="mesh axes, e.g. 'fsdp=4,model=2' "
+                        "(default: auto over all devices)")
+    t.add_argument("--context-parallel", action="store_true",
+                   help="ring attention over the mesh's seq axis")
+    t.add_argument("--data", default=None, metavar="FILE",
+                   help="UTF-8 text corpus, byte-tokenized into fixed "
+                        "rows (default: deterministic synthetic batches)")
+    t.add_argument("--checkpoint-dir", default=None)
+    t.add_argument("--save-every", type=int, default=50)
+    t.add_argument("--resume", action="store_true",
+                   help="restore the latest checkpoint before training")
+    t.add_argument("--log-every", type=int, default=10)
+
     sub.add_parser("models", help="list registry models")
     return p
+
+
+def _parse_mesh(spec: str | None):
+    """'fsdp=4,model=2' → a 4-axis Mesh; None → auto layout."""
+    from pilottai_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    if not spec:
+        return create_mesh()
+    axes = {}
+    for part in spec.split(","):
+        k, _, v = part.partition("=")
+        if k.strip() not in ("data", "fsdp", "model", "seq"):
+            raise SystemExit(
+                f"unknown mesh axis {k.strip()!r} "
+                "(use data/fsdp/model/seq)"
+            )
+        try:
+            n = int(v)
+        except ValueError:
+            raise SystemExit(
+                f"mesh axis {k.strip()}={v!r} is not an integer"
+            ) from None
+        if n < 1:
+            raise SystemExit(f"mesh axis {k.strip()} must be >= 1, got {n}")
+        axes[k.strip()] = n
+    return create_mesh(MeshConfig(**axes))
+
+
+def _text_batches(path: str, vocab_cap: int, batch_size: int, seq_len: int):
+    """Byte-tokenized fixed-length rows over a text corpus, cycling."""
+    from pathlib import Path
+
+    import numpy as np
+
+    from pilottai_tpu.engine.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    ids = np.asarray(
+        tok.encode(
+            Path(path).read_text(encoding="utf-8", errors="replace"),
+            add_bos=False,
+        ),
+        np.int32,
+    ) % vocab_cap
+    if len(ids) == 0:
+        raise SystemExit(f"empty corpus: {path}")
+    if len(ids) < batch_size * seq_len:
+        reps = -(-(batch_size * seq_len) // max(len(ids), 1))
+        ids = np.tile(ids, reps)
+    pos = 0
+    while True:
+        rows = []
+        for _ in range(batch_size):
+            if pos + seq_len > len(ids):
+                pos = 0
+            rows.append(ids[pos: pos + seq_len])
+            pos += seq_len
+        yield {
+            "tokens": np.stack(rows),
+            "valid": np.full((batch_size,), seq_len, np.int32),
+        }
+
+
+def run_train(args) -> int:
+    """Training entry point: synthetic or text-corpus next-token LM on
+    a sharded mesh, with optional checkpoint save/resume."""
+    import time
+
+    import jax
+
+    from pilottai_tpu.models.registry import get_model_config
+    from pilottai_tpu.train.trainer import (
+        TrainConfig,
+        Trainer,
+        synthetic_batches,
+    )
+
+    if args.resume and not args.checkpoint_dir:
+        raise SystemExit("--resume requires --checkpoint-dir")
+    model_cfg = get_model_config(args.model)
+    mesh = _parse_mesh(args.mesh)
+    trainer = Trainer(
+        model_cfg,
+        TrainConfig(
+            learning_rate=args.learning_rate,
+            warmup_steps=args.warmup_steps,
+            total_steps=args.steps,
+            context_parallel=args.context_parallel,
+        ),
+        mesh=mesh,
+    )
+    print(f"training {args.model} on mesh {dict(mesh.shape)}",
+          file=sys.stderr, flush=True)
+    state = trainer.init(jax.random.key(args.seed))
+
+    ckpt = None
+    start_step = 0
+    if args.checkpoint_dir:
+        from pilottai_tpu.checkpoint.train_io import TrainCheckpointer
+
+        ckpt = TrainCheckpointer(args.checkpoint_dir)
+        if args.resume and ckpt.latest_step() is not None:
+            state, start_step = ckpt.restore(state)
+            print(f"resumed from step {start_step}", file=sys.stderr)
+
+    batches = (
+        _text_batches(args.data, model_cfg.vocab_size,
+                      args.batch_size, args.seq_len)
+        if args.data
+        else synthetic_batches(model_cfg, args.batch_size, args.seq_len,
+                               seed=args.seed)
+    )
+    # Resume fast-forwards the data stream: without this, steps after a
+    # restore would re-train on the exact batches steps 0..start_step
+    # already consumed and diverge from an uninterrupted run.
+    for _ in range(start_step):
+        next(batches)
+    t0 = time.perf_counter()
+    last = None
+    last_saved = start_step
+    for step in range(start_step, args.steps):
+        state, metrics = trainer.step(state, next(batches))
+        if (step + 1) % args.log_every == 0 or step + 1 == args.steps:
+            loss = float(metrics["loss"])
+            rate = (step + 1 - start_step) / (time.perf_counter() - t0)
+            print(f"step {step + 1}/{args.steps} loss {loss:.4f} "
+                  f"({rate:.2f} steps/s)", flush=True)
+            last = loss
+        if ckpt is not None and (step + 1) % args.save_every == 0:
+            ckpt.save(step + 1, state)
+            last_saved = step + 1
+    # Final save only when the run actually advanced past the last save
+    # (a redundant rewrite is gigabytes of I/O for a sharded model; and
+    # resuming with --steps <= the restored step must never relabel the
+    # restored weights under a smaller step number).
+    if ckpt is not None and start_step < args.steps and last_saved != args.steps:
+        ckpt.save(args.steps, state)
+    print(f"done; final loss {last}", file=sys.stderr)
+    return 0
 
 
 async def run_serve(args, ready: Optional[asyncio.Event] = None,
@@ -186,6 +347,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         for name in list_models():
             print(name)
         return 0
+    if args.command == "train":
+        return run_train(args)
     if args.command == "serve":
         try:
             asyncio.run(run_serve(args))

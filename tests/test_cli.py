@@ -27,6 +27,50 @@ def test_serve_args_parse():
     assert args.max_seq == 4096
 
 
+def test_train_command_synthetic(tmp_path, capsys):
+    rc = main([
+        "train", "--model", "llama-tiny", "--steps", "4",
+        "--batch-size", "2", "--seq-len", "32", "--log-every", "2",
+        "--checkpoint-dir", str(tmp_path / "ckpt"), "--save-every", "2",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "step 4/4 loss" in out
+    # Checkpoints landed (steps 2 and 4).
+    assert (tmp_path / "ckpt").exists()
+
+    # Resume restores the latest step and continues to the new target.
+    rc = main([
+        "train", "--model", "llama-tiny", "--steps", "6",
+        "--batch-size", "2", "--seq-len", "32", "--log-every", "2",
+        "--checkpoint-dir", str(tmp_path / "ckpt"), "--resume",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "step 6/6 loss" in out
+
+
+def test_train_command_text_corpus(tmp_path, capsys):
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text("the quick brown fox jumps over the lazy dog. " * 50)
+    rc = main([
+        "train", "--model", "llama-tiny", "--steps", "3",
+        "--batch-size", "2", "--seq-len", "32", "--log-every", "3",
+        "--data", str(corpus),
+    ])
+    assert rc == 0
+    assert "step 3/3 loss" in capsys.readouterr().out
+
+
+def test_parse_mesh_rejects_unknown_axis():
+    from pilottai_tpu.cli import _parse_mesh
+
+    with pytest.raises(SystemExit):
+        _parse_mesh("bogus=2")
+    mesh = _parse_mesh("fsdp=2,model=2")
+    assert dict(mesh.shape) == {"data": 1, "fsdp": 2, "model": 2, "seq": 1}
+
+
 @pytest.mark.asyncio
 async def test_serve_loop_mock_end_to_end():
     args = _build_parser().parse_args(
