@@ -168,6 +168,8 @@ def run_train(args) -> int:
 
     if args.resume and not args.checkpoint_dir:
         raise SystemExit("--resume requires --checkpoint-dir")
+    if args.save_every < 1 or args.log_every < 1:
+        raise SystemExit("--save-every and --log-every must be >= 1")
     model_cfg = get_model_config(args.model)
     mesh = _parse_mesh(args.mesh)
     trainer = Trainer(

@@ -233,17 +233,32 @@ class NativeEngine(LLMBackend):
         tools: Optional[Sequence[ToolSpec]],
         params: GenerationParams,
     ) -> GenRequest:
-        prompt = render_chat(messages)
+        tool_text = None
         if tools:
             tool_desc = "\n".join(f"- {t.name}: {t.description}" for t in tools)
-            prompt = (
+            tool_text = (
                 f"Available tools:\n{tool_desc}\n\n"
                 'To invoke one, reply {"tool_call": {"name": ..., '
                 '"arguments": {...}}} or {"action": <tool name>, '
-                '"arguments": {...}}.\n\n'
-                f"{prompt}"
+                '"arguments": {...}}.'
             )
-        prompt_ids = self.tokenizer.encode(prompt)
+        # Checkpoint-native chat rendering first (HF chat_template via
+        # the tokenizer; instruct models are fine-tuned on their own
+        # header format) — the tool preamble rides as a system turn.
+        # Byte tokenizers and template-less checkpoints fall back to the
+        # generic transcript, byte-identical to previous behavior.
+        msg_dicts = [{"role": m.role, "content": m.content} for m in messages]
+        if tool_text:
+            msg_dicts = [{"role": "system", "content": tool_text}] + msg_dicts
+        rendered = self.tokenizer.render_chat(msg_dicts)
+        if rendered is not None:
+            # Templates emit their own BOS text; add_bos would double it.
+            prompt_ids = self.tokenizer.encode(rendered, add_bos=False)
+        else:
+            prompt = render_chat(messages)
+            if tool_text:
+                prompt = f"{tool_text}\n\n{prompt}"
+            prompt_ids = self.tokenizer.encode(prompt)
         return GenRequest(
             prompt_ids=prompt_ids,
             max_new_tokens=params.max_new_tokens,

@@ -39,6 +39,15 @@ class Tokenizer(abc.ABC):
         (engine/json_mask.py:token_byte_table)."""
         return None
 
+    def render_chat(self, messages) -> Optional[str]:
+        """Model-specific chat rendering for ``[{role, content}, ...]``,
+        or None when the tokenizer has no template — the engine then
+        falls back to the generic ``<|role|>`` transcript
+        (engine/base.py:render_chat). Real checkpoints care: a Llama-3
+        instruct model fine-tuned on its header format produces garbage
+        on any other framing."""
+        return None
+
 
 class ByteTokenizer(Tokenizer):
     """Byte-level tokenizer: ids 0..255 are raw bytes; specials follow.
@@ -118,6 +127,28 @@ class HFTokenizer(Tokenizer):
             list(ids), skip_special_tokens=True,
             clean_up_tokenization_spaces=False,
         )
+
+    def render_chat(self, messages) -> Optional[str]:
+        """Apply the checkpoint's own chat template when it ships one
+        (``tokenizer_config.json``'s ``chat_template``). Returns the
+        rendered PROMPT (generation prompt appended) as text — encode()
+        then tokenizes it like any other prompt. None when the local
+        tokenizer has no template or rendering fails (never guess a
+        format for an instruct model)."""
+        if not getattr(self._tok, "chat_template", None):
+            return None
+        try:
+            return self._tok.apply_chat_template(
+                [
+                    {"role": m.get("role", "user"),
+                     "content": m.get("content", "")}
+                    for m in messages
+                ],
+                tokenize=False,
+                add_generation_prompt=True,
+            )
+        except Exception:  # noqa: BLE001 — fall back to generic framing
+            return None
 
     def token_bytes(self, i: int) -> Optional[bytes]:
         """Derive token i's decoded byte string by anchored difference:

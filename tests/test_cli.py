@@ -36,8 +36,11 @@ def test_train_command_synthetic(tmp_path, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "step 4/4 loss" in out
-    # Checkpoints landed (steps 2 and 4).
-    assert (tmp_path / "ckpt").exists()
+    # Checkpoints actually landed (steps 2 and 4) — the dir alone is
+    # created by the constructor and proves nothing.
+    from pilottai_tpu.checkpoint.train_io import TrainCheckpointer
+
+    assert TrainCheckpointer(tmp_path / "ckpt").all_steps() == [2, 4]
 
     # Resume restores the latest step and continues to the new target.
     rc = main([
