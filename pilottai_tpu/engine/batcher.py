@@ -75,6 +75,10 @@ class GenRequest:
     # automaton for byte tokenizers, token→byte product for subword ones
     # (the batcher's json_tables).
     json_mode: bool = False
+    # Schema-constrained decoding: row into the engine's SchemaBank
+    # (engine/json_schema.py), -1 = generic grammar. Byte tokenizers
+    # only; implies json_mode.
+    json_schema_id: int = -1
     stop_ids: List[int] = field(default_factory=list)
     future: Future = field(default_factory=Future)
     submitted_at: float = field(default_factory=time.perf_counter)
@@ -136,6 +140,7 @@ class ContinuousBatcher:
         kv_quantize: bool = False,  # int8 cache panels + per-token scales
         draft_layers: int = 0,  # shallow-layer self-drafting (adaptive)
         pipeline_depth: int = 2,  # decode chunks in flight (tunnel hiding)
+        schema_bank: Optional[Any] = None,  # json_schema.SchemaBank
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -199,6 +204,12 @@ class ContinuousBatcher:
             tuple(jnp.asarray(t) for t in json_tables)
             if json_tables is not None else None
         )
+        # Schema-constrained decoding: compiled DFA bank shared by all
+        # slots; device copies refresh lazily when the bank version moves
+        # (a few MB uploaded once per NEW schema, not per dispatch).
+        self.schema_bank = schema_bank
+        self._schema_dev: Optional[Tuple[Any, Any, Any]] = None
+        self._schema_seen = -1
 
         # Speculative decoding: verify-blocks of ``speculate`` tokens per
         # weight pass (engine/decode.py:decode_chunk_spec) — both caches
@@ -637,6 +648,7 @@ class ContinuousBatcher:
         eos = np.full((A,), -1, np.int32)
         budgets = np.zeros((A,), np.int32)
         jsonm = np.zeros((A,), bool)
+        schema_rows = np.full((A,), -1, np.int32)
         for row, (idx, req) in enumerate(group):
             slots[row] = idx
             temps[row] = req.temperature
@@ -645,6 +657,7 @@ class ContinuousBatcher:
             seeds[row] = req.seed
             eos[row] = req.eos_id
             jsonm[row] = req.json_mode
+            schema_rows[row] = req.json_schema_id
             budgets[row] = req.max_new_tokens - 1
         # Bake the token tables into this dispatch only when the group
         # actually constrains: with a 128k-vocab the B x V x L automaton
@@ -654,6 +667,14 @@ class ContinuousBatcher:
             self.json_tables
             if any(req.json_mode for _, req in group) else None
         )
+        # Schema tables/ids ride only when the group has a schema slot
+        # (same two-variant discipline as the token tables).
+        if (schema_rows >= 0).any():
+            group_schema = self._schema_tables()
+            group_sids = jnp.asarray(schema_rows)
+        else:
+            group_schema = None
+            group_sids = None
 
         if entry is not None and self.page_index is not None:
             # Paged block-granular hit: the shared chain's pages are
@@ -699,6 +720,7 @@ class ContinuousBatcher:
                     jnp.asarray(eos), jnp.asarray(jsonm),
                     jnp.asarray(budgets), n_prefix_bucket=kb,
                     json_tables=group_json, history=self.history,
+                    schema_ids=group_sids, schema_tables=group_schema,
                 )
             global_metrics.inc("engine.prefix_hits", len(group))
             # Blocks past the shared chain that the prompt fully covers
@@ -738,6 +760,7 @@ class ContinuousBatcher:
                     jnp.asarray(seeds), jnp.asarray(eos),
                     jnp.asarray(jsonm), jnp.asarray(budgets),
                     json_tables=group_json, history=self.history,
+                    schema_ids=group_sids, schema_tables=group_schema,
                 )
             global_metrics.inc("engine.prefix_hits", len(group))
         else:
@@ -776,6 +799,7 @@ class ContinuousBatcher:
                     use_flash=self.on_tpu, flash_mesh=self.flash_mesh,
                     page_rows=page_rows, json_tables=group_json,
                     history=self.history,
+                    schema_ids=group_sids, schema_tables=group_schema,
                 )
             if self.paged:
                 self._maybe_register(group)
@@ -800,6 +824,22 @@ class ContinuousBatcher:
                 ([(idx, self._gen[idx]) for idx, _ in group], first)
             )
         global_metrics.inc("engine.admitted", len(group))
+
+    def _schema_tables(self):
+        """Device copies of the SchemaBank tables, refreshed when the
+        bank gained a schema (device thread only)."""
+        bank = self.schema_bank
+        if bank is None or len(bank) == 0:
+            return None
+        if bank.version != self._schema_seen:
+            # Snapshot the version BEFORE copying: register() on the
+            # request thread mutates rows first and bumps version last,
+            # so reading version after the copy could mark a torn
+            # mid-registration copy as current forever.
+            seen = bank.version
+            self._schema_dev = tuple(jnp.asarray(t) for t in bank.tables())
+            self._schema_seen = seen
+        return self._schema_dev
 
     def _maybe_register(self, group: List[Tuple[int, GenRequest]]) -> None:
         """After a paged admission (miss or hit), pin the admitted
@@ -989,6 +1029,13 @@ class ContinuousBatcher:
                 s is not None and s.request.json_mode for s in self._slots
             ) else None
         )
+        chunk_schema = (
+            self._schema_tables()
+            if any(
+                s is not None and s.request.json_schema_id >= 0
+                for s in self._slots
+            ) else None
+        )
         with global_metrics.timer("engine.chunk_dispatch_latency"):
             if self.speculate:
                 (
@@ -998,7 +1045,8 @@ class ContinuousBatcher:
                     self.params, self.cfg, self.cache, self.dstate,
                     self.sampling, self.history, self.chunk_size,
                     self.speculate, prefix_bound=prefix_bound,
-                    json_tables=chunk_json, table=table,
+                    json_tables=chunk_json, schema_tables=chunk_schema,
+                    table=table,
                     use_pallas=self.paged and use_pallas_now,
                     draft_layers=self.draft_layers,
                     draft_mode=(
@@ -1012,7 +1060,7 @@ class ContinuousBatcher:
                         self.params, self.cfg, self.cache, self.dstate,
                         self.sampling, self.chunk_size, use_pallas_now,
                         prefix_bound=prefix_bound, table=table,
-                        json_tables=chunk_json,
+                        json_tables=chunk_json, schema_tables=chunk_schema,
                     )
                 )
         # Start the D2H transfer as soon as the chunk finishes computing,

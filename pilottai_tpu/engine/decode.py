@@ -255,6 +255,8 @@ def decode_chunk(
     prefix_bound: Optional[int] = None,
     table: Optional[jax.Array] = None,  # [B, max_pages] — paged cache only
     json_tables: Optional[Tuple[jax.Array, jax.Array]] = None,
+    schema_tables: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+    # ^ SchemaBank (ALLOWED, NEXT, MINCOST) — schema-constrained slots
     # ^ (token_bytes [Vt, L], token_len [Vt]) — subword JSON grammar mask
 ) -> Tuple[jax.Array, jax.Array, KVCache, DecodeState, SamplingState]:
     """Run ``n_steps`` decode steps for every slot in one dispatch.
@@ -393,6 +395,7 @@ def decode_chunk(
         sampled, sampling = sample_core(
             logits, sampling, json_remaining=budget,
             json_token_tables=json_tables,
+            json_schema_tables=schema_tables,
         )
         new_budget = budget - active.astype(jnp.int32)
         hit_eos = (sampling.eos_id >= 0) & (sampled == sampling.eos_id)
@@ -789,6 +792,7 @@ def decode_chunk_spec(
     draft_len: int,          # D >= 2: block width (1 current + D-1 drafts)
     prefix_bound: Optional[int] = None,
     json_tables: Optional[Tuple[jax.Array, jax.Array]] = None,
+    schema_tables: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
     table: Optional[jax.Array] = None,  # [B, max_pages] — paged cache only
     use_pallas: bool = False,           # paged prefix reads via the Pallas
                                         # kernel (TPU); else gather fallback
@@ -964,6 +968,7 @@ def decode_chunk_spec(
         tok0, sampling = sample_core(
             logits[:, 0], sampling, json_remaining=budget,
             json_token_tables=json_tables,
+            json_schema_tables=schema_tables,
         )
         # Rows 1..D-1: masked greedy with coords advanced along the DRAFT
         # path (rows only matter while drafts keep being accepted, and
@@ -972,10 +977,13 @@ def decode_chunk_spec(
         g_rows = [tok0]
         coords = pre_row0
         for j in range(1, D):
-            coords = _advance_json(coords, blk[:, j], json_tables)
+            coords = _advance_json(
+                coords, blk[:, j], json_tables, schema_tables
+            )
             row = _apply_json_mask(
                 logits[:, j], coords,
                 remaining=budget - j, token_tables=json_tables,
+                schema_tables=schema_tables,
             )
             g_rows.append(jnp.argmax(row, axis=-1).astype(jnp.int32))
         emitted = jnp.stack(g_rows, axis=1)               # [B, D]
@@ -1018,7 +1026,9 @@ def decode_chunk_spec(
         # Json coords: row 0 already advanced inside sample_core; advance
         # by the remaining emitted tokens.
         for j in range(1, D):
-            stepped = _advance_json(sampling, emitted[:, j], json_tables)
+            stepped = _advance_json(
+                sampling, emitted[:, j], json_tables, schema_tables
+            )
             take = emit_mask[:, j]
             sampling = sampling._replace(
                 json_state=jnp.where(take, stepped.json_state, sampling.json_state),
@@ -1256,6 +1266,8 @@ def admit_group_prefix(
     jsonm: jax.Array,
     budgets: jax.Array,
     json_tables: Optional[Tuple[jax.Array, jax.Array]] = None,
+    schema_ids: Optional[jax.Array] = None,  # [A] SchemaBank rows (-1 none)
+    schema_tables: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
     history: Optional[jax.Array] = None,
 ):
     """Admission with a cached prefix: copy the prefix K/V into each
@@ -1336,11 +1348,12 @@ def admit_group_prefix(
     )
 
     sampling = admit_sampling(
-        sampling, slots, temps, topks, topps, seeds, eos, jsonm
+        sampling, slots, temps, topks, topps, seeds, eos, jsonm,
+        schema_ids=schema_ids,
     )
     first, sampling = sample_prefill_tokens(
         logits, tail_lens, slots, sampling, remaining=budgets + 1,
-        json_tables=json_tables,
+        json_tables=json_tables, schema_tables=schema_tables,
     )
     dstate = admit_decode(dstate, slots, first, budgets, live)
     if history is not None:
@@ -1380,6 +1393,8 @@ def admit_group_prefix_paged(
     budgets: jax.Array,
     n_prefix_bucket: int = 1,
     json_tables: Optional[Tuple[jax.Array, jax.Array]] = None,
+    schema_ids: Optional[jax.Array] = None,
+    schema_tables: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
     history: Optional[jax.Array] = None,
 ):
     """Block-granular prefix-cached admission on the paged pool
@@ -1431,11 +1446,12 @@ def admit_group_prefix_paged(
     )
 
     sampling = admit_sampling(
-        sampling, slots, temps, topks, topps, seeds, eos, jsonm
+        sampling, slots, temps, topks, topps, seeds, eos, jsonm,
+        schema_ids=schema_ids,
     )
     first, sampling = sample_prefill_tokens(
         logits, tail_lens, slots, sampling, remaining=budgets + 1,
-        json_tables=json_tables,
+        json_tables=json_tables, schema_tables=schema_tables,
     )
     dstate = admit_decode(dstate, slots, first, budgets, live)
     if history is not None:
@@ -1529,6 +1545,8 @@ def admit_group(
     flash_mesh: Any = None,
     page_rows: Optional[jax.Array] = None,  # [A, max_pages] — paged cache
     json_tables: Optional[Tuple[jax.Array, jax.Array]] = None,
+    schema_ids: Optional[jax.Array] = None,
+    schema_tables: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
     history: Optional[jax.Array] = None,    # [B, S] — speculative decode
 ):
     """The whole admission path — prefill forward, batched cache write,
@@ -1549,11 +1567,12 @@ def admit_group(
     else:
         cache = write_prompts(cache, slots, ks, vs, lens)
     sampling = admit_sampling(
-        sampling, slots, temps, topks, topps, seeds, eos, jsonm
+        sampling, slots, temps, topks, topps, seeds, eos, jsonm,
+        schema_ids=schema_ids,
     )
     first, sampling = sample_prefill_tokens(
         logits, lens, slots, sampling, remaining=budgets + 1,
-        json_tables=json_tables,
+        json_tables=json_tables, schema_tables=schema_tables,
     )
     dstate = admit_decode(dstate, slots, first, budgets, lens > 0)
     if history is not None:
@@ -1569,6 +1588,7 @@ def sample_prefill_tokens(
     sampling: SamplingState,
     remaining: Optional[jax.Array] = None,  # [A] total generation budget
     json_tables: Optional[Tuple[jax.Array, jax.Array]] = None,
+    schema_tables: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
 ) -> Tuple[jax.Array, SamplingState]:
     """Sample each admitted prompt's first generated token on device,
     using (and advancing) the slot's sampling params — host-side sampling
@@ -1579,7 +1599,8 @@ def sample_prefill_tokens(
     )[:, 0]                                              # [A, V]
     sub = jax.tree.map(lambda a: a[slots], sampling)
     tokens, sub = sample_core(
-        last, sub, json_remaining=remaining, json_token_tables=json_tables
+        last, sub, json_remaining=remaining, json_token_tables=json_tables,
+        json_schema_tables=schema_tables,
     )
     del A
     # Write back everything the sampler advanced: the PRNG keys and the

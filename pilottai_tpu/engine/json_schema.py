@@ -1,0 +1,437 @@
+"""JSON-Schema–constrained decoding: compile a schema to a byte DFA.
+
+Extends the generic JSON grammar masking (``engine/json_mask.py``) from
+"well-formed JSON" to "THIS shape of JSON": the OpenAI
+``response_format: {"type": "json_schema"}`` contract. Without ``$ref``
+recursion a JSON Schema unrolls into a FINITE automaton — arrays loop
+within their own states and every nested object/array has a statically
+known continuation — so no pushdown stack is needed at all. The device
+work per byte stays two gathers (``ALLOWED[state]`` mask,
+``NEXT[state, byte]`` advance), identical in shape to the generic
+tables, and runs inside the jitted decode chunk like everything else.
+
+Output is COMPACT (no optional whitespace) and properties are emitted in
+schema order; properties not listed in ``required`` may be skipped (a
+byte-trie over the still-allowed keys disambiguates). Budget feasibility
+uses ``MINCOST[state]`` — the shortest byte count from a state to the
+accept state (reverse BFS) — masking any byte whose successor could not
+finish within the remaining budget, which is strictly stronger than the
+generic automaton's depth margin.
+
+Supported subset (the agent-protocol shapes and the usual structured-
+output surface): ``object`` with ``properties``/``required`` (no
+``additionalProperties``), ``array`` of a supported item schema,
+``string`` (free-form printable ASCII + escapes), ``number``/
+``integer``, ``boolean``, ``null``, ``enum`` of scalars, ``const``, and
+unions via ``type: [..]``. ``$ref``/``anyOf``/recursion raise
+``UnsupportedSchema`` — callers fall back to generic JSON masking.
+
+Conventions: state 0 is the ACCEPT state (``MINCOST == 0``; the mask
+layer forces EOS there, exactly like the generic ``S_DONE``); state 1 is
+the root start, so admission initializes schema slots to ``json_state=1``
+with no per-schema lookup. The reference has no counterpart — it
+re-prompts on malformed JSON (``pilott/pilott.py:603-639``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+INF = np.int32(2**30)
+
+_PRINTABLE = [b for b in range(0x20, 0x7F)]
+_ESCAPES = [ord(c) for c in '"\\/bfnrt']
+_DIGITS = [ord(c) for c in "0123456789"]
+
+ACC = 0    # accept state (document complete)
+START = 1  # root start state
+
+
+class UnsupportedSchema(ValueError):
+    """Schema uses a feature outside the compiled subset."""
+
+
+class _Builder:
+    """Mutable DFA builder: per-state [256] allow mask + next table."""
+
+    def __init__(self) -> None:
+        self.allowed: List[np.ndarray] = []
+        self.next: List[np.ndarray] = []
+        # Edges whose target is a literal's continuation (external state):
+        # trie insertion must never traverse THROUGH one — a literal that
+        # is a strict prefix of another (e.g. enum [1, 12]) would attach
+        # new edges to the continuation and corrupt it. Rejected instead.
+        self.terminal: set = set()
+        self.new_state()  # ACC = 0 (no outgoing edges)
+        self.new_state()  # START = 1 (root fragment is wired to it)
+
+    def new_state(self) -> int:
+        self.allowed.append(np.zeros((256,), np.bool_))
+        self.next.append(np.zeros((256,), np.int32))
+        return len(self.allowed) - 1
+
+    def edge(self, s: int, bytes_: Any, t: int) -> None:
+        if isinstance(bytes_, (int, np.integer)):
+            bytes_ = [int(bytes_)]
+        for b in bytes_:
+            self.allowed[s][b] = True
+            self.next[s][b] = t
+
+    def chain(self, s: int, text: str, t: int) -> None:
+        """Literal byte chain from ``s`` through fresh states to ``t``.
+        TRIE semantics: existing edges are followed, not overwritten, so
+        several literals inserted from the same state share their common
+        prefix and diverge at the first differing byte (object keys all
+        start with '\"'; enum members may share arbitrary prefixes)."""
+        data = text.encode("utf-8")
+        for i, b in enumerate(data):
+            last = i == len(data) - 1
+            if self.allowed[s][b]:
+                existing = int(self.next[s][b])
+                if last:
+                    # Duplicate identical literal is a no-op; anything
+                    # else is a collision.
+                    if (s, b) not in self.terminal or existing != t:
+                        raise UnsupportedSchema(
+                            "literal collision (duplicate serialization "
+                            "with different continuations)"
+                        )
+                    return
+                if (s, b) in self.terminal:
+                    raise UnsupportedSchema(
+                        f"literal {text!r} extends through another "
+                        "literal's end (prefix-ambiguous literals)"
+                    )
+                s = existing
+            else:
+                nxt = t if last else self.new_state()
+                self.edge(s, b, nxt)
+                if last:
+                    self.terminal.add((s, b))
+                s = nxt
+
+    def copy_state(self, dst: int, src: int) -> None:
+        """Overlay ``src``'s edges onto ``dst`` (used by number states,
+        whose end is implicit: the byte after the number belongs to the
+        continuation)."""
+        sel = self.allowed[src]
+        self.allowed[dst] = self.allowed[dst] | sel
+        self.next[dst] = np.where(sel, self.next[src], self.next[dst])
+
+
+def _string_fragment(b: _Builder, start: int, cont: int) -> None:
+    """'"' chars* '"' from ``start`` to ``cont`` (value string)."""
+    body = b.new_state()
+    esc = b.new_state()
+    b.edge(start, ord('"'), body)
+    plain = [c for c in _PRINTABLE if c not in (ord('"'), ord("\\"))]
+    b.edge(body, plain, body)
+    b.edge(body, ord("\\"), esc)
+    b.edge(esc, _ESCAPES, body)
+    b.edge(body, ord('"'), cont)
+
+
+def _number_fragment(
+    b: _Builder, start: int, cont: int, integer: bool
+) -> None:
+    """JSON number from ``start``; termination is implicit — integer/
+    fraction/exponent states OVERLAY the continuation's edges (the byte
+    after a number belongs to whatever follows; digits never collide
+    with JSON structure bytes). The integer part is ``0 | [1-9][0-9]*``
+    — a leading zero cannot be followed by more digits (JSON grammar;
+    '01' is not valid JSON and the validates-by-construction contract
+    forbids emitting it)."""
+    nonzero = [d for d in _DIGITS if d != ord("0")]
+    int_digits = b.new_state()   # [1-9][0-9]*
+    zero = b.new_state()         # lone leading 0
+    neg = b.new_state()
+    b.edge(start, ord("-"), neg)
+    for s in (start, neg):
+        b.edge(s, ord("0"), zero)
+        b.edge(s, nonzero, int_digits)
+    b.edge(int_digits, _DIGITS, int_digits)
+    terminal = [int_digits, zero]
+    if not integer:
+        frac = b.new_state()
+        frac_digits = b.new_state()
+        for s in (int_digits, zero):
+            b.edge(s, ord("."), frac)
+        b.edge(frac, _DIGITS, frac_digits)
+        b.edge(frac_digits, _DIGITS, frac_digits)
+        exp = b.new_state()
+        exp_sign = b.new_state()
+        exp_digits = b.new_state()
+        for s in (int_digits, zero, frac_digits):
+            b.edge(s, [ord("e"), ord("E")], exp)
+        b.edge(exp, [ord("+"), ord("-")], exp_sign)
+        b.edge(exp, _DIGITS, exp_digits)
+        b.edge(exp_sign, _DIGITS, exp_digits)
+        b.edge(exp_digits, _DIGITS, exp_digits)
+        terminal += [frac_digits, exp_digits]
+    for s in terminal:
+        b.copy_state(s, cont)
+
+
+def _literal_value(b: _Builder, start: int, value: Any, cont: int) -> None:
+    """A ``const``/``enum`` member as its exact JSON serialization."""
+    b.chain(start, json.dumps(value), cont)
+
+
+def _compile_value(
+    b: _Builder, schema: Dict[str, Any], start: int, cont: int, depth: int
+) -> None:
+    """Wire ``start ─(one value matching schema)→ cont``."""
+    if depth > 32:
+        raise UnsupportedSchema("schema nesting too deep (>32)")
+    if not isinstance(schema, dict):
+        raise UnsupportedSchema(f"schema must be an object, got {schema!r}")
+    for key in ("$ref", "anyOf", "oneOf", "allOf", "not",
+                "patternProperties", "additionalProperties"):
+        if schema.get(key):
+            raise UnsupportedSchema(f"unsupported schema keyword: {key}")
+
+    if "const" in schema:
+        _literal_value(b, start, schema["const"], cont)
+        return
+    if "enum" in schema:
+        for value in schema["enum"]:
+            if isinstance(value, (dict, list)):
+                raise UnsupportedSchema("enum members must be scalars")
+            _literal_value(b, start, value, cont)
+        return
+
+    stype = schema.get("type")
+    if isinstance(stype, list):
+        for t in stype:
+            _compile_value(b, {**schema, "type": t}, start, cont, depth)
+        return
+    if stype == "string":
+        _string_fragment(b, start, cont)
+    elif stype in ("number", "integer"):
+        _number_fragment(b, start, cont, integer=stype == "integer")
+    elif stype == "boolean":
+        b.chain(start, "true", cont)
+        b.chain(start, "false", cont)
+    elif stype == "null":
+        b.chain(start, "null", cont)
+    elif stype == "array":
+        item = schema.get("items")
+        if item is None:
+            raise UnsupportedSchema("array schema needs 'items'")
+        open_ = b.new_state()   # after '['
+        sep = b.new_state()     # after an item
+        b.edge(start, ord("["), open_)
+        b.edge(open_, ord("]"), cont)
+        b.edge(sep, ord("]"), cont)
+        item_start = b.new_state()
+        # ',' between items loops back to a fresh item.
+        b.edge(sep, ord(","), item_start)
+        _compile_value(b, item, item_start, sep, depth + 1)
+        b.copy_state(open_, item_start)  # first item starts right after '['
+    elif stype == "object":
+        props = schema.get("properties") or {}
+        if not isinstance(props, dict):
+            raise UnsupportedSchema("'properties' must be an object")
+        required = set(schema.get("required") or [])
+        unknown = required - set(props)
+        if unknown:
+            raise UnsupportedSchema(f"required names not in properties: {unknown}")
+        names = list(props)  # schema order, preserved in output
+        open_ = b.new_state()
+        b.edge(start, ord("{"), open_)
+        _compile_object_body(b, names, props, required, open_, cont, depth)
+    else:
+        raise UnsupportedSchema(f"unsupported type: {stype!r}")
+
+
+def _compile_object_body(
+    b: _Builder,
+    names: List[str],
+    props: Dict[str, Any],
+    required: set,
+    open_: int,
+    cont: int,
+    depth: int,
+) -> None:
+    """Decision-point automaton over ordered, possibly-optional keys.
+
+    ``decision[i]`` is the state where properties ``i..n`` may still
+    appear (in order). From there a byte trie over the candidate keys
+    disambiguates which property comes next; '}' is legal iff every
+    remaining property is optional. ``first`` tracks whether a ','
+    separator is owed (two variants per decision point)."""
+    n = len(names)
+    # decision[i][first?] — first=True means no property emitted yet.
+    decision: Dict[Tuple[int, bool], int] = {}
+
+    def get_decision(i: int, first: bool) -> int:
+        if i == n:
+            # No properties left: close (the caller wires '}'->cont).
+            st = decision.get((n, first))
+            if st is None:
+                st = b.new_state()
+                b.edge(st, ord("}"), cont)
+                decision[(n, first)] = st
+            return st
+        key = (i, first)
+        if key in decision:
+            return decision[key]
+        st = b.new_state()
+        decision[key] = st
+        # '}' legal when every remaining property is optional.
+        if not any(names[j] in required for j in range(i, n)):
+            b.edge(st, ord("}"), cont)
+        # Candidate keys: i, plus i+1.. while the skipped ones are
+        # optional. Keys are emitted as ',' (unless first) '"name":'.
+        j = i
+        while j < n:
+            after_value = get_decision(j + 1, False)
+            entry = st
+            if not first:
+                comma = b.next[st][ord(",")] if b.allowed[st][ord(",")] else None
+                if comma is None:
+                    comma = b.new_state()
+                    b.edge(st, ord(","), comma)
+                entry = comma
+            vstart = b.new_state()
+            b.chain(entry, json.dumps(names[j]) + ":", vstart)
+            _compile_value(b, props[names[j]], vstart, after_value, depth + 1)
+            if names[j] in required:
+                break  # later keys can't appear before a required one
+            j += 1
+        return st
+
+    first_state = get_decision(0, True)
+    b.copy_state(open_, first_state)
+
+
+class SchemaDFA:
+    """Compiled schema: device-ready tables + a host-side stepper."""
+
+    def __init__(self, allowed: np.ndarray, nxt: np.ndarray,
+                 mincost: np.ndarray) -> None:
+        self.allowed = allowed  # [S, 256] bool
+        self.next = nxt         # [S, 256] int32
+        self.mincost = mincost  # [S] int32 (bytes to ACC; INF unreachable)
+
+    @property
+    def n_states(self) -> int:
+        return self.allowed.shape[0]
+
+    # Host-side simulation (tests, validation).
+    def matches(self, text: str) -> bool:
+        state = START
+        for byte in text.encode("utf-8"):
+            if not self.allowed[state, byte]:
+                return False
+            state = int(self.next[state, byte])
+        return state == ACC
+
+    def step(self, state: int, byte: int) -> Optional[int]:
+        if not self.allowed[state, byte]:
+            return None
+        return int(self.next[state, byte])
+
+
+def compile_schema(schema: Dict[str, Any]) -> SchemaDFA:
+    """Compile a JSON Schema (supported subset) into a byte DFA."""
+    b = _Builder()
+    root_type = schema.get("type")
+    if root_type not in ("object", "array") and "enum" not in schema \
+            and "const" not in schema:
+        raise UnsupportedSchema(
+            f"root schema must be an object or array, got {root_type!r}"
+        )
+    _compile_value(b, schema, START, ACC, 0)
+    allowed = np.stack(b.allowed)
+    nxt = np.stack(b.next)
+    mincost = _min_costs(allowed, nxt)
+    if mincost[START] >= INF:
+        raise UnsupportedSchema("schema admits no finite document")
+    return SchemaDFA(allowed, nxt, mincost)
+
+
+def _min_costs(allowed: np.ndarray, nxt: np.ndarray) -> np.ndarray:
+    """Shortest #bytes from each state to ACC (reverse BFS)."""
+    S = allowed.shape[0]
+    cost = np.full((S,), INF, np.int32)
+    cost[ACC] = 0
+    # Reverse adjacency: states with an edge into t.
+    frontier = [ACC]
+    # Precompute predecessor lists once.
+    preds: List[List[int]] = [[] for _ in range(S)]
+    for s in range(S):
+        targets = np.unique(nxt[s][allowed[s]])
+        for t in targets:
+            preds[int(t)].append(s)
+    while frontier:
+        nxt_frontier: List[int] = []
+        for t in frontier:
+            for s in preds[t]:
+                if cost[s] > cost[t] + 1:
+                    cost[s] = cost[t] + 1
+                    nxt_frontier.append(s)
+        frontier = nxt_frontier
+    return cost
+
+
+class SchemaBank:
+    """Fixed-capacity device bank of compiled schemas.
+
+    Pre-sized ``(max_schemas, max_states)`` so registering a new schema
+    updates rows in place and never changes the table shapes the jitted
+    decode chunk was compiled against (a growing shape would recompile
+    the engine's hot path on the first request of every new schema)."""
+
+    def __init__(self, max_schemas: int = 8, max_states: int = 768) -> None:
+        self.max_schemas = max_schemas
+        self.max_states = max_states
+        self.allowed = np.zeros((max_schemas, max_states, 256), np.bool_)
+        self.next = np.zeros((max_schemas, max_states, 256), np.int32)
+        self.mincost = np.full((max_schemas, max_states), INF, np.int32)
+        self._ids: Dict[str, int] = {}
+        # Bumped on every table mutation — device-side copies re-upload
+        # when stale (the batcher checks before each dispatch).
+        self.version = 0
+
+    def register(self, schema: Dict[str, Any]) -> int:
+        """Compile (or look up) a schema; returns its bank row.
+
+        Raises ``UnsupportedSchema`` for schemas outside the subset or
+        bigger than ``max_states``."""
+        key = json.dumps(schema, sort_keys=True)
+        if key in self._ids:
+            return self._ids[key]
+        dfa = compile_schema(schema)
+        if dfa.n_states > self.max_states:
+            raise UnsupportedSchema(
+                f"schema compiles to {dfa.n_states} states "
+                f"(> bank capacity {self.max_states})"
+            )
+        if len(self._ids) >= self.max_schemas:
+            # NO eviction: an in-flight request still masks against its
+            # bank row — repointing it mid-generation would silently
+            # constrain against the wrong schema. Callers degrade to the
+            # generic grammar instead; restart clears the bank.
+            raise UnsupportedSchema(
+                f"schema bank full ({self.max_schemas} distinct schemas)"
+            )
+        sid = len(self._ids)
+        self.allowed[sid] = False
+        self.next[sid] = 0
+        self.mincost[sid] = INF
+        self.allowed[sid, : dfa.n_states] = dfa.allowed
+        self.next[sid, : dfa.n_states] = dfa.next
+        self.mincost[sid, : dfa.n_states] = dfa.mincost
+        self._ids[key] = sid
+        self.version += 1
+        return sid
+
+    def tables(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        return self.allowed, self.next, self.mincost
+
+    def __len__(self) -> int:
+        return len(self._ids)

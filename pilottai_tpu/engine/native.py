@@ -70,6 +70,13 @@ class NativeEngine(LLMBackend):
         # Subword JSON grammar tables (built lazily at start; None = byte
         # automaton or tokenizer can't derive token bytes).
         self._json_tables = None
+        # Compiled JSON-Schema DFAs for response_format json_schema
+        # (byte tokenizers only; engine/json_schema.py).
+        self.schema_bank = None
+        if isinstance(self.tokenizer, ByteTokenizer):
+            from pilottai_tpu.engine.json_schema import SchemaBank
+
+            self.schema_bank = SchemaBank()
         self._start_lock = asyncio.Lock()
 
     # ------------------------------------------------------------------ #
@@ -214,6 +221,7 @@ class NativeEngine(LLMBackend):
             kv_quantize=self.config.engine_kv_quantize == "int8",
             draft_layers=self.config.engine_draft_layers,
             pipeline_depth=self.config.engine_pipeline,
+            schema_bank=self.schema_bank,
         )
         self.batcher.start()
         self.batcher.warmup()
@@ -259,6 +267,29 @@ class NativeEngine(LLMBackend):
             if tool_text:
                 prompt = f"{tool_text}\n\n{prompt}"
             prompt_ids = self.tokenizer.encode(prompt)
+        # Schema-constrained decoding: compile/look up in the bank
+        # (byte tokenizers only). Unsupported schemas, full banks and
+        # subword vocabs degrade to the generic grammar — still valid
+        # JSON by construction, just not shape-checked.
+        schema_id = -1
+        want_json = params.json_mode
+        if params.json_schema is not None:
+            want_json = True
+            if self.schema_bank is not None:
+                from pilottai_tpu.engine.json_schema import UnsupportedSchema
+
+                try:
+                    schema_id = self.schema_bank.register(params.json_schema)
+                except UnsupportedSchema as exc:
+                    self._log.warning(
+                        "json_schema not enforceable (%s); falling back "
+                        "to generic JSON grammar", exc,
+                    )
+            else:
+                self._log.warning(
+                    "json_schema requires a byte tokenizer; falling back "
+                    "to generic JSON grammar"
+                )
         return GenRequest(
             prompt_ids=prompt_ids,
             max_new_tokens=params.max_new_tokens,
@@ -270,10 +301,11 @@ class NativeEngine(LLMBackend):
             # Byte tokenizers use the byte automaton; subword tokenizers
             # the token→byte product tables. Only a tokenizer whose table
             # build failed falls back to free sampling + tolerant parsing.
-            json_mode=params.json_mode and (
+            json_mode=want_json and (
                 isinstance(self.tokenizer, ByteTokenizer)
                 or self._json_tables is not None
             ),
+            json_schema_id=schema_id,
         )
 
     async def generate(

@@ -29,6 +29,10 @@ class SamplingState(NamedTuple):
     json_state: jax.Array    # [B] int32
     json_stack: jax.Array    # [B] int32 (container-type bit per level)
     json_depth: jax.Array    # [B] int32
+    # Schema-constrained slots (engine/json_schema.py): row into the
+    # engine's SchemaBank, -1 = generic JSON automaton. Schema slots
+    # reuse ``json_state`` as their DFA state (start = 1, accept = 0).
+    json_schema_id: jax.Array  # [B] int32
 
     @classmethod
     def create(cls, n_slots: int, seed: int = 0) -> "SamplingState":
@@ -43,6 +47,7 @@ class SamplingState(NamedTuple):
             json_state=jnp.zeros((n_slots,), jnp.int32),
             json_stack=jnp.zeros((n_slots,), jnp.int32),
             json_depth=jnp.zeros((n_slots,), jnp.int32),
+            json_schema_id=jnp.full((n_slots,), -1, jnp.int32),
         )
 
 
@@ -75,11 +80,17 @@ def _apply_json_mask(
     state: SamplingState,
     remaining: jax.Array | None = None,
     token_tables: tuple[jax.Array, jax.Array] | None = None,
+    schema_tables: tuple[jax.Array, jax.Array, jax.Array] | None = None,
 ) -> jax.Array:
     """Constrain logits of json-enabled slots to grammar-legal tokens.
     ``remaining`` (budget left, [B]) enables forced document closure.
     ``token_tables`` = (token_bytes [Vt, L], token_len [Vt]) switches from
-    the byte automaton to the token→byte product (subword vocabs)."""
+    the byte automaton to the token→byte product (subword vocabs).
+    ``schema_tables`` = the SchemaBank's (ALLOWED [N,S,256],
+    NEXT [N,S,256], MINCOST [N,S]) — slots with ``json_schema_id >= 0``
+    mask against their compiled schema DFA instead of the generic
+    grammar (byte tokenizers only; budget feasibility is the exact
+    shortest-completion cost)."""
     from pilottai_tpu.engine.json_mask import (
         S_DONE,
         json_allowed_bytes,
@@ -101,8 +112,23 @@ def _apply_json_mask(
             state.json_state, state.json_stack, state.json_depth, remaining
         )                                               # [B, 256]
         full = jnp.zeros((B, V), bool).at[:, :256].set(byte_ok[:, :V])
+    schema_slot = state.json_schema_id >= 0
+    if schema_tables is not None and token_tables is None:
+        s_allowed, s_next, s_cost = schema_tables
+        sid = jnp.clip(state.json_schema_id, 0, s_allowed.shape[0] - 1)
+        st = state.json_state
+        ok = s_allowed[sid, st]                          # [B, 256]
+        nxt = s_next[sid, st]                            # [B, 256]
+        cost = s_cost[sid[:, None], nxt]                 # [B, 256]
+        if remaining is not None:
+            ok = ok & (cost <= remaining[:, None] - 1)
+        s_full = jnp.zeros((B, V), bool).at[:, :256].set(ok[:, :V])
+        full = jnp.where(schema_slot[:, None], s_full, full)
+        done = jnp.where(schema_slot, st == 0, state.json_state == S_DONE)
+    else:
+        done = state.json_state == S_DONE
     # Document closed: force EOS when the slot has one (else pad spaces).
-    eos_ok = (state.json_state == S_DONE) & (state.eos_id >= 0)
+    eos_ok = done & (state.eos_id >= 0)
     eos_onehot = jax.nn.one_hot(
         jnp.clip(state.eos_id, 0, V - 1), V, dtype=bool
     )
@@ -124,6 +150,7 @@ def _advance_json(
     state: SamplingState,
     tokens: jax.Array,
     token_tables: tuple[jax.Array, jax.Array] | None = None,
+    schema_tables: tuple[jax.Array, jax.Array, jax.Array] | None = None,
 ) -> SamplingState:
     from pilottai_tpu.engine.json_mask import (
         json_advance,
@@ -139,6 +166,17 @@ def _advance_json(
         ns, stack, depth = json_advance(
             state.json_state, state.json_stack, state.json_depth, tokens
         )
+    if schema_tables is not None and token_tables is None:
+        _, s_next, _ = schema_tables
+        sid = jnp.clip(state.json_schema_id, 0, s_next.shape[0] - 1)
+        byte = jnp.clip(tokens, 0, 255)
+        s_ns = s_next[sid, state.json_state, byte]
+        # Non-byte tokens (EOS/specials) don't advance the DFA.
+        s_ns = jnp.where(tokens < 256, s_ns, state.json_state)
+        schema_slot = state.json_schema_id >= 0
+        ns = jnp.where(schema_slot, s_ns, ns)
+        stack = jnp.where(schema_slot, state.json_stack, stack)
+        depth = jnp.where(schema_slot, state.json_depth, depth)
     en = state.json_enabled
     return state._replace(
         json_state=jnp.where(en, ns, state.json_state),
@@ -152,12 +190,15 @@ def sample_core(
     state: SamplingState,
     json_remaining: jax.Array | None = None,  # [B] budget incl. this token
     json_token_tables: tuple[jax.Array, jax.Array] | None = None,
+    json_schema_tables: tuple[jax.Array, jax.Array, jax.Array] | None = None,
 ) -> tuple[jax.Array, SamplingState]:
     """Sample one token per slot; greedy where temperature == 0.
 
     Plain function (no jit) so the decode chunk can inline it inside its
     step scan; ``sample_tokens`` is the standalone jitted wrapper."""
-    logits = _apply_json_mask(logits, state, json_remaining, json_token_tables)
+    logits = _apply_json_mask(
+        logits, state, json_remaining, json_token_tables, json_schema_tables
+    )
     greedy = jnp.argmax(logits, axis=-1)
 
     temp = jnp.maximum(state.temperature, 1e-6)[:, None]
@@ -176,7 +217,8 @@ def sample_core(
         jnp.int32
     )
     state = _advance_json(
-        state._replace(key=carry_keys), tokens, json_token_tables
+        state._replace(key=carry_keys), tokens, json_token_tables,
+        json_schema_tables,
     )
     return tokens, state
 
@@ -198,6 +240,7 @@ def update_slot(
     seed: int,
     eos_id: int = -1,
     json_mode: bool = False,
+    json_schema_id: int = -1,
 ) -> SamplingState:
     """Host-side admission: install one request's sampling params."""
     return state._replace(
@@ -207,9 +250,14 @@ def update_slot(
         key=state.key.at[slot].set(jax.random.PRNGKey(seed)[None][0]),
         eos_id=state.eos_id.at[slot].set(eos_id),
         json_enabled=state.json_enabled.at[slot].set(json_mode),
-        json_state=state.json_state.at[slot].set(0),
+        # Schema DFAs start at state 1 (engine/json_schema.py:START);
+        # the generic automaton at 0.
+        json_state=state.json_state.at[slot].set(
+            1 if json_schema_id >= 0 else 0
+        ),
         json_stack=state.json_stack.at[slot].set(0),
         json_depth=state.json_depth.at[slot].set(0),
+        json_schema_id=state.json_schema_id.at[slot].set(json_schema_id),
     )
 
 
@@ -223,10 +271,15 @@ def admit_sampling(
     seeds: jax.Array,        # [A] int32
     eos_id: jax.Array,       # [A] int32
     json_mode: jax.Array,    # [A] bool — grammar-constrained decoding
+    schema_ids: jax.Array | None = None,  # [A] int32; -1 = generic
 ) -> SamplingState:
     """Batched admission: install a group of requests' sampling params."""
     keys = jax.vmap(jax.random.PRNGKey)(seeds)
     zeros = jnp.zeros_like(slots)
+    if schema_ids is None:
+        schema_ids = jnp.full_like(slots, -1)
+    # Schema DFAs start at state 1 (engine/json_schema.py:START).
+    init_state = jnp.where(schema_ids >= 0, 1, 0).astype(jnp.int32)
     return state._replace(
         temperature=state.temperature.at[slots].set(temperature, mode="drop"),
         top_k=state.top_k.at[slots].set(top_k, mode="drop"),
@@ -234,7 +287,10 @@ def admit_sampling(
         key=state.key.at[slots].set(keys, mode="drop"),
         eos_id=state.eos_id.at[slots].set(eos_id, mode="drop"),
         json_enabled=state.json_enabled.at[slots].set(json_mode, mode="drop"),
-        json_state=state.json_state.at[slots].set(zeros, mode="drop"),
+        json_state=state.json_state.at[slots].set(init_state, mode="drop"),
         json_stack=state.json_stack.at[slots].set(zeros, mode="drop"),
         json_depth=state.json_depth.at[slots].set(zeros, mode="drop"),
+        json_schema_id=state.json_schema_id.at[slots].set(
+            schema_ids, mode="drop"
+        ),
     )

@@ -65,6 +65,11 @@ class GenerationParams(BaseModel):
     seed: Optional[int] = None
     stop: List[str] = Field(default_factory=list)
     json_mode: bool = False
+    # Schema-constrained decoding (engine/json_schema.py): a JSON Schema
+    # dict the output must match exactly — OpenAI's response_format
+    # json_schema. Byte-tokenizer engines enforce it by construction;
+    # unsupported schemas / subword vocabs degrade to generic json_mode.
+    json_schema: Optional[Dict[str, Any]] = None
 
 
 class Usage(BaseModel):

@@ -186,10 +186,13 @@ class Serve:
     def _wire_agent(self, agent: BaseAgent) -> None:
         """Attach orchestrator plumbing an agent needs: dependency
         lookups and (unless the user installed their own) a step
-        callback feeding the task event bus."""
-        if agent.dependency_resolver is None:
+        callback feeding the task event bus. ``getattr`` with a
+        non-None sentinel: proxy agents (``distributed/control_plane.py``
+        RemoteAgent) don't carry these hooks at all — leave them alone
+        (their steps happen on the worker host)."""
+        if getattr(agent, "dependency_resolver", True) is None:
             agent.dependency_resolver = self.get_task
-        if agent.step_callback is None:
+        if getattr(agent, "step_callback", True) is None:
             agent.step_callback = self._agent_step_event
 
     def _agent_step_event(self, task_id: str, info: Dict[str, Any]) -> None:

@@ -368,6 +368,18 @@ class APIServer:
         rf = req.get("response_format") or {}
         if not isinstance(rf, dict):
             raise _HttpError(400, "'response_format' must be an object")
+        json_schema = None
+        if rf.get("type") == "json_schema":
+            # OpenAI nests {name, schema, strict} under json_schema.
+            spec = rf.get("json_schema")
+            if not isinstance(spec, dict) or not isinstance(
+                spec.get("schema"), dict
+            ):
+                raise _HttpError(
+                    400, "response_format json_schema needs "
+                    "{'json_schema': {'schema': {...}}}"
+                )
+            json_schema = spec["schema"]
         try:
             # Client values are untrusted: a non-numeric temperature or
             # seed is a 400 invalid_request_error (OpenAI parity), not a
@@ -382,7 +394,8 @@ class APIServer:
                 top_p=float(req.get("top_p", 1.0)),
                 seed=int(req["seed"]) if req.get("seed") is not None else None,
                 stop=[str(s) for s in stop],
-                json_mode=rf.get("type") == "json_object",
+                json_mode=rf.get("type") in ("json_object", "json_schema"),
+                json_schema=json_schema,
             )
         except (TypeError, ValueError) as exc:
             # (pydantic's ValidationError subclasses ValueError)

@@ -1,0 +1,325 @@
+"""Schema→byte-DFA compiler: acceptance/rejection, ordering/optional
+semantics, budget costs, the bank, and device-side constrained decoding."""
+
+import json
+
+import numpy as np
+import pytest
+
+from pilottai_tpu.engine.json_schema import (
+    ACC,
+    START,
+    SchemaBank,
+    UnsupportedSchema,
+    compile_schema,
+)
+
+PROTOCOL = {
+    "type": "object",
+    "properties": {
+        "requires_decomposition": {"type": "boolean"},
+        "complexity": {"type": "integer"},
+        "reasoning": {"type": "string"},
+    },
+    "required": ["requires_decomposition", "complexity", "reasoning"],
+}
+
+
+def test_flat_object_accepts_exact_shape():
+    dfa = compile_schema(PROTOCOL)
+    good = '{"requires_decomposition":false,"complexity":3,"reasoning":"ok"}'
+    assert dfa.matches(good)
+    assert json.loads(good)  # sanity: the accepted text is real JSON
+
+
+@pytest.mark.parametrize("bad", [
+    '{"complexity":3,"requires_decomposition":false,"reasoning":"x"}',  # order
+    '{"requires_decomposition":false,"complexity":3}',                  # missing
+    '{"requires_decomposition":"no","complexity":3,"reasoning":"x"}',   # type
+    '{"requires_decomposition":false,"complexity":3.5,"reasoning":"x"}',  # int
+    '{"requires_decomposition":false,"complexity":3,"reasoning":"x"} ',  # trail
+    '{"requires_decomposition": false,"complexity":3,"reasoning":"x"}',  # ws
+    '{"extra":1}',
+])
+def test_flat_object_rejects(bad):
+    assert not compile_schema(PROTOCOL).matches(bad)
+
+
+def test_optional_properties_skippable_in_order():
+    dfa = compile_schema({
+        "type": "object",
+        "properties": {
+            "a": {"type": "integer"},
+            "b": {"type": "string"},
+            "c": {"type": "boolean"},
+        },
+        "required": ["c"],
+    })
+    assert dfa.matches('{"a":1,"b":"x","c":true}')
+    assert dfa.matches('{"b":"x","c":true}')
+    assert dfa.matches('{"c":false}')
+    assert not dfa.matches('{"a":1}')            # required c missing
+    assert not dfa.matches('{"c":true,"a":1}')   # out of order
+    assert not dfa.matches("{}")
+
+
+def test_all_optional_allows_empty_object():
+    dfa = compile_schema({
+        "type": "object",
+        "properties": {"a": {"type": "integer"}},
+    })
+    assert dfa.matches("{}")
+    assert dfa.matches('{"a":7}')
+
+
+def test_arrays_enums_unions_nested():
+    dfa = compile_schema({
+        "type": "object",
+        "properties": {
+            "tags": {"type": "array",
+                     "items": {"enum": ["alpha", "beta"]}},
+            "score": {"type": ["number", "null"]},
+            "child": {
+                "type": "object",
+                "properties": {"n": {"type": "integer"}},
+                "required": ["n"],
+            },
+        },
+        "required": ["tags", "score", "child"],
+    })
+    assert dfa.matches('{"tags":[],"score":1.5,"child":{"n":2}}')
+    assert dfa.matches('{"tags":["alpha","beta"],"score":null,"child":{"n":-1}}')
+    assert not dfa.matches('{"tags":["gamma"],"score":1,"child":{"n":2}}')
+    assert not dfa.matches('{"tags":[],"score":"x","child":{"n":2}}')
+    assert not dfa.matches('{"tags":[],"score":1,"child":{}}')
+
+
+def test_shared_prefix_keys_and_enum_members():
+    dfa = compile_schema({
+        "type": "object",
+        "properties": {
+            "a": {"enum": ["ab", "abc"]},
+            "ab": {"type": "integer"},
+        },
+        "required": ["a", "ab"],
+    })
+    assert dfa.matches('{"a":"ab","ab":1}')
+    assert dfa.matches('{"a":"abc","ab":22}')
+    assert not dfa.matches('{"a":"abd","ab":1}')
+
+
+def test_numbers_full_grammar():
+    dfa = compile_schema({
+        "type": "object",
+        "properties": {"x": {"type": "number"}},
+        "required": ["x"],
+    })
+    for v in ("0", "-7", "3.25", "1e9", "-2.5E-3", "0.5", "0e3", "-0.1"):
+        assert dfa.matches('{"x":%s}' % v), v
+    for v in (".5", "1.", "--2", "1e", "+3", "01", "-012", "00"):
+        assert not dfa.matches('{"x":%s}' % v), v
+
+
+def test_const_and_root_enum():
+    dfa = compile_schema({"enum": ["yes", "no"]})
+    assert dfa.matches('"yes"') and dfa.matches('"no"')
+    assert not dfa.matches('"maybe"')
+    dfa = compile_schema({
+        "type": "object",
+        "properties": {"kind": {"const": "task"}},
+        "required": ["kind"],
+    })
+    assert dfa.matches('{"kind":"task"}')
+    assert not dfa.matches('{"kind":"other"}')
+
+
+def test_unsupported_rejected():
+    for schema in (
+        {"type": "object", "properties": {"a": {"$ref": "#/defs/x"}},
+         "required": ["a"]},
+        {"type": "object", "properties": {"a": {"anyOf": [{"type": "integer"}]}},
+         "required": ["a"]},
+        {"type": "string"},  # root must be object/array/enum/const
+        {"type": "object", "properties": {"a": {"enum": [1, 12]}},
+         "required": ["a"]},  # prefix-ambiguous literals
+    ):
+        with pytest.raises(UnsupportedSchema):
+            compile_schema(schema)
+
+
+def test_mincost_budget_feasibility():
+    dfa = compile_schema({
+        "type": "object",
+        "properties": {"ok": {"type": "boolean"}},
+        "required": ["ok"],
+    })
+    # Shortest doc: {"ok":true} = 11 bytes.
+    assert int(dfa.mincost[START]) == 11
+    assert int(dfa.mincost[ACC]) == 0
+    # Every state on the accepting path can finish.
+    state = START
+    for b in b'{"ok":':
+        state = dfa.step(state, b)
+    assert int(dfa.mincost[state]) == 5  # 'true}' remains
+
+
+def test_schema_bank_register_reuse_full():
+    bank = SchemaBank(max_schemas=2, max_states=256)
+    s1 = {"type": "object", "properties": {"a": {"type": "integer"}},
+          "required": ["a"]}
+    s2 = {"type": "object", "properties": {"b": {"type": "string"}},
+          "required": ["b"]}
+    i1 = bank.register(s1)
+    v1 = bank.version
+    assert bank.register(s1) == i1  # cached, no version bump
+    assert bank.version == v1
+    i2 = bank.register(s2)
+    assert i1 != i2 and len(bank) == 2 and bank.version > v1
+    # Full bank REFUSES (no eviction — in-flight slots hold row ids).
+    s3 = {"type": "object", "properties": {"c": {"type": "boolean"}},
+          "required": ["c"]}
+    with pytest.raises(UnsupportedSchema):
+        bank.register(s3)
+    allowed, nxt, cost = bank.tables()
+    assert allowed.shape[0] == 2 and cost[i1, START] < 2**30
+
+
+# ---------------------- engine integration (cpu) ----------------------- #
+
+@pytest.fixture(scope="module")
+def schema_backend():
+    from pilottai_tpu.core.config import LLMConfig
+    from pilottai_tpu.engine.native import NativeEngine
+
+    backend = NativeEngine(
+        LLMConfig(
+            model_name="llama-tiny", provider="cpu",
+            engine_slots=2, engine_max_seq=256, engine_chunk=4,
+        ),
+        platform="cpu",
+    )
+    yield backend
+    import asyncio
+
+    asyncio.run(backend.stop())
+
+
+def _gen(backend, schema, max_new=96, prompt="produce the record"):
+    import asyncio
+
+    from pilottai_tpu.engine.types import ChatMessage, GenerationParams
+
+    async def run():
+        resp = await backend.generate(
+            [ChatMessage(content=prompt)],
+            params=GenerationParams(
+                max_new_tokens=max_new, temperature=0.0, json_schema=schema
+            ),
+        )
+        return resp.content
+
+    return asyncio.run(run())
+
+
+def test_engine_output_matches_schema(schema_backend):
+    """A random-weight model constrained by a schema emits a document
+    that parses AND validates against the schema — by construction."""
+    out = _gen(schema_backend, PROTOCOL)
+    data = json.loads(out)
+    assert set(data) == set(PROTOCOL["properties"])
+    assert isinstance(data["requires_decomposition"], bool)
+    assert isinstance(data["complexity"], int)
+    assert isinstance(data["reasoning"], str)
+
+
+def test_engine_schema_enum_and_nested(schema_backend):
+    schema = {
+        "type": "object",
+        "properties": {
+            "verdict": {"enum": ["approve", "reject"]},
+            "detail": {
+                "type": "object",
+                "properties": {"score": {"type": "integer"}},
+                "required": ["score"],
+            },
+        },
+        "required": ["verdict", "detail"],
+    }
+    data = json.loads(_gen(schema_backend, schema))
+    assert data["verdict"] in ("approve", "reject")
+    assert isinstance(data["detail"]["score"], int)
+
+
+def test_engine_schema_tight_budget_still_closes(schema_backend):
+    """Budget feasibility: even a tight max_new_tokens produces a
+    complete (possibly minimal) valid document, never a truncated one."""
+    schema = {
+        "type": "object",
+        "properties": {"note": {"type": "string"}},
+        "required": ["note"],
+    }
+    out = _gen(schema_backend, schema, max_new=14)  # min doc: {"note":""}
+    data = json.loads(out)
+    assert set(data) == {"note"}
+
+
+def test_engine_schema_with_speculation():
+    """Schema masking composes with speculative verify-blocks."""
+    import asyncio
+
+    from pilottai_tpu.core.config import LLMConfig
+    from pilottai_tpu.engine.native import NativeEngine
+    from pilottai_tpu.engine.types import ChatMessage, GenerationParams
+
+    backend = NativeEngine(
+        LLMConfig(
+            model_name="llama-tiny", provider="cpu",
+            engine_slots=2, engine_max_seq=256, engine_chunk=4,
+            engine_speculate=4,
+        ),
+        platform="cpu",
+    )
+    try:
+        async def run():
+            resp = await backend.generate(
+                [ChatMessage(content="emit json")],
+                params=GenerationParams(
+                    max_new_tokens=64, temperature=0.0, json_schema=PROTOCOL
+                ),
+            )
+            return resp.content
+
+        data = json.loads(asyncio.run(run()))
+        assert set(data) == set(PROTOCOL["properties"])
+    finally:
+        asyncio.run(backend.stop())
+
+
+def test_engine_unsupported_schema_degrades_to_generic(schema_backend):
+    """anyOf → generic JSON grammar: output is still valid JSON."""
+    out = _gen(schema_backend, {
+        "type": "object",
+        "properties": {"a": {"anyOf": [{"type": "integer"}]}},
+        "required": ["a"],
+    }, max_new=48)
+    json.loads(out)  # well-formed, just not shape-checked
+
+
+def test_greedy_forced_bytes_reach_accept():
+    """Greedy walk taking the unique allowed byte where forced (and the
+    cheapest where not) terminates at ACC — no dead ends."""
+    dfa = compile_schema(PROTOCOL)
+    state, out = START, bytearray()
+    for _ in range(300):
+        if state == ACC:
+            break
+        allowed = np.flatnonzero(dfa.allowed[state])
+        assert len(allowed) > 0
+        nxt = dfa.next[state, allowed]
+        costs = dfa.mincost[nxt]
+        pick = int(allowed[int(np.argmin(costs))])
+        out.append(pick)
+        state = int(dfa.next[state, pick])
+    assert state == ACC
+    parsed = json.loads(out.decode())
+    assert set(parsed) == set(PROTOCOL["properties"])

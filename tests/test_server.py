@@ -439,6 +439,55 @@ async def test_serving_endpoint_example():
 
 
 @pytest.mark.asyncio
+async def test_json_schema_response_format():
+    """response_format json_schema flows to the engine and the output
+    validates against the schema by construction (real CPU engine)."""
+    handler = LLMHandler(LLMConfig(
+        model_name="llama-tiny", provider="cpu",
+        engine_slots=2, engine_max_seq=256, engine_chunk=4,
+    ))
+    server = await APIServer(handler).start()
+    try:
+        status, _, body = await _request(
+            server.port, "POST", "/v1/chat/completions",
+            {
+                "messages": [{"role": "user", "content": "report status"}],
+                "max_tokens": 96, "temperature": 0,
+                "response_format": {
+                    "type": "json_schema",
+                    "json_schema": {
+                        "name": "status",
+                        "schema": {
+                            "type": "object",
+                            "properties": {
+                                "ok": {"type": "boolean"},
+                                "score": {"type": "integer"},
+                            },
+                            "required": ["ok", "score"],
+                        },
+                    },
+                },
+            },
+        )
+        assert status == 200
+        content = json.loads(body)["choices"][0]["message"]["content"]
+        data = json.loads(content)
+        assert set(data) == {"ok", "score"}
+        assert isinstance(data["ok"], bool) and isinstance(data["score"], int)
+
+        # Malformed response_format is a 400, not a 500.
+        status, _, _ = await _request(
+            server.port, "POST", "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "x"}],
+             "response_format": {"type": "json_schema"}},
+        )
+        assert status == 400
+    finally:
+        await server.stop()
+        await handler.stop()
+
+
+@pytest.mark.asyncio
 async def test_json_mode_response_format():
     server = await APIServer(_mock_handler()).start()
     try:
