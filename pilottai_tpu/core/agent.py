@@ -31,6 +31,7 @@ from pilottai_tpu.core.config import AgentConfig, LLMConfig
 from pilottai_tpu.core.status import AgentStatus
 from pilottai_tpu.core.task import Task, TaskResult, TaskStatus
 from pilottai_tpu.prompts.manager import PromptManager
+from pilottai_tpu.prompts.schemas import schema_for
 from pilottai_tpu.tools.tool import Tool, ToolRegistry
 from pilottai_tpu.utils.json_utils import coerce_bool, extract_json
 from pilottai_tpu.utils.logging import get_logger
@@ -410,9 +411,16 @@ class BaseAgent:
             backstory=self.config.backstory or "none",
         )
 
-    async def _ask(self, prompt: str, tools: Optional[List[Dict[str, Any]]] = None) -> Dict[str, Any]:
+    async def _ask(
+        self,
+        prompt: str,
+        tools: Optional[List[Dict[str, Any]]] = None,
+        schema: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
         # Every rules.yaml prompt demands strict JSON: constrained decoding
-        # makes the reply well-formed by construction on in-tree engines.
+        # makes the reply well-formed by construction on in-tree engines —
+        # and SCHEMA-constrained where the template's shape is expressible
+        # (prompts/schemas.py), so the wire fields are exact, not hoped for.
         response = await self.llm.generate_response(
             [
                 {"role": "system", "content": self.system_prompt()},
@@ -420,6 +428,7 @@ class BaseAgent:
             ],
             tools=tools,
             json_mode=True,
+            json_schema=schema,
         )
         self.conversation_history.append(
             {"prompt_tail": prompt[-200:], "response": response.content[:500]}
@@ -435,7 +444,7 @@ class BaseAgent:
 
     async def _analyze_task(self, task: Task) -> Dict[str, Any]:
         prompt = self.prompts.format_prompt("task_analysis", task=task.to_prompt())
-        return await self._ask(prompt)
+        return await self._ask(prompt, schema=schema_for("agent", "task_analysis"))
 
     async def _select_tools(self, task: Task) -> List[Tool]:
         candidates = (
@@ -449,7 +458,10 @@ class BaseAgent:
             task=task.to_prompt(),
             tools="\n".join(f"{t.name}: {t.description}" for t in candidates),
         )
-        data = await self._ask(prompt, tools=[t.to_spec() for t in candidates])
+        data = await self._ask(
+            prompt, tools=[t.to_spec() for t in candidates],
+            schema=schema_for("agent", "tool_selection"),
+        )
         names = data.get("selected_tools", [])
         if not names and data.get("action"):
             # The engine surfaced a structured tool_call instead of the
@@ -514,7 +526,9 @@ class BaseAgent:
         prompt = self.prompts.format_prompt(
             "result_evaluation", task=task.to_prompt(), result=str(output)[:2000]
         )
-        return await self._ask(prompt)
+        return await self._ask(
+            prompt, schema=schema_for("agent", "result_evaluation")
+        )
 
     # ------------------------------------------------------------------ #
     # Ops surface (reference ``:217-229,535-575``)
@@ -597,7 +611,8 @@ class BaseAgent:
         )
         data = extract_json(
             (await self.llm.generate_response(
-                [{"role": "user", "content": prompt}], json_mode=True
+                [{"role": "user", "content": prompt}], json_mode=True,
+                json_schema=schema_for("orchestrator", "execution_strategy"),
             )).content
         ) or {}
         return {
@@ -620,7 +635,8 @@ class BaseAgent:
         )
         data = extract_json(
             (await self.llm.generate_response(
-                [{"role": "user", "content": prompt}], json_mode=True
+                [{"role": "user", "content": prompt}], json_mode=True,
+                json_schema=schema_for("orchestrator", "agent_selection"),
             )).content
         ) or {}
         chosen = data.get("agent_id", "")

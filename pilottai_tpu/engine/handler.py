@@ -125,6 +125,7 @@ class LLMHandler:
         tools: Optional[Sequence[ToolSpec | Dict[str, Any]]],
         params: Optional[GenerationParams],
         json_mode: Optional[bool],
+        json_schema: Optional[Dict[str, Any]] = None,
     ):
         """One request-normalization path for the streaming AND
         non-streaming calls — the two must never drift in default-params
@@ -145,6 +146,11 @@ class LLMHandler:
             )
         if json_mode is not None and json_mode != params.json_mode:
             params = params.model_copy(update={"json_mode": json_mode})
+        if json_schema is not None:
+            # Schema implies JSON mode (the schema DFA subsumes it).
+            params = params.model_copy(
+                update={"json_schema": json_schema, "json_mode": True}
+            )
         return msgs, specs, params
 
     async def generate_response(
@@ -153,6 +159,7 @@ class LLMHandler:
         tools: Optional[Sequence[ToolSpec | Dict[str, Any]]] = None,
         params: Optional[GenerationParams] = None,
         json_mode: Optional[bool] = None,
+        json_schema: Optional[Dict[str, Any]] = None,
     ) -> LLMResponse:
         """Chat completion with retry/backoff (reference ``llm.py:38-66``).
 
@@ -160,7 +167,9 @@ class LLMHandler:
         sites (rules.yaml prompts demand strict JSON) set it True to get
         grammar-constrained decoding on byte-tokenizer engines.
         """
-        msgs, specs, params = self._normalize(messages, tools, params, json_mode)
+        msgs, specs, params = self._normalize(
+            messages, tools, params, json_mode, json_schema
+        )
 
         last_error: Optional[Exception] = None
         for attempt in range(self.config.retries + 1):
@@ -208,6 +217,7 @@ class LLMHandler:
         tools: Optional[Sequence[ToolSpec | Dict[str, Any]]] = None,
         params: Optional[GenerationParams] = None,
         json_mode: Optional[bool] = None,
+        json_schema: Optional[Dict[str, Any]] = None,
     ):
         """Streaming chat completion: an async generator of text deltas
         whose concatenation equals ``generate_response(...).content`` for
@@ -222,7 +232,9 @@ class LLMHandler:
         apply for the stream's whole lifetime."""
         if isinstance(messages, str):
             messages = [messages]
-        msgs, specs, params = self._normalize(messages, tools, params, json_mode)
+        msgs, specs, params = self._normalize(
+            messages, tools, params, json_mode, json_schema
+        )
 
         if self._limiter:
             await self._limiter.acquire()

@@ -57,7 +57,8 @@ class UnsupportedSchema(ValueError):
 class _Builder:
     """Mutable DFA builder: per-state [256] allow mask + next table."""
 
-    def __init__(self) -> None:
+    def __init__(self, max_states: int = 2048) -> None:
+        self.max_states = max_states
         self.allowed: List[np.ndarray] = []
         self.next: List[np.ndarray] = []
         # Edges whose target is a literal's continuation (external state):
@@ -69,6 +70,14 @@ class _Builder:
         self.new_state()  # START = 1 (root fragment is wired to it)
 
     def new_state(self) -> int:
+        # Enforced DURING compilation, not after: schemas come from
+        # unauthenticated API requests, and a giant const/enum literal
+        # must fail at the cap (~KB of tables), not after allocating a
+        # state per literal byte (a 10 MB const ≈ 13 GB of tables).
+        if len(self.allowed) >= self.max_states:
+            raise UnsupportedSchema(
+                f"schema too large (> {self.max_states} DFA states)"
+            )
         self.allowed.append(np.zeros((256,), np.bool_))
         self.next.append(np.zeros((256,), np.int32))
         return len(self.allowed) - 1

@@ -1,0 +1,125 @@
+"""JSON Schemas for the rules.yaml wire protocol.
+
+Each template in ``prompts/rules.yaml`` demands a specific JSON shape;
+these schemas state those shapes formally so in-tree engines can enforce
+them with schema-constrained decoding (``engine/json_schema.py``) — the
+LLM↔runtime protocol becomes valid **by construction**, not by
+retry-parse (the reference's approach, ``pilott/pilott.py:603-639``).
+
+``step_planning`` is deliberately absent: its ``arguments`` field is a
+free-form object (tool arguments), which the compiled-DFA subset cannot
+express — that call keeps the generic JSON grammar.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+_STR = {"type": "string"}
+_STR_LIST = {"type": "array", "items": {"type": "string"}}
+
+PROTOCOL_SCHEMAS: Dict[str, Dict[str, Any]] = {
+    "agent.task_analysis": {
+        "type": "object",
+        "properties": {
+            "understanding": _STR,
+            "approach": _STR,
+            "estimated_steps": {"type": "integer"},
+            "risks": _STR_LIST,
+        },
+        "required": ["understanding", "approach", "estimated_steps", "risks"],
+    },
+    "agent.tool_selection": {
+        "type": "object",
+        "properties": {
+            "selected_tools": _STR_LIST,
+            "reasoning": _STR,
+        },
+        "required": ["selected_tools", "reasoning"],
+    },
+    "agent.result_evaluation": {
+        "type": "object",
+        "properties": {
+            "success": {"type": "boolean"},
+            "quality": {"type": "number"},
+            "issues": _STR_LIST,
+            "suggestions": _STR_LIST,
+        },
+        "required": ["success", "quality", "issues", "suggestions"],
+    },
+    "orchestrator.task_analysis": {
+        "type": "object",
+        "properties": {
+            "requires_decomposition": {"type": "boolean"},
+            "complexity": {"type": "integer"},
+            "estimated_resources": {
+                "type": "object",
+                "properties": {
+                    "agents": {"type": "integer"},
+                    "llm_calls": {"type": "integer"},
+                },
+                "required": ["agents", "llm_calls"],
+            },
+            "reasoning": _STR,
+        },
+        "required": [
+            "requires_decomposition", "complexity",
+            "estimated_resources", "reasoning",
+        ],
+    },
+    "orchestrator.task_decomposition": {
+        "type": "object",
+        "properties": {
+            "subtasks": {
+                "type": "array",
+                "items": {
+                    "type": "object",
+                    "properties": {
+                        "description": _STR,
+                        "type": _STR,
+                        "priority": {
+                            "enum": ["low", "normal", "high", "critical"]
+                        },
+                        "depends_on": {
+                            "type": "array",
+                            "items": {"type": "integer"},
+                        },
+                    },
+                    "required": [
+                        "description", "type", "priority", "depends_on",
+                    ],
+                },
+            },
+        },
+        "required": ["subtasks"],
+    },
+    "orchestrator.agent_selection": {
+        "type": "object",
+        "properties": {"agent_id": _STR, "reasoning": _STR},
+        "required": ["agent_id", "reasoning"],
+    },
+    "orchestrator.execution_strategy": {
+        "type": "object",
+        "properties": {
+            "strategy": {"enum": ["parallel", "sequential"]},
+            "max_parallel": {"type": "integer"},
+            "reasoning": _STR,
+        },
+        "required": ["strategy", "max_parallel", "reasoning"],
+    },
+    "orchestrator.result_evaluation": {
+        "type": "object",
+        "properties": {
+            "quality": {"type": "number"},
+            "requires_retry": {"type": "boolean"},
+            "feedback": _STR,
+        },
+        "required": ["quality", "requires_retry", "feedback"],
+    },
+}
+
+
+def schema_for(namespace: str, template: str) -> Optional[Dict[str, Any]]:
+    """The wire schema for ``<namespace>.<template>``, or None when the
+    shape is not expressible (step_planning's free-form arguments)."""
+    return PROTOCOL_SCHEMAS.get(f"{namespace}.{template}")

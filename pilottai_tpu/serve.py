@@ -29,6 +29,7 @@ from pilottai_tpu.core.memory import Memory
 from pilottai_tpu.core.router import TaskRouter
 from pilottai_tpu.core.task import Task, TaskPriority, TaskResult, TaskStatus
 from pilottai_tpu.prompts.manager import PromptManager
+from pilottai_tpu.prompts.schemas import schema_for
 from pilottai_tpu.utils.json_utils import coerce_bool, extract_json
 from pilottai_tpu.utils.logging import get_logger
 from pilottai_tpu.utils.metrics import global_metrics
@@ -484,7 +485,10 @@ class Serve:
             return {"requires_decomposition": False, "complexity": task.complexity}
         prompt = self.prompts.format_prompt("task_analysis", task=task.to_prompt())
         try:
-            content = await self.manager_llm.apredict(prompt, json_mode=True)
+            content = await self.manager_llm.apredict(
+                prompt, json_mode=True,
+                json_schema=schema_for("orchestrator", "task_analysis"),
+            )
             data = extract_json(content) or {}
         except Exception as exc:  # noqa: BLE001 - analysis is advisory
             self._log.warning("task analysis failed: %s", exc)
@@ -498,7 +502,10 @@ class Serve:
         """LLM decomposition into dependent subtasks (reference ``:427-458``)."""
         prompt = self.prompts.format_prompt("task_decomposition", task=task.to_prompt())
         try:
-            content = await self.manager_llm.apredict(prompt, json_mode=True)
+            content = await self.manager_llm.apredict(
+                prompt, json_mode=True,
+                json_schema=schema_for("orchestrator", "task_decomposition"),
+            )
             data = extract_json(content) or {}
             raw_subtasks = data.get("subtasks") or []
         except Exception as exc:  # noqa: BLE001 - fall back to simple path
@@ -702,7 +709,12 @@ class Serve:
                     result=str(result.output)[:2000],
                 )
                 evaluation = extract_json(
-                    await self.manager_llm.apredict(prompt, json_mode=True)
+                    await self.manager_llm.apredict(
+                        prompt, json_mode=True,
+                        json_schema=schema_for(
+                            "orchestrator", "result_evaluation"
+                        ),
+                    )
                 ) or {}
                 needs_retry = coerce_bool(evaluation.get("requires_retry", False))
                 result.metadata["orchestrator_evaluation"] = evaluation

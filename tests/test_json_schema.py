@@ -305,6 +305,65 @@ def test_engine_unsupported_schema_degrades_to_generic(schema_backend):
     json.loads(out)  # well-formed, just not shape-checked
 
 
+def test_agent_protocol_schema_exact_on_native_engine():
+    """The full orchestrator→agent loop on a RANDOM-WEIGHT native engine
+    yields schema-exact protocol JSON: analysis and evaluation carry
+    exactly the rules.yaml contract fields with the right types —
+    impossible without schema constraint (prompts/schemas.py)."""
+    import asyncio
+
+    from pilottai_tpu.core.agent import BaseAgent
+    from pilottai_tpu.core.config import AgentConfig, LLMConfig, ServeConfig
+    from pilottai_tpu.engine.handler import LLMHandler
+    from pilottai_tpu.serve import Serve
+
+    llm = LLMHandler(LLMConfig(
+        model_name="llama-tiny", provider="cpu",
+        engine_slots=2, engine_max_seq=512, engine_chunk=8,
+        sampling={"max_new_tokens": 160, "temperature": 0.0},
+    ))
+    agent = BaseAgent(
+        config=AgentConfig(role="worker", specializations=["generic"],
+                           max_iterations=1),
+        llm=llm,
+    )
+    serve = Serve(
+        name="schema-proto", agents=[agent], manager_llm=llm,
+        config=ServeConfig(decomposition_enabled=False,
+                           evaluation_enabled=True),
+    )
+
+    async def run():
+        await serve.start()
+        try:
+            return await serve.execute_task(
+                "inventory check for bay 9", timeout=600
+            )
+        finally:
+            await serve.stop()
+
+    result = asyncio.run(run())
+    analysis = result.metadata.get("analysis") or {}
+    assert set(analysis) == {
+        "understanding", "approach", "estimated_steps", "risks"
+    }
+    assert isinstance(analysis["estimated_steps"], int)
+    assert isinstance(analysis["risks"], list)
+    evaluation = result.metadata.get("evaluation") or {}
+    assert set(evaluation) == {"success", "quality", "issues", "suggestions"}
+    assert isinstance(evaluation["success"], bool)
+    assert isinstance(evaluation["quality"], (int, float))
+
+
+def test_protocol_schemas_all_compile():
+    """Every rules.yaml wire schema stays inside the compiled subset."""
+    from pilottai_tpu.prompts.schemas import PROTOCOL_SCHEMAS
+
+    for name, schema in PROTOCOL_SCHEMAS.items():
+        dfa = compile_schema(schema)
+        assert dfa.n_states < 768, name  # fits the default bank
+
+
 def test_greedy_forced_bytes_reach_accept():
     """Greedy walk taking the unique allowed byte where forced (and the
     cheapest where not) terminates at ACC — no dead ends."""
