@@ -76,8 +76,42 @@ class TaskPriority(enum.IntEnum):
         return cls(int(value))
 
 
+def _cleanup_files(file_handles: List[Any], temp_files: List[str]) -> List[str]:
+    """Close handles and unlink temp files; clears both lists in place and
+    returns per-item failure descriptions (shared by Task and TaskResult so
+    their error accounting cannot diverge)."""
+    import os
+
+    errors: List[str] = []
+    for handle in file_handles:
+        try:
+            handle.close()
+        except Exception as exc:  # noqa: BLE001 — best-effort teardown
+            errors.append(f"close {handle!r}: {exc}")
+    file_handles.clear()
+    for path in temp_files:
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+        except OSError as exc:
+            errors.append(f"unlink {path}: {exc}")
+    temp_files.clear()
+    return errors
+
+
 class TaskResult(BaseModel):
-    """Outcome of one task execution (reference: ``pilott/core/task.py:29-66``)."""
+    """Outcome of one task execution (reference: ``pilott/core/task.py:29-66``).
+
+    Carries OS resources a task's tools may hand over (open file handles,
+    temp files) and owns their cleanup: ``cleanup_resources()`` is
+    idempotent, runs on ``__del__`` as a last resort, and is invoked by
+    ``Task.cleanup_resources()``. Unlike the reference (whose ``except:
+    pass`` hides everything), per-item failures are recorded in
+    ``metadata["cleanup_errors"]``.
+    """
+
+    model_config = ConfigDict(arbitrary_types_allowed=True)
 
     success: bool
     output: Any = None
@@ -85,6 +119,38 @@ class TaskResult(BaseModel):
     execution_time: float = 0.0
     metadata: Dict[str, Any] = Field(default_factory=dict)
     completed_at: float = Field(default_factory=time.time)
+    resources_cleaned: bool = False
+    # Excluded from serialization: handles and paths are process-local.
+    file_handles: List[Any] = Field(default_factory=list, exclude=True)
+    temp_files: List[str] = Field(default_factory=list, exclude=True)
+
+    def register_file_handle(self, handle: Any) -> None:
+        if handle is None:
+            raise ValueError("file handle must not be None")
+        self.file_handles.append(handle)
+        self.resources_cleaned = False
+
+    def register_temp_file(self, path: Any) -> None:
+        if not path:
+            raise ValueError("temp file path must not be empty")
+        self.temp_files.append(str(path))
+        self.resources_cleaned = False
+
+    def cleanup_resources(self) -> None:
+        """Close registered handles and unlink temp files (idempotent)."""
+        errors = _cleanup_files(self.file_handles, self.temp_files)
+        if errors:
+            self.metadata.setdefault("cleanup_errors", []).extend(errors)
+        self.resources_cleaned = True
+
+    def __del__(self) -> None:  # pragma: no cover — GC-timing dependent
+        try:
+            if not self.resources_cleaned and (
+                self.file_handles or self.temp_files
+            ):
+                self.cleanup_resources()
+        except Exception:  # noqa: BLE001 — never raise from a finalizer
+            pass
 
     def to_dict(self) -> Dict[str, Any]:
         return self.model_dump()
@@ -135,6 +201,13 @@ class Task(BaseModel):
     error_history: List[str] = Field(default_factory=list)
     metadata: Dict[str, Any] = Field(default_factory=dict)
 
+    # Resource management (reference ``core/task.py:94,172-202``: the
+    # reference declares output_file + handle/temp-file sets but never
+    # writes the output; here completion actually persists it).
+    output_file: Optional[str] = None
+    file_handles: List[Any] = Field(default_factory=list, exclude=True)
+    temp_files: List[str] = Field(default_factory=list, exclude=True)
+
     # ------------------------------------------------------------------ #
     # Validation
     # ------------------------------------------------------------------ #
@@ -152,6 +225,20 @@ class Task(BaseModel):
         if self.deadline is not None and self.deadline <= self.created_at:
             raise ValueError("deadline must be after task creation time")
         return self
+
+    @field_validator("output_file")
+    @classmethod
+    def _output_file_writable_target(cls, v: Optional[str]) -> Optional[str]:
+        # Reference validator (``core/task.py:223-231``): an existing
+        # path that is not a regular file (directory, socket) can never
+        # receive the output — reject at construction.
+        if v is None:
+            return None
+        import os
+
+        if os.path.exists(v) and not os.path.isfile(v):
+            raise ValueError(f"output_file {v!r} exists and is not a file")
+        return v
 
     @model_validator(mode="after")
     def _no_self_dependency(self) -> "Task":
@@ -220,6 +307,49 @@ class Task(BaseModel):
         self.status = TaskStatus.COMPLETED
         self.completed_at = time.time()
         self.result = result
+        if self.output_file:
+            self._write_output(result)
+
+    def _write_output(self, result: TaskResult) -> None:
+        """Persist the completed output to ``output_file`` (JSON for
+        structured outputs, text otherwise). Failure to write is recorded
+        on the result, never raised — completion already happened."""
+        import json as _json
+
+        try:
+            out = result.output
+            text = (
+                out if isinstance(out, str)
+                else _json.dumps(out, indent=2, default=repr)
+            )
+            with open(self.output_file, "w", encoding="utf-8") as f:
+                f.write(text if text is not None else "")
+        except (OSError, ValueError, TypeError) as exc:
+            # ValueError covers json circular refs and surrogate encode
+            # errors from write(); completion already happened, so record
+            # instead of raising out of mark_completed.
+            result.metadata.setdefault("cleanup_errors", []).append(
+                f"write {self.output_file}: {exc}"
+            )
+
+    def register_file_handle(self, handle: Any) -> None:
+        if handle is None:
+            raise ValueError("file handle must not be None")
+        self.file_handles.append(handle)
+
+    def register_temp_file(self, path: Any) -> None:
+        if not path:
+            raise ValueError("temp file path must not be empty")
+        self.temp_files.append(str(path))
+
+    def cleanup_resources(self) -> None:
+        """Close registered handles, remove temp files, and cascade to the
+        result (reference ``core/task.py:172-202``). Idempotent."""
+        errors = _cleanup_files(self.file_handles, self.temp_files)
+        if errors:
+            self.metadata.setdefault("cleanup_errors", []).extend(errors)
+        if self.result is not None:
+            self.result.cleanup_resources()
 
     def mark_failed(self, error: str, result: Optional[TaskResult] = None) -> None:
         self.status = TaskStatus.FAILED

@@ -40,13 +40,23 @@ class LLMBackend(abc.ABC):
         messages: Sequence[ChatMessage],
         tools: Optional[Sequence[ToolSpec]] = None,
         params: Optional[GenerationParams] = None,
+        info: Optional[Dict[str, Any]] = None,
     ):
         """Async generator of text deltas; concatenation equals the
         ``generate()`` content for the same request. Default adapter:
         one delta with the whole completion — backends with true
         incremental output (the native engine streams per fused decode
-        chunk) override."""
+        chunk) override.
+
+        ``info``, when a dict is passed, is filled in place before the
+        generator finishes with end-of-stream facts a text stream can't
+        carry: ``finish_reason`` ("stop" | "length" | ...) and
+        ``completion_tokens``. SSE consumers report truncation from it
+        (a stream that hit max_new_tokens must not claim "stop")."""
         response = await self.generate(messages, tools, params)
+        if info is not None:
+            info["finish_reason"] = response.finish_reason
+            info["completion_tokens"] = response.usage.completion_tokens
         if response.content:
             yield response.content
 

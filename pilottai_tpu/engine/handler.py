@@ -218,6 +218,7 @@ class LLMHandler:
         params: Optional[GenerationParams] = None,
         json_mode: Optional[bool] = None,
         json_schema: Optional[Dict[str, Any]] = None,
+        info: Optional[Dict[str, Any]] = None,
     ):
         """Streaming chat completion: an async generator of text deltas
         whose concatenation equals ``generate_response(...).content`` for
@@ -244,9 +245,18 @@ class LLMHandler:
             ):
                 start = time.perf_counter()
                 n_chars = 0
-                agen = self.backend.generate_stream(
-                    msgs, specs or None, params
-                ).__aiter__()
+                try:
+                    gen = self.backend.generate_stream(
+                        msgs, specs or None, params, info=info
+                    )
+                except TypeError:
+                    # Pre-`info` backend signature (user-supplied
+                    # backends): argument binding fails at call time,
+                    # before any iteration — safe to retry without.
+                    gen = self.backend.generate_stream(
+                        msgs, specs or None, params
+                    )
+                agen = gen.__aiter__()
                 failed = True  # timeout/backend error until proven otherwise
                 try:
                     while True:

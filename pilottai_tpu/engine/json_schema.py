@@ -86,6 +86,16 @@ class _Builder:
         if isinstance(bytes_, (int, np.integer)):
             bytes_ = [int(bytes_)]
         for b in bytes_:
+            # Retargeting an existing transition would silently replace
+            # one fragment's continuation with another's (e.g. a future
+            # union whose members share first bytes) — a wrong DFA that
+            # still compiles. Fail loudly; re-adding the same edge is a
+            # no-op.
+            if self.allowed[s][b] and int(self.next[s][b]) != t:
+                raise UnsupportedSchema(
+                    f"conflicting DFA transitions from state {s} on byte "
+                    f"{b:#x} (overlapping alternatives)"
+                )
             self.allowed[s][b] = True
             self.next[s][b] = t
 

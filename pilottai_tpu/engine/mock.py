@@ -107,10 +107,14 @@ class MockBackend(LLMBackend):
         messages: Sequence[ChatMessage],
         tools: Optional[Sequence[ToolSpec]] = None,
         params: Optional[GenerationParams] = None,
+        info: Optional[Dict[str, Any]] = None,
     ):
         """Word-granular streaming (whitespace kept on the leading word)
         so consumer tests see real multi-delta behavior."""
         response = await self.generate(messages, tools, params)
+        if info is not None:
+            info["finish_reason"] = response.finish_reason
+            info["completion_tokens"] = response.usage.completion_tokens
         content = response.content
         pos = 0
         while pos < len(content):

@@ -308,6 +308,23 @@ class NativeEngine(LLMBackend):
             json_schema_id=schema_id,
         )
 
+    def schema_support(self, schema: Dict[str, Any]) -> Optional[str]:
+        """None when ``schema`` can be enforced by constrained decoding
+        on this engine; else a human-readable reason. Used by the HTTP
+        server to reject strict-mode requests up front (OpenAI returns
+        400 for unsupported strict schemas) instead of degrading
+        silently. A successful check registers the schema, so the
+        subsequent generation reuses the same bank row."""
+        if self.schema_bank is None:
+            return "json_schema enforcement requires a byte tokenizer"
+        from pilottai_tpu.engine.json_schema import UnsupportedSchema
+
+        try:
+            self.schema_bank.register(schema)
+        except UnsupportedSchema as exc:
+            return str(exc)
+        return None
+
     async def generate(
         self,
         messages: Sequence[ChatMessage],
@@ -349,6 +366,10 @@ class NativeEngine(LLMBackend):
             ),
             latency=time.perf_counter() - start,
             finish_reason="stop" if len(token_ids) < params.max_new_tokens else "length",
+            schema_enforced=(
+                request.json_schema_id >= 0
+                if params.json_schema is not None else None
+            ),
         )
 
     async def generate_stream(
@@ -356,6 +377,7 @@ class NativeEngine(LLMBackend):
         messages: Sequence[ChatMessage],
         tools: Optional[Sequence[ToolSpec]] = None,
         params: Optional[GenerationParams] = None,
+        info: Optional[Dict[str, Any]] = None,
     ):
         """Async generator of text deltas: tokens surface as each fused
         decode chunk folds on the host (every ``engine_chunk`` device
@@ -438,6 +460,17 @@ class NativeEngine(LLMBackend):
                     emitted = safe
                 if stopped or final:
                     break
+            if info is not None:
+                # generate() parity: a stream that consumed the full
+                # token budget finished for "length" unless a stop
+                # string truncated it first.
+                info["finish_reason"] = (
+                    "stop" if stopped or n_seen < params.max_new_tokens
+                    else "length"
+                )
+                info["completion_tokens"] = n_seen
+                if params.json_schema is not None:
+                    info["schema_enforced"] = request.json_schema_id >= 0
             # Surface generation errors (engine stopped, device failure).
             if afut.done() and not afut.cancelled():
                 exc = afut.exception()

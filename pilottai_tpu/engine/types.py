@@ -92,6 +92,13 @@ class LLMResponse(BaseModel):
     finish_reason: str = "stop"
     latency: float = 0.0
     created_at: float = Field(default_factory=time.time)
+    # Tri-state: None = no json_schema was requested; True = the output
+    # was DFA-constrained to the requested schema; False = the request
+    # asked for a schema but the engine degraded to the generic JSON
+    # grammar (unsupported schema, full bank, subword vocab) — callers
+    # (the HTTP server) surface this instead of silently claiming
+    # enforcement.
+    schema_enforced: Optional[bool] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return self.model_dump()

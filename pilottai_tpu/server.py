@@ -144,6 +144,10 @@ class APIServer:
                 await self._route(method, path, headers, body, writer)
             except _HttpError as exc:
                 await self._send_error(writer, exc)
+            except (ConnectionError, asyncio.IncompleteReadError):
+                # Routine client drop (usually mid-SSE): no error log, and
+                # never write a 500 body into an already-started response.
+                raise
             except Exception as exc:  # noqa: BLE001 — request boundary
                 self._log.error("request failed: %s", exc, exc_info=True)
                 await self._send_error(
@@ -329,7 +333,7 @@ class APIServer:
     # ------------------------------------------------------------------ #
 
     def _gen_params(self, req: Dict[str, Any]) -> Tuple[
-        List[Dict[str, Any]], Optional[List[ToolSpec]], GenerationParams
+        List[Dict[str, Any]], Optional[List[ToolSpec]], GenerationParams, bool
     ]:
         messages = req.get("messages")
         if not isinstance(messages, list) or not messages:
@@ -369,6 +373,7 @@ class APIServer:
         if not isinstance(rf, dict):
             raise _HttpError(400, "'response_format' must be an object")
         json_schema = None
+        strict = False
         if rf.get("type") == "json_schema":
             # OpenAI nests {name, schema, strict} under json_schema.
             spec = rf.get("json_schema")
@@ -380,15 +385,27 @@ class APIServer:
                     "{'json_schema': {'schema': {...}}}"
                 )
             json_schema = spec["schema"]
+            strict = bool(spec.get("strict"))
+        # Absent vs present-but-invalid: a client's explicit
+        # "max_tokens": 0 is a 400, not silently the 256 default
+        # (`or` would swallow any falsy value).
+        max_tokens = req.get("max_tokens")
+        if max_tokens is None:
+            max_tokens = req.get("max_completion_tokens")
+        if max_tokens is None:
+            max_tokens = 256
+        if isinstance(max_tokens, bool) or not isinstance(max_tokens, int):
+            # No coercion: 2.7 truncating to 2 (or true to 1) would run a
+            # different budget than the client sent.
+            raise _HttpError(400, "'max_tokens' must be an integer")
+        if max_tokens < 1:
+            raise _HttpError(400, "'max_tokens' must be >= 1")
         try:
             # Client values are untrusted: a non-numeric temperature or
             # seed is a 400 invalid_request_error (OpenAI parity), not a
             # 500 from int()/pydantic deep in the handler.
             params = GenerationParams(
-                max_new_tokens=int(
-                    req.get("max_tokens")
-                    or req.get("max_completion_tokens") or 256
-                ),
+                max_new_tokens=max_tokens,
                 temperature=float(req.get("temperature", 0.7)),
                 top_k=int(req.get("top_k", 0)),
                 top_p=float(req.get("top_p", 1.0)),
@@ -400,23 +417,40 @@ class APIServer:
         except (TypeError, ValueError) as exc:
             # (pydantic's ValidationError subclasses ValueError)
             raise _HttpError(400, f"invalid sampling parameter: {exc}") from exc
-        return messages, tools, params
+        return messages, tools, params, strict
 
     async def _chat_completions(
         self, req: Dict[str, Any], writer: asyncio.StreamWriter
     ) -> None:
-        messages, tools, params = self._gen_params(req)
+        messages, tools, params, strict = self._gen_params(req)
         handler = self._pick_handler(req.get("model"))
         model = req.get("model") or getattr(
             getattr(handler, "config", None), "model_name", "default"
         )
+        if params.json_schema is not None and strict:
+            # OpenAI strict-mode parity: a schema the deployment cannot
+            # enforce is a 400 up front, never a 200 whose body silently
+            # degraded to the generic JSON grammar.
+            support = getattr(
+                getattr(handler, "backend", None), "schema_support", None
+            )
+            reason = (
+                support(params.json_schema) if support is not None
+                else "this model deployment cannot enforce json_schema"
+            )
+            if reason is not None:
+                raise _HttpError(
+                    400, f"response_format json_schema with strict=true "
+                    f"is not enforceable here: {reason}"
+                )
         rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
         created = int(time.time())
 
         if req.get("stream"):
             await self._sse_start(writer)
 
-            def chunk(delta: Dict[str, Any], finish: Optional[str]) -> None:
+            def chunk(delta: Dict[str, Any], finish: Optional[str],
+                      **extra: Any) -> None:
                 self._sse_event(writer, {
                     "id": rid, "object": "chat.completion.chunk",
                     "created": created, "model": model,
@@ -424,13 +458,15 @@ class APIServer:
                         "index": 0, "delta": delta,
                         "finish_reason": finish,
                     }],
+                    **extra,
                 })
 
             try:
                 chunk({"role": "assistant"}, None)
                 text_parts: List[str] = []
+                stream_info: Dict[str, Any] = {}
                 async for delta in handler.astream(
-                    messages, tools=tools, params=params
+                    messages, tools=tools, params=params, info=stream_info
                 ):
                     text_parts.append(delta)
                     chunk({"content": delta}, None)
@@ -439,7 +475,7 @@ class APIServer:
                 # is JSON text, so calls are parseable only once the
                 # stream ends — emit them as one final tool_calls delta
                 # (clients that only read content still saw the text).
-                finish = "stop"
+                finish = stream_info.get("finish_reason", "stop")
                 if tools:
                     from pilottai_tpu.engine.base import parse_tool_calls
 
@@ -455,7 +491,18 @@ class APIServer:
                                 "arguments": json.dumps(tc.arguments),
                             },
                         } for i, tc in enumerate(calls)]}, None)
-                chunk({}, finish)
+                extra: Dict[str, Any] = {}
+                if params.json_schema is not None:
+                    # Non-stream parity: streamed clients must also be
+                    # able to tell enforced from best-effort output.
+                    extra["schema_enforced"] = bool(
+                        stream_info.get("schema_enforced")
+                    )
+                if "completion_tokens" in stream_info:
+                    extra["usage"] = {
+                        "completion_tokens": stream_info["completion_tokens"],
+                    }
+                chunk({}, finish, **extra)
             except (ConnectionError, asyncio.CancelledError):
                 raise  # client gone / shutdown: astream's finally cancels
             except Exception as exc:  # noqa: BLE001 — surface in-band
@@ -477,7 +524,7 @@ class APIServer:
                     "arguments": json.dumps(tc.arguments),
                 },
             } for tc in response.tool_calls]
-        await self._send(writer, 200, {
+        payload: Dict[str, Any] = {
             "id": rid, "object": "chat.completion",
             "created": created, "model": response.model or model,
             "choices": [{
@@ -489,7 +536,14 @@ class APIServer:
                 "completion_tokens": response.usage.completion_tokens,
                 "total_tokens": response.usage.total_tokens,
             },
-        })
+        }
+        if params.json_schema is not None:
+            # Non-strict requests proceed on best effort; tell the client
+            # whether the output was actually DFA-enforced (mock and
+            # non-schema backends report not-enforced rather than None —
+            # the field exists exactly so clients never have to guess).
+            payload["schema_enforced"] = bool(response.schema_enforced)
+        await self._send(writer, 200, payload)
 
     # ------------------------------------------------------------------ #
     # /v1/embeddings

@@ -504,3 +504,90 @@ async def test_json_mode_response_format():
         json.loads(content)  # mock replies are valid JSON already
     finally:
         await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_max_tokens_zero_is_400():
+    """Explicit max_tokens: 0 must be rejected, not silently replaced by
+    the 256 default (ADVICE r4)."""
+    server = await APIServer(_mock_handler()).start()
+    try:
+        for bad in (0, -3, "many"):
+            status, _, body = await _request(
+                server.port, "POST", "/v1/chat/completions",
+                {"messages": [{"role": "user", "content": "x"}],
+                 "max_tokens": bad},
+            )
+            assert status == 400, body
+        # Absent still defaults fine.
+        status, _, _ = await _request(
+            server.port, "POST", "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "x"}]},
+        )
+        assert status == 200
+    finally:
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_strict_json_schema_unenforceable_is_400():
+    """strict: true on a deployment that cannot enforce the schema
+    (mock backend has no constrained decoding) is a 400 up front —
+    OpenAI strict-mode parity (ADVICE r4 medium)."""
+    server = await APIServer(_mock_handler()).start()
+    try:
+        schema = {"type": "object", "properties": {"a": {"type": "integer"}},
+                  "required": ["a"]}
+        status, _, body = await _request(
+            server.port, "POST", "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "x"}],
+             "response_format": {"type": "json_schema",
+                                 "json_schema": {"name": "t", "strict": True,
+                                                 "schema": schema}}},
+        )
+        assert status == 400
+        assert b"strict" in body
+        # Non-strict: best effort is allowed, but the response must say
+        # enforcement did NOT happen.
+        status, _, body = await _request(
+            server.port, "POST", "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "x"}],
+             "response_format": {"type": "json_schema",
+                                 "json_schema": {"name": "t",
+                                                 "schema": schema}}},
+        )
+        assert status == 200
+        assert json.loads(body)["schema_enforced"] is False
+    finally:
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_strict_json_schema_enforced_on_native_engine():
+    """On the byte-tokenizer CPU engine, strict json_schema passes the
+    pre-check and the response reports schema_enforced: true."""
+    handler = LLMHandler(LLMConfig(
+        model_name="llama-tiny", provider="cpu",
+        engine_slots=2, engine_max_seq=256,
+    ))
+    server = await APIServer(handler).start()
+    try:
+        schema = {"type": "object",
+                  "properties": {"ok": {"type": "boolean"}},
+                  "required": ["ok"]}
+        status, _, body = await _request(
+            server.port, "POST", "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "x"}],
+             "max_tokens": 24,
+             "response_format": {"type": "json_schema",
+                                 "json_schema": {"name": "t", "strict": True,
+                                                 "schema": schema}}},
+        )
+        assert status == 200, body
+        data = json.loads(body)
+        assert data["schema_enforced"] is True
+        out = json.loads(data["choices"][0]["message"]["content"])
+        assert isinstance(out["ok"], bool)
+    finally:
+        await server.stop()
+        await handler.stop()

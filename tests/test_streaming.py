@@ -322,3 +322,23 @@ async def test_native_stream_with_speculation():
         assert "".join(deltas) == full
     finally:
         await handler.stop()
+
+
+@pytest.mark.asyncio
+async def test_native_stream_info_matches_generate(tiny_handler):
+    """The stream's end-of-stream ``info`` (finish_reason /
+    completion_tokens) must agree with what ``generate()`` reports for
+    the same request — SSE consumers report truncation from it."""
+    msgs = [ChatMessage(content="stream info parity prompt")]
+    for max_new in (4, 48):  # 4 almost surely truncates ("length")
+        params = GenerationParams(max_new_tokens=max_new, temperature=0.0)
+        resp = await tiny_handler.generate_response(msgs, params=params)
+        info = {}
+        deltas = [
+            d async for d in tiny_handler.astream(
+                msgs, params=params, info=info
+            )
+        ]
+        assert "".join(deltas) == resp.content
+        assert info["finish_reason"] == resp.finish_reason
+        assert info["completion_tokens"] == resp.usage.completion_tokens

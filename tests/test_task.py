@@ -96,3 +96,59 @@ def test_to_prompt_contains_fields():
     t = Task(description="summarize doc", type="summarize", tools=["reader"])
     prompt = t.to_prompt()
     assert "summarize doc" in prompt and "reader" in prompt and t.id in prompt
+
+
+def test_task_result_resource_cleanup(tmp_path):
+    """TaskResult owns registered handles/temp files (reference
+    ``core/task.py:29-66``): cleanup closes, unlinks, and is idempotent."""
+    tmp = tmp_path / "scratch.bin"
+    tmp.write_bytes(b"x" * 16)
+    handle = open(tmp_path / "out.log", "w")
+    res = TaskResult(success=True, output="done")
+    res.register_file_handle(handle)
+    res.register_temp_file(tmp)
+    assert not res.resources_cleaned
+    res.cleanup_resources()
+    assert res.resources_cleaned
+    assert handle.closed
+    assert not tmp.exists()
+    res.cleanup_resources()  # idempotent
+    assert "cleanup_errors" not in res.metadata
+    # Excluded from serialization.
+    assert "file_handles" not in res.model_dump()
+
+
+def test_task_cleanup_cascades_to_result(tmp_path):
+    tmp = tmp_path / "stage.tmp"
+    tmp.write_text("intermediate")
+    t = Task(description="with resources")
+    t.register_temp_file(tmp)
+    res = TaskResult(success=True)
+    rtmp = tmp_path / "result.tmp"
+    rtmp.write_text("r")
+    res.register_temp_file(rtmp)
+    t.mark_completed(res)
+    t.cleanup_resources()
+    assert not tmp.exists() and not rtmp.exists()
+    assert res.resources_cleaned
+
+
+def test_task_output_file_written_on_completion(tmp_path):
+    """Unlike the reference (declares output_file, never writes it),
+    completion persists the output; structured outputs as JSON."""
+    import json
+
+    out = tmp_path / "answer.json"
+    t = Task(description="write me", output_file=str(out))
+    t.mark_completed(TaskResult(success=True, output={"answer": 42}))
+    assert json.loads(out.read_text()) == {"answer": 42}
+
+    txt = tmp_path / "answer.txt"
+    t2 = Task(description="text", output_file=str(txt))
+    t2.mark_completed(TaskResult(success=True, output="plain text"))
+    assert txt.read_text() == "plain text"
+
+
+def test_task_output_file_rejects_directory(tmp_path):
+    with pytest.raises(ValueError):
+        Task(description="bad", output_file=str(tmp_path))
