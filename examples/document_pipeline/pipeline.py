@@ -76,10 +76,21 @@ def split_sections(text: str) -> List[Tuple[str, str]]:
     return out
 
 
-def make_tools(memory: EnhancedMemory) -> Dict[str, Tool]:
-    """The worker toolset, closed over the shared semantic memory."""
+def make_tools(
+    memory: EnhancedMemory,
+    default_path: Optional[str] = None,
+    default_question: str = "key findings, risks",
+) -> Dict[str, Tool]:
+    """The worker toolset, closed over the shared semantic memory.
 
-    async def extract_sections(path: str) -> Dict[str, Any]:
+    Tool arguments default to the pipeline's own document/question: a
+    model that invokes a stage tool with bare ``{}`` arguments (the
+    protocol model's trained shape) still acts on the right document —
+    the binding lives in the pipeline wiring, not in fragile prompt
+    echoing."""
+
+    async def extract_sections(path: Optional[str] = None) -> Dict[str, Any]:
+        path = path or default_path or str(SAMPLE_DOC)
         text = read_document(path)
         sections = split_sections(text)
         for heading, body in sections:
@@ -106,46 +117,23 @@ def make_tools(memory: EnhancedMemory) -> Dict[str, Tool]:
                 )
         return {"valid": not issues, "sections": len(stored), "issues": issues}
 
-    async def search_notes(query: str, k: int = 3) -> List[str]:
-        items = await memory.semantic_search(query, limit=k, tags={"extract"})
-        if not items:
-            # No embedder attached: per-keyword substring fallback (a whole
-            # natural-language question never matches a section verbatim).
-            seen: Dict[int, Dict[str, Any]] = {}
-            for word in re.findall(r"[a-zA-Z]{4,}", query):
-                for item in await memory.keyword_search(
-                    word.lower(), tags={"extract"}, limit=k
-                ):
-                    seen.setdefault(item["id"], item)
-                if len(seen) >= k:
-                    break
-            items = list(seen.values())[:k]
-        return [item["text"] for item in items]
-
+    # Memory search needs no hand-built tool anymore: agents constructed
+    # with ``memory=`` auto-register a ``memory_search`` tool and get
+    # retrieved context in step planning (core/agent.py, VERDICT r4 #5) —
+    # the per-keyword fallback this example used to hand-roll now lives in
+    # EnhancedMemory's no-embedder path.
     return {
         "extract_sections": Tool(
             name="extract_sections",
             function=extract_sections,
             description="Read a document and store its sections in memory",
-            parameters={
-                "properties": {"path": {"type": "string"}},
-                "required": ["path"],
-            },
+            parameters={"properties": {"path": {"type": "string"}}},
         ),
         "validate_extraction": Tool(
             name="validate_extraction",
             function=validate_extraction,
             description="Structurally validate the extracted sections in memory",
             parameters={"properties": {"min_sections": {"type": "integer"}}},
-        ),
-        "search_notes": Tool(
-            name="search_notes",
-            function=search_notes,
-            description="Semantic-search the extracted sections",
-            parameters={
-                "properties": {"query": {"type": "string"}},
-                "required": ["query"],
-            },
         ),
     }
 
@@ -182,7 +170,7 @@ def _pipeline_responder(prompt: str) -> Optional[Dict[str, Any]]:
         }
     if "Type: summarize" in prompt and not acted:
         return {
-            "task_complete": False, "action": "search_notes",
+            "task_complete": False, "action": "memory_search",
             "arguments": {"query": payload.get("question", "key findings, risks")},
             "reasoning": "ground the summary in memory",
         }
@@ -206,14 +194,31 @@ def _handler(provider: str) -> LLMHandler:
             LLMConfig(provider="mock"),
             backend=MockBackend(responders=[_pipeline_responder]),
         )
+    # Real engines serve the in-tree-trained protocol model (greedy,
+    # grammar-constrained): the agents' decisions come from real decoded
+    # tokens AND the tasks actually succeed (train/protocol.py).
+    from pilottai_tpu.core.config import SamplingConfig
+    from pilottai_tpu.train.protocol import (
+        DEFAULT_CHECKPOINT,
+        SERVE_MAX_NEW,
+        SERVE_MAX_SEQ,
+    )
+
+    ckpt = DEFAULT_CHECKPOINT
+    has_ckpt = ckpt.exists() and any(ckpt.iterdir())
     return LLMHandler(
         LLMConfig(
-            model_name="llama3-1b-byte" if provider == "tpu" else "llama-tiny",
+            model_name="protocol-s",
             provider=provider,
+            checkpoint_path=str(ckpt) if has_ckpt else None,
             engine_slots=8,
-            engine_max_seq=512,
+            engine_max_seq=SERVE_MAX_SEQ,
             engine_chunk=24,
+            engine_speculate=4,
             dtype="bfloat16" if provider == "tpu" else "float32",
+            sampling=SamplingConfig(
+                temperature=0.0, max_new_tokens=SERVE_MAX_NEW
+            ),
         )
     )
 
@@ -250,7 +255,7 @@ def build_pipeline(
             role="generator", goal="produce grounded summaries",
             specializations=["summarize"],
         ),
-        llm=llm, tools=[tools["search_notes"]], memory=memory,
+        llm=llm, memory=memory,  # memory_search auto-registers
     )
     manager = BaseAgent(
         config=AgentConfig(
@@ -290,7 +295,7 @@ def stage_tasks(path: str, question: str) -> List[Task]:
     )
     summarize = Task(
         description=f"Answer from the extracted document: {question}",
-        type="summarize", tools=["search_notes"],
+        type="summarize", tools=["memory_search"],
         dependencies=[evaluate.id], payload={"question": question},
     )
     return [extract, evaluate, summarize]

@@ -285,6 +285,11 @@ class ServeConfig(BaseModel):
     load_balancing_enabled: bool = False
     dynamic_scaling_enabled: bool = False
     fault_tolerance_enabled: bool = False
+    # Manager-side delegation (delegation/delegator.py): when enabled and a
+    # manager agent with children is attached, tasks route through
+    # TaskDelegator.evaluate_delegation BEFORE the router (reference
+    # ``delegation/task_delegator.py:41-111`` — never wired there).
+    delegation_enabled: bool = False
     # Durable task journal (checkpoint/journal.py; SURVEY.md §5.4 — the
     # reference loses all queue state on crash/preemption).
     journal_path: Optional[str] = None

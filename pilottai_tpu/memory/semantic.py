@@ -17,6 +17,7 @@ indexes drift, §2.12-h). Substring search remains available as
 from __future__ import annotations
 
 import asyncio
+import re
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -318,6 +319,26 @@ class EnhancedMemory:
         needle = query.lower()
         candidates = self._filter(list(self._items), tags, min_priority)
         hits = [i for i in candidates if needle in i.text.lower()]
+        if not hits and len(needle.split()) > 1:
+            # A whole natural-language question never matches an item
+            # verbatim: degrade to per-word OR matching, ranked by how
+            # many query words each item contains (the no-embedder path
+            # must still ground multi-word questions).
+            words = [w for w in re.findall(r"[a-z0-9]{4,}", needle)]
+            if words:
+                scored = [
+                    (sum(1 for w in words if w in i.text.lower()), i)
+                    for i in candidates
+                ]
+                scored = [(n, i) for n, i in scored if n > 0]
+                scored.sort(
+                    key=lambda p: (p[0], p[1].priority, p[1].created_at),
+                    reverse=True,
+                )
+                return [
+                    {**i.to_dict(), "score": n / len(words)}
+                    for n, i in scored[:limit]
+                ]
         hits.sort(key=lambda i: (i.priority, i.created_at), reverse=True)
         return [{**item.to_dict(), "score": 1.0} for item in hits[:limit]]
 

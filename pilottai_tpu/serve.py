@@ -163,6 +163,7 @@ class Serve:
         self.load_balancer = None
         self.dynamic_scaling = None
         self.fault_tolerance = None
+        self.delegator = None
 
         # Durable task journal (crash/preemption recovery, SURVEY §5.4).
         self.journal = None
@@ -319,6 +320,18 @@ class Serve:
 
             self.fault_tolerance = FaultTolerance(self)
             await self.fault_tolerance.start()
+        if self.config.delegation_enabled and self.manager_agent is not None:
+            from pilottai_tpu.delegation.delegator import TaskDelegator
+
+            # Serve-level enablement implies the manager's own gate: the
+            # delegator checks agent.config.delegation_enabled
+            # (_should_delegate), and one switch must mean one behavior.
+            self.manager_agent.config.delegation_enabled = True
+            self.delegator = TaskDelegator(self.manager_agent)
+            self._log.info(
+                "delegation attached (manager=%s, children=%d)",
+                self.manager_agent.id[:8], len(self.manager_agent.child_agents),
+            )
 
     async def stop(self) -> None:
         if not self._running:
@@ -679,14 +692,38 @@ class Serve:
             )
             try:
                 result = await agent.execute_task(task)
+                if (
+                    self.delegator is not None
+                    and task.metadata.get("delegation") is not None
+                ):
+                    # Outcome feedback closes the loop: future scoring
+                    # prefers children that actually deliver
+                    # (delegation/delegator.py:record_delegation).
+                    await self.delegator.record_delegation(
+                        agent.id, task, result.success,
+                        execution_time=result.execution_time,
+                        error=result.error,
+                    )
                 result = await self._maybe_retry(task, result)
             finally:
                 self.running_tasks.pop(task.id, None)
             self._finalize(task, result)
 
     async def _select_agent(self, task: Task) -> Optional[BaseAgent]:
-        """Manager hook first, router second (reference ``:488-504``)."""
+        """Delegation gate first (when attached), then the manager hook,
+        then the router (reference ``:488-504`` +
+        ``delegation/task_delegator.py:41-111`` semantics)."""
         candidates = self.agent_list()
+        if self.delegator is not None:
+            target, reason = await self.delegator.evaluate_delegation(task)
+            if target is not None:
+                task.metadata["delegation"] = {
+                    "by": self.manager_agent.id, "reason": reason,
+                }
+                self._emit_event(
+                    task, "delegated", agent_id=target.id, reason=reason
+                )
+                return target
         if self.manager_agent is not None:
             chosen = await self.manager_agent.select_agent(task, candidates)
             if chosen is not None:

@@ -237,3 +237,90 @@ async def test_priority_queue_eviction():
     d = Task(description="d", priority=TaskPriority.LOW)
     with pytest.raises(asyncio.QueueFull):
         await q.put(d)
+
+
+@pytest.mark.asyncio
+async def test_delegation_routes_complex_task_to_child():
+    """VERDICT r4 #4: ServeConfig.delegation_enabled attaches a
+    TaskDelegator; a task over the manager's complexity limit lands on a
+    child via evaluate_delegation, and the outcome is recorded."""
+    manager = worker(role_type="manager", max_task_complexity=3)
+    children = [worker(), worker()]
+    for c in children:
+        manager.add_child_agent(c)
+    serve = Serve(
+        name="deleg", agents=children, manager_agent=manager,
+        manager_llm=LLMHandler(LLMConfig(provider="mock"),
+                               backend=MockBackend()),
+        config=ServeConfig(
+            delegation_enabled=True, decomposition_enabled=False,
+            evaluation_enabled=False, max_concurrent_tasks=4,
+        ),
+    )
+    await serve.start()
+    try:
+        assert serve.delegator is not None
+        task = Task(description="heavy multi-part job", complexity=8)
+        result = await serve.execute_task(task, timeout=30)
+        assert result.success
+        # Landed on a child, not the manager, and was recorded.
+        assert task.agent_id in {c.id for c in children}
+        assert task.metadata["delegation"]["reason"] == "complexity over limit"
+        assert serve.delegator.get_metrics()[task.agent_id]["delegations"] == 1
+    finally:
+        await serve.stop()
+
+
+@pytest.mark.asyncio
+async def test_delegation_disabled_bypasses():
+    manager = worker(role_type="manager", max_task_complexity=3)
+    child = worker()
+    manager.add_child_agent(child)
+    serve = Serve(
+        name="nodeleg", agents=[child], manager_agent=manager,
+        manager_llm=LLMHandler(LLMConfig(provider="mock"),
+                               backend=MockBackend()),
+        config=ServeConfig(
+            delegation_enabled=False, decomposition_enabled=False,
+            evaluation_enabled=False, max_concurrent_tasks=4,
+        ),
+    )
+    await serve.start()
+    try:
+        assert serve.delegator is None
+        task = Task(description="simple job", complexity=8)
+        result = await serve.execute_task(task, timeout=30)
+        assert result.success
+        assert "delegation" not in task.metadata
+    finally:
+        await serve.stop()
+
+
+@pytest.mark.asyncio
+async def test_delegation_prefers_unloaded_child():
+    """The acceptance gate skips overloaded children."""
+    manager = worker(role_type="manager", max_task_complexity=1)
+    free = worker()
+    busy = worker(max_queue_size=10)
+    for c in (busy, free):
+        manager.add_child_agent(c)
+    # Saturate `busy` past the acceptance threshold (0.8).
+    for i in range(9):
+        await busy.add_task(Task(description=f"fill {i}"))
+    serve = Serve(
+        name="deleg2", agents=[busy, free], manager_agent=manager,
+        manager_llm=LLMHandler(LLMConfig(provider="mock"),
+                               backend=MockBackend()),
+        config=ServeConfig(
+            delegation_enabled=True, decomposition_enabled=False,
+            evaluation_enabled=False, max_concurrent_tasks=4,
+        ),
+    )
+    await serve.start()
+    try:
+        task = Task(description="needs a free worker", complexity=5)
+        result = await serve.execute_task(task, timeout=30)
+        assert result.success
+        assert task.agent_id == free.id
+    finally:
+        await serve.stop()
