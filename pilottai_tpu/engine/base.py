@@ -114,3 +114,31 @@ def render_chat(messages: Sequence[ChatMessage]) -> str:
         parts.append(f"<|{m.role}|>\n{m.content}")
     parts.append("<|assistant|>\n")
     return "\n".join(parts)
+
+
+def tool_preamble(tools: Sequence[ToolSpec]) -> str:
+    """The tool-availability header the engine injects for function
+    calling. ONE definition shared by the serving path
+    (``native.py:_build_request``) and the protocol-model training data
+    (``train/protocol.py``) — the model is trained on byte-identical
+    framing to what it will see at serve time."""
+    tool_desc = "\n".join(f"- {t.name}: {t.description}" for t in tools)
+    return (
+        f"Available tools:\n{tool_desc}\n\n"
+        'To invoke one, reply {"tool_call": {"name": ..., '
+        '"arguments": {...}}} or {"action": <tool name>, '
+        '"arguments": {...}}.'
+    )
+
+
+def render_generic_request(
+    messages: Sequence[ChatMessage],
+    tools: Optional[Sequence[ToolSpec]] = None,
+) -> str:
+    """Full request text on the generic (template-less) path: tool
+    preamble + chat transcript. This is exactly what a byte-tokenizer
+    engine encodes (modulo left-truncation to the KV budget)."""
+    prompt = render_chat(messages)
+    if tools:
+        prompt = f"{tool_preamble(tools)}\n\n{prompt}"
+    return prompt

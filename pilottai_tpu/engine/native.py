@@ -17,7 +17,12 @@ import jax
 import jax.numpy as jnp
 
 from pilottai_tpu.core.config import LLMConfig
-from pilottai_tpu.engine.base import LLMBackend, parse_tool_calls, render_chat
+from pilottai_tpu.engine.base import (
+    LLMBackend,
+    parse_tool_calls,
+    render_generic_request,
+    tool_preamble,
+)
 from pilottai_tpu.engine.batcher import ContinuousBatcher, GenRequest
 from pilottai_tpu.engine.tokenizer import (
     ByteTokenizer,
@@ -123,9 +128,11 @@ class NativeEngine(LLMBackend):
             dict(mesh_cfg.shape),
         )
         if self.config.checkpoint_path:
-            from pilottai_tpu.models.loader import load_hf_checkpoint
+            # Format-dispatching: HF safetensors or a native orbax tree
+            # (in-tree trained models, e.g. protocol-s).
+            from pilottai_tpu.models.loader import load_checkpoint
 
-            params = load_hf_checkpoint(
+            params = load_checkpoint(
                 self.model_cfg, self.config.checkpoint_path, mesh=self.mesh,
                 dtype=self.model_cfg.dtype,
             )
@@ -241,20 +248,13 @@ class NativeEngine(LLMBackend):
         tools: Optional[Sequence[ToolSpec]],
         params: GenerationParams,
     ) -> GenRequest:
-        tool_text = None
-        if tools:
-            tool_desc = "\n".join(f"- {t.name}: {t.description}" for t in tools)
-            tool_text = (
-                f"Available tools:\n{tool_desc}\n\n"
-                'To invoke one, reply {"tool_call": {"name": ..., '
-                '"arguments": {...}}} or {"action": <tool name>, '
-                '"arguments": {...}}.'
-            )
+        tool_text = tool_preamble(tools) if tools else None
         # Checkpoint-native chat rendering first (HF chat_template via
         # the tokenizer; instruct models are fine-tuned on their own
         # header format) — the tool preamble rides as a system turn.
         # Byte tokenizers and template-less checkpoints fall back to the
-        # generic transcript, byte-identical to previous behavior.
+        # generic transcript, byte-identical to previous behavior (and to
+        # the protocol-model training data, train/protocol.py).
         msg_dicts = [{"role": m.role, "content": m.content} for m in messages]
         if tool_text:
             msg_dicts = [{"role": "system", "content": tool_text}] + msg_dicts
@@ -263,10 +263,9 @@ class NativeEngine(LLMBackend):
             # Templates emit their own BOS text; add_bos would double it.
             prompt_ids = self.tokenizer.encode(rendered, add_bos=False)
         else:
-            prompt = render_chat(messages)
-            if tool_text:
-                prompt = f"{tool_text}\n\n{prompt}"
-            prompt_ids = self.tokenizer.encode(prompt)
+            prompt_ids = self.tokenizer.encode(
+                render_generic_request(messages, tools)
+            )
         # Schema-constrained decoding: compile/look up in the bank
         # (byte tokenizers only). Unsupported schemas, full banks and
         # subword vocabs degrade to the generic grammar — still valid

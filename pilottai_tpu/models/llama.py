@@ -85,3 +85,29 @@ MIXTRAL_8X7B_BYTE = MIXTRAL_8X7B.replace(
 
 # Tiny MoE for tests / the multichip dry run (exercises expert parallelism).
 MOE_TINY = LLAMA_TINY.replace(name="moe-tiny", n_experts=4, n_active_experts=2)
+
+# The agent-protocol model: a small byte-vocab Llama trunk sized to learn
+# the rules.yaml JSON wire protocol (train/protocol.py) and serve it fast —
+# ~4M params, so one decode step is microseconds of device time and a
+# 32-agent swarm shares one chip trivially. vocab 384 == ByteTokenizer's
+# padded vocab, so the trained checkpoint needs no vocab shim at serve time.
+PROTOCOL_S = ModelConfig(
+    name="protocol-s",
+    family="llama",
+    vocab_size=384,
+    hidden_size=256,
+    n_layers=4,
+    n_heads=8,
+    n_kv_heads=4,
+    head_dim=32,
+    intermediate_size=1024,
+    max_seq_len=1024,
+    tie_embeddings=True,
+)
+
+# Micro variant for CPU tests of the training recipe (fast convergence
+# checks, not a servable artifact).
+PROTOCOL_XS = PROTOCOL_S.replace(
+    name="protocol-xs", hidden_size=128, n_layers=2, n_heads=4, n_kv_heads=2,
+    intermediate_size=384, max_seq_len=512,
+)

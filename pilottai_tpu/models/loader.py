@@ -125,6 +125,51 @@ def load_hf_checkpoint(
 
 # ------------------------- native checkpointing ------------------------- #
 
+def is_hf_checkpoint(path: str | Path) -> bool:
+    """True when ``path`` holds HF-layout safetensors (vs an orbax tree
+    written by ``save_params``)."""
+    path = Path(path)
+    return (
+        (path / "model.safetensors.index.json").exists()
+        or any(path.glob("*.safetensors"))
+    )
+
+
+def load_checkpoint(
+    cfg: ModelConfig,
+    path: str | Path,
+    mesh: Optional[Any] = None,
+    dtype=jnp.bfloat16,
+) -> Dict[str, Any]:
+    """Format-dispatching load: HF safetensors or native orbax."""
+    if is_hf_checkpoint(path):
+        return load_hf_checkpoint(cfg, path, mesh=mesh, dtype=dtype)
+    return load_native_checkpoint(cfg, path, mesh=mesh, dtype=dtype)
+
+
+def load_native_checkpoint(
+    cfg: ModelConfig,
+    path: str | Path,
+    mesh: Optional[Any] = None,
+    dtype=jnp.bfloat16,
+) -> Dict[str, Any]:
+    """Load an orbax params tree written by ``save_params`` (e.g. the
+    protocol model, ``train/protocol.py``): cast floating leaves to the
+    serving dtype and place on the mesh by logical axes."""
+    from pilottai_tpu.parallel.sharding import shard_params
+
+    raw = restore_params(path)
+
+    def _cast(a):
+        a = jnp.asarray(a)
+        return a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a
+
+    params = jax.tree.map(_cast, raw)
+    if mesh is not None:
+        params = shard_params(params, param_logical_axes(cfg), mesh)
+    return params
+
+
 def save_params(params: Dict[str, Any], path: str | Path) -> None:
     """Orbax save (durable model checkpoint; reference has no checkpointing
     at all, SURVEY.md §5.4)."""

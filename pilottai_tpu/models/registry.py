@@ -20,6 +20,8 @@ for _cfg in (
     llama.LLAMA3_8B_BYTE,
     llama.LLAMA3_1B_BYTE,
     llama.LLAMA_TINY,
+    llama.PROTOCOL_S,
+    llama.PROTOCOL_XS,
     llama.MIXTRAL_8X7B,
     llama.MIXTRAL_8X7B_BYTE,
     llama.MOE_TINY,

@@ -73,13 +73,23 @@ def next_token_loss(
     logits: jax.Array,   # [B, T, V] fp32
     tokens: jax.Array,   # [B, T]
     valid: jax.Array,    # [B]
+    loss_start: Optional[jax.Array] = None,  # [B] first TARGET index
 ) -> jax.Array:
-    """Mean next-token cross-entropy over valid (non-pad) positions."""
+    """Mean next-token cross-entropy over valid (non-pad) positions.
+
+    ``loss_start[b]`` masks the loss to predictions of tokens at indices
+    >= loss_start[b] — prompt-masked supervised fine-tuning (the protocol
+    model learns the *response*, not to model its own prompts). None (or
+    zeros) is plain LM loss over the whole row.
+    """
     T = tokens.shape[1]
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    mask = (jnp.arange(T - 1)[None, :] < (valid - 1)[:, None]).astype(jnp.float32)
+    pos = jnp.arange(T - 1)[None, :]  # position i predicts token i+1
+    mask = (pos < (valid - 1)[:, None]).astype(jnp.float32)
+    if loss_start is not None:
+        mask = mask * (pos + 1 >= loss_start[:, None]).astype(jnp.float32)
     return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
 
 
@@ -157,7 +167,7 @@ class Trainer:
             else None
         )
 
-        def train_step(params, opt_state, tokens, valid):
+        def train_step(params, opt_state, tokens, valid, loss_start):
             B, T = tokens.shape
             positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
 
@@ -173,7 +183,7 @@ class Trainer:
                     remat=tc.remat, ring_mesh=ring_mesh,
                     flash_mesh=flash_mesh,
                 )
-                lm_loss = next_token_loss(logits, tokens, valid)
+                lm_loss = next_token_loss(logits, tokens, valid, loss_start)
                 return lm_loss + tc.moe_aux_weight * moe_aux, (lm_loss, moe_aux)
 
             (loss, (lm_loss, moe_aux)), grads = jax.value_and_grad(
@@ -202,6 +212,7 @@ class Trainer:
                 None,  # opt_state: inherit placement from init
                 NamedSharding(self.mesh, batch_spec),
                 NamedSharding(self.mesh, valid_spec),
+                NamedSharding(self.mesh, valid_spec),  # loss_start
             ),
             # Pin output params to the same placement as the inputs so the
             # state round-trips through step() without resharding.
@@ -213,19 +224,28 @@ class Trainer:
         self, state: Tuple[Any, Any], batch: Dict[str, jax.Array]
     ) -> Tuple[Tuple[Any, Any], Dict[str, jax.Array]]:
         params, opt_state = state
-        tokens, valid = self.shard_batch(batch)
+        tokens, valid, loss_start = self.shard_batch(batch)
         with jax.set_mesh(self.mesh):
-            params, opt_state, metrics = self._step(params, opt_state, tokens, valid)
+            params, opt_state, metrics = self._step(
+                params, opt_state, tokens, valid, loss_start
+            )
         return (params, opt_state), metrics
 
     def shard_batch(
         self, batch: Dict[str, Any]
-    ) -> Tuple[jax.Array, jax.Array]:
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
         tokens = jnp.asarray(batch["tokens"], jnp.int32)
         valid = jnp.asarray(batch["valid"], jnp.int32)
+        loss_start = jnp.asarray(
+            batch.get("loss_start", np.zeros(tokens.shape[0])), jnp.int32
+        )
         tok_sh = NamedSharding(self.mesh, logical_to_spec(("batch", "seq"), self.rules))
         val_sh = NamedSharding(self.mesh, logical_to_spec(("batch",), self.rules))
-        return jax.device_put(tokens, tok_sh), jax.device_put(valid, val_sh)
+        return (
+            jax.device_put(tokens, tok_sh),
+            jax.device_put(valid, val_sh),
+            jax.device_put(loss_start, val_sh),
+        )
 
 
 def synthetic_batches(
