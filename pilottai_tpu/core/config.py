@@ -139,6 +139,11 @@ class LLMConfig(BaseModel):
     # slots whose n-gram acceptance collapses on novel text
     # (engine/decode.py:_model_drafts). Requires engine_speculate >= 2.
     engine_draft_layers: int = Field(default=0, ge=0)
+    # Chunked prefill: long cold prompts admit in page-aligned segments
+    # of this many tokens, one per device-loop cycle, so live slots'
+    # decode chunks interleave with the prefill instead of stalling
+    # behind it (paged KV only). None = auto (1024 when paged); 0 = off.
+    engine_prefill_chunk: Optional[int] = None
     # int8 KV cache ("int8" or None): panels stored int8 with symmetric
     # per-token-per-head scales (ops/kvcache.py:quantize_kv). Doubles
     # resident context per HBM GB everywhere; the decode-bandwidth win

@@ -1462,6 +1462,53 @@ def admit_group_prefix_paged(
     return cache, dstate, sampling, first, history
 
 
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def extend_prompt_paged(
+    params,
+    cfg: ModelConfig,
+    cache: PagedKVCache,
+    prefix_pages: jax.Array,  # [n_prefix_bucket] int32 — pages already
+                              # written for this slot, sentinel-padded
+    prefix_len: jax.Array,    # scalar int32 — page-aligned tokens written
+    seg_tokens: jax.Array,    # [1, Ts] right-padded prompt segment
+    seg_lens: jax.Array,      # [1] true segment length
+    page_rows: jax.Array,     # [1, max_pages] the slot's block table
+):
+    """One chunked-prefill segment of a long prompt (VERDICT r5 #6):
+    prefill ``seg_tokens`` attending to the KV already written for this
+    slot, scatter its K/V into the slot's private pages — and nothing
+    else. No sampling, no decode install, no length install: the slot
+    stays decode-inactive until the FINAL segment admits through
+    ``admit_group_prefix_paged``. The batcher dispatches one segment per
+    device-loop cycle, so live slots' decode chunks interleave instead
+    of stalling behind a monolithic multi-thousand-token prefill."""
+    P = cache.page_size
+    K = cache.n_kv_heads
+    Pb = prefix_pages.shape[0] * P
+
+    def _chain_gather(a):
+        return a[:, prefix_pages].reshape((K, Pb) + a.shape[3:])
+
+    panels = []
+    for l in range(cfg.n_layers):
+        k_, v_, sc = _bounded_panels(cache, l, _chain_gather)
+        panels.append(_dequant_pair(k_, v_, sc, cfg.dtype))
+    pks = jnp.stack([p[0] for p in panels])
+    pvs = jnp.stack([p[1] for p in panels])
+    cache_dtype = (
+        cfg.dtype if cache.scales is not None else cache.layers[0][0].dtype
+    )
+    _logits, ks, vs = _tail_prefill_core(
+        params, cfg, pks, pvs, prefix_len, seg_tokens, seg_lens,
+        cache_dtype,
+    )
+    ks_w = ks.transpose(0, 1, 3, 2, 4)  # [L, 1, Ts, K, H]
+    vs_w = vs.transpose(0, 1, 3, 2, 4)
+    return write_prompts_paged(
+        cache, page_rows, ks_w, vs_w, seg_lens, pos_offset=prefix_len
+    )
+
+
 @partial(jax.jit, static_argnames=("p_bucket", "dtype"))
 def export_prefix(cache: KVCache, slot, p_bucket: int, dtype=None):
     """Read one slot's first ``p_bucket`` cache rows out as stacked

@@ -229,6 +229,7 @@ class NativeEngine(LLMBackend):
             draft_layers=self.config.engine_draft_layers,
             pipeline_depth=self.config.engine_pipeline,
             schema_bank=self.schema_bank,
+            prefill_chunk=self.config.engine_prefill_chunk,
         )
         self.batcher.start()
         self.batcher.warmup()

@@ -185,3 +185,70 @@ def test_rebuild_requeues_later_groups():
     finally:
         bmod.admit_group = real_admit
         batcher.stop()
+
+
+# --------------------------------------------------------------------- #
+# Scaling harness (VERDICT r4 #8): parity proves correctness; this
+# records parallel *efficiency* so a TP/DP serving regression (a stray
+# all-gather, a resharding copy in the decode hot path) shows up in CI
+# as a rate collapse, not just in a hand-run profile. Absolute CPU-mesh
+# numbers are meaningless; the sanity bound is deliberately loose.
+# --------------------------------------------------------------------- #
+
+MESH_LADDER = (
+    {"data": 1},
+    {"model": 2},
+    {"model": 4, "data": 2},
+)
+
+
+async def _measure_mesh_rate(mesh_shape, steps=12, concurrency=4):
+    import time
+
+    cfg = LLMConfig(
+        model_name="llama-tiny",
+        provider="cpu",
+        mesh_shape=mesh_shape,
+        engine_slots=concurrency,
+        engine_max_seq=128,
+        engine_chunk=4,
+        dtype="float32",
+    )
+    handler = LLMHandler(cfg)
+    await handler.start()
+    try:
+        params = GenerationParams(max_new_tokens=16, temperature=0.0)
+
+        async def one(i):
+            await handler.generate_response(
+                [ChatMessage(role="user", content=f"scale probe {i}")],
+                params=params,
+            )
+
+        await asyncio.gather(*[one(i) for i in range(concurrency)])  # warm
+        t0 = time.perf_counter()
+        done = 0
+        while done < steps:
+            n = min(concurrency, steps - done)
+            await asyncio.gather(*[one(100 + done + i) for i in range(n)])
+            done += n
+        return steps / (time.perf_counter() - t0)
+    finally:
+        await handler.stop()
+
+
+@pytest.mark.asyncio
+async def test_mesh_scaling_ladder_stays_serviceable():
+    """Every rung of the serving-mesh ladder sustains throughput. The
+    regression bound: no sharded config may collapse below 10% of the
+    single-device rate (a resharding bug costs far more than mesh
+    overhead on a virtual CPU mesh, where communication is memcpy)."""
+    rates = {}
+    for shape in MESH_LADDER:
+        key = ",".join(f"{k}={v}" for k, v in shape.items())
+        rates[key] = await _measure_mesh_rate(shape)
+    print("\nmesh scaling (virtual 8-CPU, llama-tiny):", rates)
+    base = rates["data=1"]
+    assert all(r > 0 for r in rates.values())
+    for key, rate in rates.items():
+        assert rate > 0.1 * base, (key, rates)
