@@ -33,12 +33,14 @@ def parse_trace_dir(trace_dir: str) -> Dict[str, Any]:
     """Parse the newest ``*.trace.json.gz`` under ``trace_dir``.
 
     Returns ``{device_busy_s, wall_s, busy_frac, lane, n_events}`` where
-    ``device_busy_s`` is the largest per-thread duration sum over the
-    device process's lanes (profiler lanes nest — XLA Modules ⊃ XLA Ops —
-    so the largest single lane is the coarsest: time the device spent
-    executing dispatched programs, without double counting). Falls back
-    to host execution lanes when no ``/device:`` process exists (CPU
-    backend), and to zeros when no trace was written.
+    ``device_busy_s`` is the largest per-thread interval UNION over the
+    device process's lanes. Union, not sum: profiler lanes carry nested
+    events ("XLA Ops" rows overlap hierarchically — a raw sum
+    over-counted a measured 1B wave by ~1.8×), and merging intervals
+    yields the time the device actually spent executing regardless of
+    nesting. Falls back to host execution lanes when no ``/device:``
+    process exists (CPU backend), and to zeros when no trace was
+    written.
     """
     files = sorted(
         glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
@@ -78,8 +80,7 @@ def parse_trace_dir(trace_dir: str) -> Dict[str, Any]:
         def lane_ok(pid: int, tid) -> bool:
             return True
 
-    sums: Dict[tuple, float] = {}
-    counts: Dict[tuple, int] = {}
+    intervals: Dict[tuple, list] = {}
     t_min, t_max = float("inf"), float("-inf")
     for e in events:
         if e.get("ph") != "X" or e.get("pid") not in device_pids:
@@ -89,14 +90,27 @@ def parse_trace_dir(trace_dir: str) -> Dict[str, Any]:
         key = (e["pid"], e.get("tid"))
         dur = float(e.get("dur", 0.0))
         ts = float(e.get("ts", 0.0))
-        sums[key] = sums.get(key, 0.0) + dur
-        counts[key] = counts.get(key, 0) + 1
+        intervals.setdefault(key, []).append((ts, ts + dur))
         t_min = min(t_min, ts)
         t_max = max(t_max, ts + dur)
-    if not sums:
+    if not intervals:
         return empty
-    lane_key = max(sums, key=lambda k: sums[k])
-    busy_s = sums[lane_key] / 1e6
+
+    def union_us(spans: list) -> float:
+        spans.sort()
+        total = 0.0
+        cur_start, cur_end = spans[0]
+        for s, t in spans[1:]:
+            if s > cur_end:
+                total += cur_end - cur_start
+                cur_start, cur_end = s, t
+            else:
+                cur_end = max(cur_end, t)
+        return total + (cur_end - cur_start)
+
+    unions = {k: union_us(v) for k, v in intervals.items()}
+    lane_key = max(unions, key=lambda k: unions[k])
+    busy_s = unions[lane_key] / 1e6
     wall_s = max(t_max - t_min, 0.0) / 1e6
     return {
         "device_busy_s": busy_s,
@@ -104,7 +118,7 @@ def parse_trace_dir(trace_dir: str) -> Dict[str, Any]:
         "busy_frac": busy_s / wall_s if wall_s > 0 else 0.0,
         "lane": thread_names.get(lane_key)
         or proc_names.get(lane_key[0], "?"),
-        "n_events": counts[lane_key],
+        "n_events": len(intervals[lane_key]),
     }
 
 
