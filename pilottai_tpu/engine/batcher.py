@@ -403,11 +403,24 @@ class ContinuousBatcher:
         decode chunk up front, so steady-state serving never waits on the
         compiler. Groups are padded to ``admit_batch``, so one request per
         bucket compiles the same batched write/sample/admit shapes a full
-        production wave hits."""
+        production wave hits.
+
+        With chunked prefill active, buckets past the segmentation
+        threshold never run as monolithic group prefills at serve time —
+        and must not compile as such here either: an admit_batch×8192
+        prefill executable alone exceeds a v5e's HBM next to 8B int8
+        weights (measured: 17.97G of 15.75G). Instead the sweep stops at
+        the threshold and one long prompt warms the segment ladder
+        (extend_prompt_paged variants + the final tail admission)."""
         if prompt_lens is None:
+            cap = self.max_seq_len
+            if self.prefill_chunk:
+                cap = min(cap, 2 * self.prefill_chunk)
             prompt_lens = tuple(sorted(
-                {self._bucket(n) for n in range(1, self.max_seq_len + 1)}
+                {self._bucket(n) for n in range(1, cap + 1)}
             ))
+            if self.prefill_chunk and self.max_seq_len > cap:
+                prompt_lens = prompt_lens + (self.max_seq_len - 8,)
         self._warming = True
         try:
             for plen in prompt_lens:
@@ -572,7 +585,7 @@ class ContinuousBatcher:
                     # (own slot, one segment per cycle), never a
                     # monolithic group prefill.
                     long_req = False
-                    if self.prefill_chunk and not self._warming:
+                    if self.prefill_chunk:
                         chain = (
                             len(key.path_pages)
                             if self.page_index is not None

@@ -471,17 +471,28 @@ def _ngram_drafts(
     n_drafts: int,
 ) -> jax.Array:
     """Propose ``n_drafts`` continuation tokens per slot by matching the
-    latest (prev, cur) 2-gram earlier in the slot's own history and
-    copying what followed it. No match → zeros (harmless: acceptance
-    compares against the model's output, so junk drafts just miss)."""
+    latest (prev2, prev, cur) 3-gram earlier in the slot's own history —
+    backing off to the latest 2-gram — and copying what followed it. The
+    3-gram tier disambiguates repeated contexts (a byte pair like ``",
+    "`` recurs with many continuations inside JSON; three bytes usually
+    pin the right one), which is where the 2-gram's acceptance plateaued.
+    No match → zeros (harmless: acceptance compares against the model's
+    output, so junk drafts just miss — draft quality affects speed,
+    never content)."""
     B, S = history.shape
     idx = jnp.arange(S)[None, :]
     bidx = jnp.arange(B)[:, None]
     prev = jnp.take_along_axis(
         history, jnp.maximum(pos - 1, 0)[:, None], axis=1
     )                                                     # [B, 1]
+    prev2 = jnp.take_along_axis(
+        history, jnp.maximum(pos - 2, 0)[:, None], axis=1
+    )
     prev_col = jnp.concatenate(
         [jnp.full((B, 1), -1, history.dtype), history[:, :-1]], axis=1
+    )
+    prev2_col = jnp.concatenate(
+        [jnp.full((B, 2), -1, history.dtype), history[:, :-2]], axis=1
     )
     match = (history == cur[:, None]) & (prev_col == prev)
     # Only occurrences whose whole n-draft continuation is already
@@ -489,8 +500,12 @@ def _ngram_drafts(
     # zeros from unwritten positions and never accepts — measured on
     # v5e as acceptance ~0 even on a constant output stream.
     match &= (idx <= pos[:, None] - n_drafts) & (idx >= 1)
+    match3 = match & (prev2_col == prev2) & (idx >= 2) & (pos[:, None] >= 2)
     found = match.any(axis=1)
-    j = jnp.argmax(jnp.where(match, idx, -1), axis=1)     # latest match
+    found3 = match3.any(axis=1)
+    j2 = jnp.argmax(jnp.where(match, idx, -1), axis=1)    # latest match
+    j3 = jnp.argmax(jnp.where(match3, idx, -1), axis=1)
+    j = jnp.where(found3, j3, j2)
     dpos = j[:, None] + 1 + jnp.arange(n_drafts)[None, :]
     drafts = history[bidx, jnp.minimum(dpos, S - 1)]
     return jnp.where(found[:, None], drafts, 0)
@@ -1240,6 +1255,59 @@ def _tail_prefill_core(
     return logits, ks, vs
 
 
+def _tail_prefill_lazy(
+    params,
+    cfg: ModelConfig,
+    gather_layer,            # l -> (pk [K, Pb, H], pv) in compute dtype
+    prefix_len: jax.Array,
+    tail_tokens: jax.Array,  # [A, Tt]
+    tail_lens: jax.Array,    # [A]
+    cache_dtype,
+):
+    """``_tail_prefill_core`` with PER-LAYER prefix gathering (python
+    loop, no scan): stacking all L layers' dequantized chain panels
+    up front costs ``2·L·K·Pb·H·2`` bytes — 17+ GB for an 8B model at an
+    8K prefix, a measured OOM next to the weights. Here each layer
+    gathers its own panels transiently (~0.5 GB at 8K) and XLA reuses
+    the buffer across layers. Used by the paged admission paths whenever
+    the stacked gather would exceed the gather budget."""
+    A, Tt = tail_tokens.shape
+    positions = prefix_len + jnp.broadcast_to(
+        jnp.arange(Tt, dtype=jnp.int32)[None], (A, Tt)
+    )
+    x = _embed(cfg, params, tail_tokens)
+    sin, cos = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+    windows = cfg.window_sizes()
+    qscale = cfg.query_scale if cfg.query_scale is not None else cfg.head_dim**-0.5
+    G = cfg.n_heads // cfg.n_kv_heads
+
+    ks_l, vs_l = [], []
+    for l in range(cfg.n_layers):
+        lp = jax.tree.map(lambda a: a[l], params["layers"])
+        window = int(windows[l])
+        pk, pv = gather_layer(l)
+        h = rms_norm(x, lp["ln1"]["scale"], cfg.rms_eps, cfg.rms_offset)
+        q, k, v = _qkv(cfg, lp["attn"], h, sin, cos)
+        qg = q.transpose(0, 2, 1, 3).reshape(
+            A, cfg.n_kv_heads, G, Tt, cfg.head_dim
+        )
+        blk_k = k.transpose(0, 2, 1, 3).astype(cache_dtype)
+        blk_v = v.transpose(0, 2, 1, 3).astype(cache_dtype)
+        attn = _tail_prefix_attn(
+            qg, pk, pv, blk_k, blk_v, prefix_len, tail_lens,
+            qscale, cfg.attn_softcap, window,
+        )
+        x = _layer_tail(
+            cfg, lp, x,
+            attn.astype(x.dtype).reshape(A, Tt, cfg.n_heads, cfg.head_dim),
+        )
+        ks_l.append(blk_k)
+        vs_l.append(blk_v)
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps, cfg.rms_offset)
+    logits = _unembed(cfg, params, x)
+    return logits, jnp.stack(ks_l), jnp.stack(vs_l)
+
+
 @partial(
     jax.jit,
     static_argnames=("cfg",),
@@ -1409,26 +1477,17 @@ def admit_group_prefix_paged(
     K = cache.n_kv_heads
     H = cache.head_dim
     Pb = n_prefix_bucket * P
-    # Gather the shared chain into stacked [L, K, Pb, H] panels
-    # (sentinel-padded pages gather scratch garbage — masked by
-    # ``col < prefix_len`` in the tail attention). int8 pools dequantize
-    # on the way out; the pages themselves stay quantized and untouched.
-    def _chain_gather(a):
-        # Works for [K, pages, P, H] pools and [K, pages, P] scale pools.
-        return a[:, prefix_pages].reshape((K, Pb) + a.shape[3:])
-
-    panels = []
-    for l in range(cfg.n_layers):
-        k_, v_, sc = _bounded_panels(cache, l, _chain_gather)
-        panels.append(_dequant_pair(k_, v_, sc, cfg.dtype))
-    pks = jnp.stack([p[0] for p in panels])
-    pvs = jnp.stack([p[1] for p in panels])
+    # The shared chain is read as prefix panels (sentinel-padded pages
+    # gather scratch garbage — masked by ``col < prefix_len`` in the
+    # tail attention). int8 pools dequantize on the way out; the pages
+    # themselves stay quantized and untouched. Large chains gather per
+    # layer instead of stacking (see _chain_tail_prefill).
     cache_dtype = (
         cfg.dtype if cache.scales is not None else cache.layers[0][0].dtype
     )
-    logits, ks, vs = _tail_prefill_core(
-        params, cfg, pks, pvs, prefix_len, tail_tokens, tail_lens,
-        cache_dtype,
+    logits, ks, vs = _chain_tail_prefill(
+        params, cfg, cache, prefix_pages, prefix_len, tail_tokens,
+        tail_lens, cache_dtype,
     )
 
     # Tail install: position t of the tail lives at absolute position
@@ -1482,6 +1541,33 @@ def extend_prompt_paged(
     ``admit_group_prefix_paged``. The batcher dispatches one segment per
     device-loop cycle, so live slots' decode chunks interleave instead
     of stalling behind a monolithic multi-thousand-token prefill."""
+    cache_dtype = (
+        cfg.dtype if cache.scales is not None else cache.layers[0][0].dtype
+    )
+    _logits, ks, vs = _chain_tail_prefill(
+        params, cfg, cache, prefix_pages, prefix_len, seg_tokens, seg_lens,
+        cache_dtype,
+    )
+    ks_w = ks.transpose(0, 1, 3, 2, 4)  # [L, 1, Ts, K, H]
+    vs_w = vs.transpose(0, 1, 3, 2, 4)
+    return write_prompts_paged(
+        cache, page_rows, ks_w, vs_w, seg_lens, pos_offset=prefix_len
+    )
+
+
+def _chain_tail_prefill(
+    params, cfg, cache, prefix_pages, prefix_len, tail_tokens, tail_lens,
+    cache_dtype,
+):
+    """Tail prefill against a page chain, choosing the gather strategy by
+    HBM cost: small chains stack all layers' dequantized panels up front
+    (one scanned forward — the fast, proven path); chains whose stacked
+    panels would exceed the gather budget (PILOTTAI_GATHER_BUDGET, the
+    same knob the decode chunk uses) gather per layer instead
+    (``_tail_prefill_lazy``) — an 8K chain on an 8B model is 17+ GB
+    stacked, a measured OOM."""
+    import os as _os
+
     P = cache.page_size
     K = cache.n_kv_heads
     Pb = prefix_pages.shape[0] * P
@@ -1489,23 +1575,26 @@ def extend_prompt_paged(
     def _chain_gather(a):
         return a[:, prefix_pages].reshape((K, Pb) + a.shape[3:])
 
-    panels = []
-    for l in range(cfg.n_layers):
+    def gather_layer(l):
         k_, v_, sc = _bounded_panels(cache, l, _chain_gather)
-        panels.append(_dequant_pair(k_, v_, sc, cfg.dtype))
+        return _dequant_pair(k_, v_, sc, cfg.dtype)
+
+    budget = int(_os.environ.get("PILOTTAI_GATHER_BUDGET", 5 * 1024**3))
+    stacked_bytes = (
+        2 * cfg.n_layers * K * Pb * cache.head_dim
+        * jnp.dtype(cfg.dtype).itemsize
+    )
+    if stacked_bytes > budget:
+        return _tail_prefill_lazy(
+            params, cfg, gather_layer, prefix_len, tail_tokens, tail_lens,
+            cache_dtype,
+        )
+    panels = [gather_layer(l) for l in range(cfg.n_layers)]
     pks = jnp.stack([p[0] for p in panels])
     pvs = jnp.stack([p[1] for p in panels])
-    cache_dtype = (
-        cfg.dtype if cache.scales is not None else cache.layers[0][0].dtype
-    )
-    _logits, ks, vs = _tail_prefill_core(
-        params, cfg, pks, pvs, prefix_len, seg_tokens, seg_lens,
+    return _tail_prefill_core(
+        params, cfg, pks, pvs, prefix_len, tail_tokens, tail_lens,
         cache_dtype,
-    )
-    ks_w = ks.transpose(0, 1, 3, 2, 4)  # [L, 1, Ts, K, H]
-    vs_w = vs.transpose(0, 1, 3, 2, 4)
-    return write_prompts_paged(
-        cache, page_rows, ks_w, vs_w, seg_lens, pos_offset=prefix_len
     )
 
 
