@@ -424,8 +424,12 @@ async def run_bench():
                 model_name="llama3-8b-byte", engine_slots=8,
                 engine_chunk=16, engine_speculate=6,
                 **{**common, "engine_max_seq": 8192},
-                engine_paged_kv=True, engine_page_size=64,
-                engine_kv_pages=1025,
+                # Page 128 at 8K (round-5 A/B, device-only ms/step:
+                # 64→268, 128→243, 256→309): decode here is the paged
+                # kernel's per-grid-cell latency, so fewer/bigger pages
+                # win until tail-prefill cost overtakes at 256.
+                engine_paged_kv=True, engine_page_size=128,
+                engine_kv_pages=513,
                 engine_kv_quantize="int8",
             ),
             concurrency=8, steps=16, epochs=2, n_chips=n_chips,

@@ -738,14 +738,17 @@ class ContinuousBatcher:
                 self._wake.set()  # next cycle advances without the idle wait
                 return
             # Final segment: the tokens already written are this slot's
-            # own page chain — admit exactly like a block-prefix hit.
+            # own page chain — admit exactly like a block-prefix hit, at
+            # n_rows=1 (admit_batch padding rows against an 8K chain
+            # made the prefix-score tensor 8x bigger for nothing — a
+            # measured compile OOM).
             self._segmenting = None
             k = done // self.page_size
             entry = SimpleNamespace(
                 depth=k,
                 path_pages=tuple(int(p) for p in self.alloc.table[idx, :k]),
             )
-            self._prefill_group([(idx, req)], entry)
+            self._prefill_group([(idx, req)], entry, n_rows=1)
         except Exception as exc:  # noqa: BLE001 — fail this request only
             self._log.error("chunked prefill failed: %s", exc, exc_info=True)
             self._segmenting = None
@@ -763,8 +766,9 @@ class ContinuousBatcher:
         self,
         group: List[Tuple[int, GenRequest]],
         entry: Optional[Any] = None,
+        n_rows: Optional[int] = None,
     ) -> None:
-        A = self.admit_batch
+        A = n_rows if n_rows is not None else self.admit_batch
         slots = np.full((A,), self.n_slots, np.int32)  # OOB = padding row
         temps = np.zeros((A,), np.float32)
         topks = np.zeros((A,), np.int32)

@@ -1147,23 +1147,61 @@ def _tail_prefix_attn(
         return jnp.tanh(s / softcap) * softcap if softcap > 0.0 else s
 
     qpos = prefix_len + jnp.arange(T)                       # tail positions
-    s = softcapped(jnp.einsum(
-        "akgth,kph->akgtp", qg, pk, preferred_element_type=jnp.float32,
-    ) * scale)
-    col = jnp.arange(pk.shape[1])[None, None, None, None, :]
-    mask = col < prefix_len
-    if window > 0:
-        mask = mask & (
-            (qpos[None, None, None, :, None] - col) < window
+
+    def prefix_stats(pkw, pvw, col):
+        """Flash partials of the tail queries against one span of prefix
+        keys (``col`` are the span's absolute columns)."""
+        s = softcapped(jnp.einsum(
+            "akgth,kph->akgtp", qg, pkw, preferred_element_type=jnp.float32,
+        ) * scale)
+        mask = col[None, None, None, None, :] < prefix_len
+        if window > 0:
+            mask = mask & (
+                (qpos[None, None, None, :, None] - col[None, None, None, None, :])
+                < window
+            )
+        s = jnp.where(mask, s, NEG_INF)
+        m_w = jnp.max(s, axis=-1)
+        p = jnp.where(
+            m_w[..., None] > NEG_INF / 2, jnp.exp(s - m_w[..., None]), 0.0
         )
-    s = jnp.where(mask, s, NEG_INF)
-    m_p = jnp.max(s, axis=-1)
-    p = jnp.where(m_p[..., None] > NEG_INF / 2, jnp.exp(s - m_p[..., None]), 0.0)
-    l_p = jnp.sum(p, axis=-1)
-    acc_p = jnp.einsum(
-        "akgtp,kph->akgth", p.astype(pv.dtype), pv,
-        preferred_element_type=jnp.float32,
-    )
+        l_w = jnp.sum(p, axis=-1)
+        acc_w = jnp.einsum(
+            "akgtp,kph->akgth", p.astype(pvw.dtype), pvw,
+            preferred_element_type=jnp.float32,
+        )
+        return acc_w, m_w, l_w
+
+    P = pk.shape[1]
+    # The scores tensor is A·K·G·T·P·4 bytes; one shot at a long prefix
+    # (8K chain × 1K tail × 8 rows = 8 GB) OOMs — window the prefix with
+    # online-softmax merging (flash over the chain, coarse-grained)
+    # whenever the full scores would be big.
+    W = 2048
+    one_shot_bytes = 4 * A * K * G * T * P
+    if P > W and P % W == 0 and one_shot_bytes > (1 << 30):
+        nw = P // W
+        pk_w = pk.reshape(K, nw, W, H).transpose(1, 0, 2, 3)
+        pv_w = pv.reshape(K, nw, W, H).transpose(1, 0, 2, 3)
+        cols = (
+            jnp.arange(nw)[:, None] * W + jnp.arange(W)[None, :]
+        ).astype(jnp.int32)
+
+        def wstep(carry, xs):
+            acc, m, l = carry
+            acc_w, m_w, l_w = prefix_stats(*xs)
+            return _merge_stats(acc, m, l, acc_w, m_w, l_w), None
+
+        init = (
+            jnp.zeros((A, K, G, T, H), jnp.float32),
+            jnp.full((A, K, G, T), NEG_INF, jnp.float32),
+            jnp.zeros((A, K, G, T), jnp.float32),
+        )
+        (acc_p, m_p, l_p), _ = jax.lax.scan(
+            wstep, init, (pk_w, pv_w, cols)
+        )
+    else:
+        acc_p, m_p, l_p = prefix_stats(pk, pv, jnp.arange(P))
 
     s = softcapped(jnp.einsum(
         "akgth,akeh->akgte", qg, blk_k, preferred_element_type=jnp.float32,
