@@ -132,8 +132,15 @@ async def bench_model(cfg, concurrency, steps, epochs, n_chips=1,
 
         try:
             win = DeviceWindow().start()
-            await asyncio.gather(*[one_step() for _ in range(concurrency)])
-            prof = win.stop()
+            try:
+                await asyncio.gather(
+                    *[one_step() for _ in range(concurrency)]
+                )
+            finally:
+                # The profiler trace is process-global: leaving it
+                # running after a failed wave breaks every later
+                # section's profiling.
+                prof = win.stop()
             if prof["device_busy_s"] > 0:
                 device = {
                     "device_ms_per_step": round(
@@ -221,7 +228,7 @@ async def bench_pipeline(provider: str, rounds: int = 4):
     finally:
         await serve.stop()
     gc.collect()
-    from pilottai_tpu.train.protocol import DEFAULT_CHECKPOINT
+    from pilottai_tpu.train.protocol import has_checkpoint
 
     return {
         "pipeline_p50_ms": round(statistics.median(task_lat) * 1000.0, 1),
@@ -230,9 +237,7 @@ async def bench_pipeline(provider: str, rounds: int = 4):
         "rounds": rounds,
         "stages_per_round": len(tasks),
         "pipeline_model": "protocol-s" if provider != "mock" else "mock",
-        "pipeline_trained_checkpoint": (
-            DEFAULT_CHECKPOINT.exists() and any(DEFAULT_CHECKPOINT.iterdir())
-        ),
+        "pipeline_trained_checkpoint": has_checkpoint(),
     }
 
 
@@ -253,9 +258,10 @@ async def bench_swarm(model: str, provider: str, n_agents: int = 32,
         DEFAULT_CHECKPOINT,
         SERVE_MAX_NEW,
         SERVE_MAX_SEQ,
+        has_checkpoint,
     )
 
-    has_ckpt = DEFAULT_CHECKPOINT.exists() and any(DEFAULT_CHECKPOINT.iterdir())
+    has_ckpt = has_checkpoint()
     llm = LLMHandler(LLMConfig(
         model_name=model, provider=provider,
         # The in-tree-trained protocol checkpoint: agents make their

@@ -79,14 +79,13 @@ def split_sections(text: str) -> List[Tuple[str, str]]:
 def make_tools(
     memory: EnhancedMemory,
     default_path: Optional[str] = None,
-    default_question: str = "key findings, risks",
 ) -> Dict[str, Tool]:
     """The worker toolset, closed over the shared semantic memory.
 
-    Tool arguments default to the pipeline's own document/question: a
-    model that invokes a stage tool with bare ``{}`` arguments (the
-    protocol model's trained shape) still acts on the right document —
-    the binding lives in the pipeline wiring, not in fragile prompt
+    ``default_path`` binds the pipeline's own document: a model that
+    invokes a stage tool with bare ``{}`` arguments (the protocol
+    model's trained shape) still acts on the right document — the
+    binding lives in the pipeline wiring, not in fragile prompt
     echoing."""
 
     async def extract_sections(path: Optional[str] = None) -> Dict[str, Any]:
@@ -202,10 +201,11 @@ def _handler(provider: str) -> LLMHandler:
         DEFAULT_CHECKPOINT,
         SERVE_MAX_NEW,
         SERVE_MAX_SEQ,
+        has_checkpoint,
     )
 
     ckpt = DEFAULT_CHECKPOINT
-    has_ckpt = ckpt.exists() and any(ckpt.iterdir())
+    has_ckpt = has_checkpoint(ckpt)
     return LLMHandler(
         LLMConfig(
             model_name="protocol-s",
@@ -224,16 +224,22 @@ def _handler(provider: str) -> LLMHandler:
 
 
 def build_pipeline(
-    provider: str = "mock", use_embedder: bool = False
+    provider: str = "mock",
+    use_embedder: bool = False,
+    doc_path: Optional[str | Path] = None,
 ) -> Tuple[Serve, EnhancedMemory]:
-    """Manager + extractor/evaluator/generator hierarchy over one Serve."""
+    """Manager + extractor/evaluator/generator hierarchy over one Serve.
+
+    ``doc_path`` binds the stage tools' default document — a run over a
+    user document must never silently fall back to the bundled sample
+    when the model invokes a tool with bare arguments."""
     embedder = None
     if use_embedder:
         from pilottai_tpu.memory.embedder import Embedder
 
         embedder = Embedder(model_name="llama-tiny")
     memory = EnhancedMemory(embedder=embedder)
-    tools = make_tools(memory)
+    tools = make_tools(memory, default_path=str(doc_path or SAMPLE_DOC))
     llm = _handler(provider)
 
     extractor = BaseAgent(
@@ -308,7 +314,9 @@ async def run_pipeline(
     use_embedder: bool = False,
 ) -> Dict[str, Any]:
     """End-to-end run; returns the stage results and final answer."""
-    serve, memory = build_pipeline(provider=provider, use_embedder=use_embedder)
+    serve, memory = build_pipeline(
+        provider=provider, use_embedder=use_embedder, doc_path=path
+    )
     await serve.start()
     try:
         tasks = stage_tasks(str(path), question)

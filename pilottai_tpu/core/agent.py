@@ -129,6 +129,7 @@ class BaseAgent:
         self.tools = (
             tools if isinstance(tools, ToolRegistry) else ToolRegistry(tools or [])
         )
+        self._own_registry = not isinstance(tools, ToolRegistry)
         self.memory = memory
         self.knowledge = knowledge
         # Framework-level grounding (VERDICT r4 #5): attached stores are
@@ -167,7 +168,25 @@ class BaseAgent:
         """Auto-register ``memory_search``/``knowledge_query`` tools for
         attached stores (same shape the document-pipeline example used to
         hand-build). A user tool with the same name wins — this never
-        overwrites."""
+        overwrites. A caller-SUPPLIED registry is never mutated: two
+        agents sharing one registry must not end up with a tool closure
+        bound to whichever agent constructed first — the registry is
+        copied per-agent before any grounding tool is added (the Tool
+        objects themselves stay shared)."""
+        wants_memory = (
+            self.memory is not None
+            and self.config.memory_enabled
+            and hasattr(self.memory, "semantic_search")
+            and "memory_search" not in self.tools
+        )
+        wants_knowledge = (
+            self.knowledge is not None
+            and hasattr(self.knowledge, "query_knowledge")
+            and "knowledge_query" not in self.tools
+        )
+        if (wants_memory or wants_knowledge) and not self._own_registry:
+            self.tools = ToolRegistry(self.tools.subset(self.tools.names()))
+            self._own_registry = True
         if (
             self.memory is not None
             and self.config.memory_enabled

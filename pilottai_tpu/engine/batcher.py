@@ -747,6 +747,7 @@ class ContinuousBatcher:
             entry = SimpleNamespace(
                 depth=k,
                 path_pages=tuple(int(p) for p in self.alloc.table[idx, :k]),
+                segmented=True,  # own chain, not a cache hit (metrics)
             )
             self._prefill_group([(idx, req)], entry, n_rows=1)
         except Exception as exc:  # noqa: BLE001 — fail this request only
@@ -852,7 +853,11 @@ class ContinuousBatcher:
                     json_tables=group_json, history=self.history,
                     schema_ids=group_sids, schema_tables=group_schema,
                 )
-            global_metrics.inc("engine.prefix_hits", len(group))
+            if not getattr(entry, "segmented", False):
+                # A chunked-prefill final reads its OWN chain — counting
+                # it as a cache hit would report near-100% hit rates on
+                # deployments with the prefix cache disabled.
+                global_metrics.inc("engine.prefix_hits", len(group))
             # Blocks past the shared chain that the prompt fully covers
             # are immutable too — register them as chain extensions.
             self._maybe_register(group)

@@ -692,19 +692,22 @@ class Serve:
             )
             try:
                 result = await agent.execute_task(task)
+                result = await self._maybe_retry(task, result)
                 if (
                     self.delegator is not None
                     and task.metadata.get("delegation") is not None
                 ):
                     # Outcome feedback closes the loop: future scoring
-                    # prefers children that actually deliver
-                    # (delegation/delegator.py:record_delegation).
+                    # prefers children that actually deliver. Recorded
+                    # AFTER retries settle — a child that recovers via
+                    # the framework's own retry path must not be scored
+                    # as a failure (the retry may land on another agent;
+                    # task.agent_id tracks the final executor).
                     await self.delegator.record_delegation(
-                        agent.id, task, result.success,
+                        task.agent_id or agent.id, task, result.success,
                         execution_time=result.execution_time,
                         error=result.error,
                     )
-                result = await self._maybe_retry(task, result)
             finally:
                 self.running_tasks.pop(task.id, None)
             self._finalize(task, result)

@@ -495,6 +495,14 @@ def train_protocol(
     return result
 
 
+def has_checkpoint(path: Optional[str | Path] = None) -> bool:
+    """True when a protocol checkpoint is present at ``path`` (default:
+    the committed one). ONE definition — bench, example pipeline and
+    tests all gate on this."""
+    path = Path(path) if path is not None else DEFAULT_CHECKPOINT
+    return path.exists() and any(path.iterdir())
+
+
 def ensure_protocol_checkpoint(
     path: Optional[str | Path] = None,
     steps: int = 3000,
@@ -503,7 +511,7 @@ def ensure_protocol_checkpoint(
     """The committed checkpoint if present, else train one in place.
     Returns None when training is impossible (no orbax)."""
     path = Path(path) if path is not None else DEFAULT_CHECKPOINT
-    if path.exists() and any(path.iterdir()):
+    if has_checkpoint(path):
         return path
     try:
         import orbax.checkpoint  # noqa: F401 — save_params needs it

@@ -127,6 +127,7 @@ class DeviceWindow:
     bench can't wrap an ``await`` in a context manager argument)."""
 
     def __init__(self, trace_dir: Optional[str] = None) -> None:
+        self._own_dir = trace_dir is None
         self.trace_dir = trace_dir or tempfile.mkdtemp(prefix="pilottai-prof-")
         self._t0 = 0.0
         self.wall_s = 0.0
@@ -144,6 +145,13 @@ class DeviceWindow:
         self.wall_s = time.perf_counter() - self._t0
         jax.profiler.stop_trace()
         out = parse_trace_dir(self.trace_dir)
+        if self._own_dir:
+            # Self-created temp dir: traces of multi-request waves run
+            # tens of MB; leaking one per profiled section fills tmpfs
+            # on long-lived hosts.
+            import shutil
+
+            shutil.rmtree(self.trace_dir, ignore_errors=True)
         out["window_wall_s"] = self.wall_s
         if self.wall_s > 0:
             # Busy fraction against the measured host window (the trace's

@@ -129,3 +129,22 @@ def test_read_document_text(tmp_path):
     doc = tmp_path / "notes.md"
     doc.write_text("## Heading\nBody text", encoding="utf-8")
     assert "Body text" in read_document(str(doc))
+
+
+@pytest.mark.asyncio
+async def test_bare_tool_args_act_on_the_requested_document(tmp_path):
+    """code-review r5: a model invoking extract_sections with bare {}
+    must act on the pipeline's own document, never silently fall back
+    to the bundled sample."""
+    from examples.document_pipeline.pipeline import build_pipeline
+
+    doc = tmp_path / "mine.md"
+    doc.write_text("## Only Section\nDistinctive body here", encoding="utf-8")
+    serve, memory = build_pipeline(provider="mock", doc_path=doc)
+    extractor = next(
+        a for a in serve.agents.values() if a.config.role == "extractor"
+    )
+    out = await extractor.tools.get("extract_sections").execute({})
+    assert out["headings"] == ["Only Section"]
+    items = await memory.keyword_search("Distinctive", tags={"extract"})
+    assert items

@@ -333,3 +333,32 @@ async def test_user_tool_name_wins_over_auto_registration():
         memory=memory,
     )
     assert agent.tools.get("memory_search") is custom
+
+
+@pytest.mark.asyncio
+async def test_shared_registry_not_mutated_by_grounding():
+    """Two agents sharing one ToolRegistry must each get a memory_search
+    bound to THEIR memory, and the caller's registry must stay
+    untouched (code-review r5)."""
+    from pilottai_tpu.engine.mock import MockBackend
+    from pilottai_tpu.tools.tool import Tool, ToolRegistry
+
+    shared = ToolRegistry([Tool(name="noop", function=lambda: "x")])
+    mem_a, mem_b = EnhancedMemory(), EnhancedMemory()
+    await mem_a.store_semantic("fact alpha", tags={"t"})
+    await mem_b.store_semantic("fact beta", tags={"t"})
+
+    def mk(mem):
+        return BaseAgent(
+            config=AgentConfig(role="x"),
+            llm=LLMHandler(LLMConfig(provider="mock"),
+                           backend=MockBackend()),
+            tools=shared, memory=mem,
+        )
+
+    a, b = mk(mem_a), mk(mem_b)
+    assert "memory_search" not in shared  # caller registry untouched
+    out_a = await a.tools.get("memory_search").execute({"query": "fact"})
+    out_b = await b.tools.get("memory_search").execute({"query": "fact"})
+    assert out_a == ["fact alpha"]
+    assert out_b == ["fact beta"]

@@ -143,7 +143,9 @@ def test_train_steps_reduce_protocol_loss():
 
 
 def _ckpt_present() -> bool:
-    return DEFAULT_CHECKPOINT.exists() and any(DEFAULT_CHECKPOINT.iterdir())
+    from pilottai_tpu.train.protocol import has_checkpoint
+
+    return has_checkpoint()
 
 
 @pytest.mark.skipif(not _ckpt_present(), reason="no committed checkpoint")
