@@ -110,6 +110,16 @@ _TOOLS: List[Tuple[str, str]] = [
 _TYPES = [
     "generic", "extract", "evaluate", "summarize", "analyze", "research",
 ]
+# Task-type → agent-role affinity the selection curriculum teaches (the
+# document pipeline's stage mapping plus the obvious ones).
+_TYPE_ROLE = {
+    "extract": "extractor",
+    "evaluate": "evaluator",
+    "summarize": "generator",
+    "analyze": "analyst",
+    "research": "researcher",
+    "generic": "worker",
+}
 _TOOL_RESULTS = [
     "{'sections': 4, 'characters': 5120, 'headings': ['Overview', 'Risks']}",
     "{'valid': True, 'sections': 4, 'issues': []}",
@@ -199,12 +209,14 @@ def make_example(r: _Rand, pms: Dict[str, PromptManager]) -> Tuple[str, str]:
     drawn from the protocol curriculum."""
     agent_pm, orch_pm = pms["agent"], pms["orchestrator"]
     kind = r.choice(
-        # Weighted by how decisive the call is for task success.
+        # Weighted by how decisive the call is for task success;
+        # tooled-fresh heaviest — invoking the offered tool (a name
+        # copy) is the hardest decision the loop depends on.
         ["analysis"] * 3 + ["tool_selection"] * 3
-        + ["step_tools_fresh"] * 4 + ["step_tools_done"] * 4
+        + ["step_tools_fresh"] * 7 + ["step_tools_done"] * 5
         + ["step_plain"] * 4 + ["evaluation"] * 4
         + ["orch_analysis"] * 2 + ["orch_decompose"]
-        + ["orch_select"] * 2 + ["orch_strategy"] + ["orch_eval"] * 2
+        + ["orch_select"] * 4 + ["orch_strategy"] + ["orch_eval"] * 2
     )
 
     if kind == "analysis":
@@ -330,19 +342,35 @@ def make_example(r: _Rand, pms: Dict[str, PromptManager]) -> Tuple[str, str]:
         return render_generic_request([ChatMessage(content=prompt)]), target
 
     if kind == "orch_select":
+        # Selection is ROLE-AWARE, not first-listed: the candidate whose
+        # role matches the task type wins (shuffled positions force the
+        # model to find the line, not copy position 0 — a first-id
+        # habit routed every pipeline stage to the same agent).
         task, _ = _task(r, with_tools=False)
-        ids = [r.uuid() for _ in range(int(r.rng.integers(2, 5)))]
+        n = int(r.rng.integers(2, 5))
+        ids = [r.uuid() for _ in range(n)]
+        match_role = _TYPE_ROLE.get(task.type)
+        roles = []
+        others = [x for x in _ROLES if x != match_role]
+        for _ in range(n):
+            roles.append(r.choice(others))
+        pick = int(r.rng.integers(n))
+        if match_role is not None and r.bool(0.85):
+            roles[pick] = match_role
+            chosen = ids[pick]
+        else:
+            chosen = ids[0]  # no matching role listed → first candidate
         agents = "\n".join(
-            f"{aid}: {r.choice(_ROLES)}, load={float(r.rng.random()):.2f}, "
+            f"{aid}: {role}, load={float(r.rng.random()):.2f}, "
             f"success={float(r.rng.random()):.2f}"
-            for aid in ids
+            for aid, role in zip(ids, roles)
         )
         prompt = orch_pm.format_prompt(
             "agent_selection", task=task.to_prompt(), agents=agents
         )
         target = _dumps({
-            "agent_id": ids[0],
-            "reasoning": "suitable and least loaded",
+            "agent_id": chosen,
+            "reasoning": "role matches the task",
         })
         return render_generic_request([ChatMessage(content=prompt)]), target
 
