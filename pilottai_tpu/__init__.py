@@ -29,6 +29,7 @@ from pilottai_tpu.core.config import (
     LLMConfig,
     LoadBalancerConfig,
     LogConfig,
+    ReliabilityConfig,
     RouterConfig,
     ScalingConfig,
     ServeConfig,
@@ -54,6 +55,12 @@ _LAZY = {
     "TaskDelegator": ("pilottai_tpu.delegation.delegator", "TaskDelegator"),
     "TaskJournal": ("pilottai_tpu.checkpoint.journal", "TaskJournal"),
     "TrainCheckpointer": ("pilottai_tpu.checkpoint.train_io", "TrainCheckpointer"),
+    "CircuitBreaker": ("pilottai_tpu.reliability", "CircuitBreaker"),
+    "CircuitOpenError": ("pilottai_tpu.reliability", "CircuitOpenError"),
+    "DeadlineExceeded": ("pilottai_tpu.reliability", "DeadlineExceeded"),
+    "EngineOverloaded": ("pilottai_tpu.reliability", "EngineOverloaded"),
+    "FaultInjector": ("pilottai_tpu.reliability", "FaultInjector"),
+    "global_injector": ("pilottai_tpu.reliability", "global_injector"),
 }
 
 
@@ -80,6 +87,7 @@ __all__ = [
     "AgentConfig",
     "LLMConfig",
     "LogConfig",
+    "ReliabilityConfig",
     "ServeConfig",
     "RouterConfig",
     "LoadBalancerConfig",

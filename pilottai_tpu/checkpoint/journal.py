@@ -25,7 +25,9 @@ from pathlib import Path
 from typing import Any, Dict, List, Optional, TextIO
 
 from pilottai_tpu.core.task import Task, TaskResult, TaskStatus
+from pilottai_tpu.reliability import global_injector
 from pilottai_tpu.utils.logging import get_logger
+from pilottai_tpu.utils.metrics import global_metrics
 
 
 class TaskJournal:
@@ -72,14 +74,17 @@ class TaskJournal:
 
     def record_task(self, task: Task) -> None:
         """Full task dump — written on enqueue and requeue so replay can
-        reconstruct the Task object exactly."""
-        self._write(
-            {"ev": "task", "ts": time.time(), "data": task.model_dump(mode="json")}
-        )
+        reconstruct the Task object exactly. Write failures (disk full,
+        revoked mount) degrade to at-least-once-with-a-hole: the task
+        still runs now, it just may rerun after a crash — a full journal
+        disk must not take live serving down with it."""
+        self._record({"ev": "task", "ts": time.time(),
+                      "data": task.model_dump(mode="json")})
 
     def record_status(self, task: Task) -> None:
-        """Slim status transition — written on start/terminal events."""
-        self._write(
+        """Slim status transition — written on start/terminal events.
+        Same degraded semantics on write failure as ``record_task``."""
+        self._record(
             {
                 "ev": "status",
                 "ts": time.time(),
@@ -92,6 +97,19 @@ class TaskJournal:
                 ),
             }
         )
+
+    def _record(self, record: Dict[str, Any]) -> None:
+        # Chaos point: a failing journal disk (arm with exc=OSError).
+        try:
+            global_injector.fire("checkpoint.write")
+            self._write(record)
+        except OSError as exc:
+            global_metrics.inc("journal.write_failures")
+            self._log.error(
+                "journal write failed (%s); task %s will replay "
+                "at-least-once after a crash",
+                exc, record.get("id") or record.get("data", {}).get("id"),
+            )
 
     def close(self) -> None:
         if self._fh is not None:

@@ -69,6 +69,36 @@ class SamplingConfig(BaseModel):
     json_mode: bool = False  # grammar-constrained JSON decoding
 
 
+class ReliabilityConfig(BaseModel):
+    """Overload, deadline and failure-handling knobs (reliability/ — no
+    reference analog: the reference has no admission control at all).
+
+    Semantics are documented in docs/SERVING.md "Overload & failure
+    semantics": queue-depth shedding → 429, breaker open → 503, deadline
+    exceeded → 408.
+    """
+
+    # Engine admission control: submits beyond this many queued-but-not-
+    # admitted requests are rejected (EngineOverloaded → HTTP 429).
+    # None = unbounded (the seed behavior).
+    max_queue_depth: Optional[int] = Field(default=None, ge=1)
+    # Per-request deadline defaults at the HTTP edge. Clients set
+    # ``timeout`` in the body or an ``x-request-timeout`` header;
+    # ``default_timeout`` applies when they don't (None = no deadline),
+    # and ``max_timeout`` caps whatever they ask for.
+    default_timeout: Optional[float] = Field(default=None, gt=0)
+    max_timeout: float = Field(default=600.0, gt=0)
+    # Retry backoff shaping (engine/handler.py): capped exponential with
+    # jitter — synchronized retry herds re-break a recovering backend.
+    retry_max_delay: float = Field(default=30.0, ge=0)
+    retry_jitter: bool = True
+    # Circuit breaker over engine calls (reliability/breaker.py).
+    breaker_enabled: bool = True
+    breaker_failure_threshold: int = Field(default=5, ge=1)
+    breaker_recovery_timeout: float = Field(default=30.0, gt=0)
+    breaker_half_open_max: int = Field(default=1, ge=1)
+
+
 class LLMConfig(BaseModel):
     """LLM engine configuration (reference: ``pilott/core/config.py:41-77``).
 
@@ -159,6 +189,10 @@ class LLMConfig(BaseModel):
     # compiled programs instead of paying minutes of recompilation.
     engine_compile_cache: Optional[str] = None
     seed: int = 0                                    # param init seed when no checkpoint
+    # Deadlines, shedding, breaker (reliability/): defaults keep the seed
+    # behavior except the breaker, which only changes anything once the
+    # backend fails 5 times in a row.
+    reliability: ReliabilityConfig = Field(default_factory=ReliabilityConfig)
 
 
 class LogConfig(BaseModel):

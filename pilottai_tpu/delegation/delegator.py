@@ -127,11 +127,15 @@ class TaskDelegator:
         ]
         if not pool:
             return None, "no accepting candidate"
+        async def _select() -> BaseAgent:
+            async with self._lock:
+                return max(pool, key=lambda c: self._score(c, task))
+
         try:
-            async with asyncio.timeout(self.selection_timeout):
-                async with self._lock:
-                    best = max(pool, key=lambda c: self._score(c, task))
-        except TimeoutError:
+            # wait_for, not asyncio.timeout: the latter is 3.11+ and this
+            # package supports 3.10 (requires-python >= 3.10).
+            best = await asyncio.wait_for(_select(), self.selection_timeout)
+        except (TimeoutError, asyncio.TimeoutError):
             return None, "selection timed out"
         return best, reason
 

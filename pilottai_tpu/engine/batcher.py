@@ -60,6 +60,11 @@ from pilottai_tpu.models.common import ModelConfig
 from pilottai_tpu.ops.kvcache import KVCache, free_slots
 from pilottai_tpu.ops.paged import PageAllocator, PagedKVCache
 from pilottai_tpu.ops.pallas.decode_attention import decode_shapes_ok
+from pilottai_tpu.reliability import (
+    DeadlineExceeded,
+    EngineOverloaded,
+    global_injector,
+)
 from pilottai_tpu.utils.logging import get_logger
 from pilottai_tpu.utils.metrics import global_metrics
 
@@ -87,6 +92,12 @@ class GenRequest:
     # Set by the caller (any thread) to abandon the request; the device loop
     # frees its slot at the next chunk boundary instead of decoding dead work.
     cancelled: bool = False
+    # End-to-end deadline: absolute ``time.monotonic()`` time. Checked at
+    # submit, again at admission (a request that expired in the backlog
+    # never costs a prefill), and swept every device-loop cycle so an
+    # occupied slot whose deadline passes mid-decode is force-released
+    # (its future fails with DeadlineExceeded). None = no deadline.
+    deadline: Optional[float] = None
     # Streaming: called from the READER thread with each batch of newly
     # folded output tokens (eos/stop ids already filtered — exactly the
     # ids the future's final result will contain, in order). Must be
@@ -144,6 +155,7 @@ class ContinuousBatcher:
         pipeline_depth: int = 2,  # decode chunks in flight (tunnel hiding)
         schema_bank: Optional[Any] = None,  # json_schema.SchemaBank
         prefill_chunk: Optional[int] = None,  # chunked-prefill segment size
+        max_queue_depth: Optional[int] = None,  # admission control (shed)
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -153,6 +165,10 @@ class ContinuousBatcher:
         self.min_bucket = min_bucket
         self.chunk_size = chunk_size
         self.admit_batch = min(admit_batch, n_slots)
+        # Overload shedding: submits beyond this many queued-not-admitted
+        # requests raise EngineOverloaded instead of growing the queue
+        # unboundedly (the HTTP edge maps it to 429). None = unbounded.
+        self.max_queue_depth = max_queue_depth
         # Whether this batcher's computations actually run on a TPU (the
         # cpu provider can run on a machine whose default backend IS a
         # TPU, so the process-level check is not enough for the Pallas
@@ -437,7 +453,39 @@ class ContinuousBatcher:
     # Submission (any thread)
     # ------------------------------------------------------------------ #
 
+    def queue_depth(self) -> int:
+        """Requests submitted but not yet admitted to a slot (any thread;
+        approximate — both containers move concurrently)."""
+        return self._pending.qsize() + len(self._backlog)
+
+    def saturated(self) -> bool:
+        return (
+            self.max_queue_depth is not None
+            and self.queue_depth() >= self.max_queue_depth
+        )
+
     def submit(self, request: GenRequest) -> Future:
+        # Admission control first: a shed request must cost nothing — no
+        # queue entry, no truncation work, no future resolution. Raising
+        # (rather than failing the future) lets the HTTP edge turn this
+        # into a structured 429 before any engine state exists for it.
+        if self.saturated():
+            global_metrics.inc("engine.shed")
+            raise EngineOverloaded(
+                f"engine queue depth {self.queue_depth()} at configured "
+                f"limit {self.max_queue_depth}; shedding"
+            )
+        # A request born expired (edge queueing, client retry storms)
+        # fails immediately instead of wasting a prefill.
+        if (
+            request.deadline is not None
+            and time.monotonic() >= request.deadline
+        ):
+            global_metrics.inc("engine.expired")
+            request.future.set_exception(
+                DeadlineExceeded("request deadline expired before submit")
+            )
+            return request.future
         # An empty prompt would be indistinguishable from an admission
         # padding row (lens <= 0 => dropped) and hang; decode from a single
         # pad token instead.
@@ -519,6 +567,31 @@ class ContinuousBatcher:
     def _free_slot_indices(self) -> List[int]:
         return [i for i, s in enumerate(self._slots) if s is None]
 
+    def _expire_deadlines(self) -> None:
+        """Force-release occupied slots whose deadline passed mid-decode
+        (device thread, once per loop cycle). Mirrors _check_finished's
+        release protocol: slot → None now, the stop/free device ops run
+        through ``_release`` at the next admission, and the ``slot is
+        None`` guard plus the admission generation stamp keep any
+        still-in-flight chunk from folding into the freed slot."""
+        now = time.monotonic()
+        with self._lock:
+            for i, slot in enumerate(self._slots):
+                if slot is None:
+                    continue
+                req = slot.request
+                if req.deadline is None or now < req.deadline:
+                    continue
+                self._slots[i] = None
+                self._release.append(i)
+                global_metrics.inc("engine.expired")
+                global_metrics.inc("engine.deadline_releases")
+                if not req.future.done():
+                    req.future.set_exception(DeadlineExceeded(
+                        f"request deadline expired after "
+                        f"{len(slot.generated)} generated token(s)"
+                    ))
+
     def _admit(self) -> None:
         """Stop released slots, then prefill+install pending requests in
         padded groups. Slot selection happens under the lock; the device
@@ -577,6 +650,19 @@ class ContinuousBatcher:
                     req = self._backlog[0]
                     if req.cancelled or req.future.cancelled():
                         self._backlog.popleft()
+                        continue
+                    # Expired while queued: admitting would spend a
+                    # prefill on work whose caller already gave up.
+                    if (
+                        req.deadline is not None
+                        and time.monotonic() >= req.deadline
+                    ):
+                        self._backlog.popleft()
+                        global_metrics.inc("engine.expired")
+                        if not req.future.done():
+                            req.future.set_exception(DeadlineExceeded(
+                                "request deadline expired before admission"
+                            ))
                         continue
                     # Prefix-cache match keys the group: one shared
                     # cached prefix per admission dispatch.
@@ -708,10 +794,19 @@ class ContinuousBatcher:
         only); the final segment admits through the normal prefix-paged
         path, which samples the first token and installs the slot."""
         idx, req, done = self._segmenting
-        if req.cancelled or req.future.cancelled():
+        expired_now = (
+            req.deadline is not None and time.monotonic() >= req.deadline
+        )
+        if req.cancelled or req.future.cancelled() or expired_now:
             self._segmenting = None
             if self.alloc is not None:
                 self.alloc.release(idx)
+            if expired_now:
+                global_metrics.inc("engine.expired")
+                if not req.future.done():
+                    req.future.set_exception(DeadlineExceeded(
+                        "request deadline expired mid-prefill"
+                    ))
             return
         try:
             remaining = len(req.prompt_ids) - done
@@ -769,6 +864,10 @@ class ContinuousBatcher:
         entry: Optional[Any] = None,
         n_rows: Optional[int] = None,
     ) -> None:
+        # Chaos point: a slow (delay=) or failed (exc=) admission prefill.
+        # Raises land in _admit's per-group failure handling — exactly the
+        # production path a device fault would take.
+        global_injector.fire("engine.prefill", n_requests=len(group))
         A = n_rows if n_rows is not None else self.admit_batch
         slots = np.full((A,), self.n_slots, np.int32)  # OOB = padding row
         temps = np.zeros((A,), np.float32)
@@ -1135,6 +1234,10 @@ class ContinuousBatcher:
     def _dispatch_chunk(
         self, prefix_bound: int, est: float = 0.0, hi: int = 0,
     ):
+        # Chaos point: a failed decode dispatch. Raises propagate to the
+        # device loop boundary → _fail_occupied_slots fails the occupants
+        # with this exception while queued requests survive to re-admit.
+        global_injector.fire("engine.step")
         table = (
             jnp.asarray(self.alloc.table) if self.alloc is not None else None
         )
@@ -1389,6 +1492,7 @@ class ContinuousBatcher:
                 # occupants on the way here.
                 if self.cache.lengths.is_deleted():
                     self._rebuild_device_state()
+                self._expire_deadlines()
                 self._admit()
                 with self._lock:
                     useful = self._chunk_useful()
@@ -1465,4 +1569,10 @@ class ContinuousBatcher:
             ),
             "decode_steps": global_metrics.get("engine.decode_steps"),
             "completed": global_metrics.get("engine.completed"),
+            **(
+                {"max_queue_depth": self.max_queue_depth,
+                 "shed": global_metrics.get("engine.shed")}
+                if self.max_queue_depth is not None else {}
+            ),
+            "expired": global_metrics.get("engine.expired"),
         }

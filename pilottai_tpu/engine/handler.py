@@ -11,6 +11,7 @@ litellm remote calls.
 from __future__ import annotations
 
 import asyncio
+import random
 import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence
@@ -22,6 +23,13 @@ from pilottai_tpu.engine.types import (
     GenerationParams,
     LLMResponse,
     ToolSpec,
+)
+from pilottai_tpu.reliability import (
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceeded,
+    EngineOverloaded,
+    global_injector,
 )
 from pilottai_tpu.utils.logging import get_logger
 from pilottai_tpu.utils.metrics import global_metrics
@@ -100,6 +108,19 @@ class LLMHandler:
         self._limiter = (
             RateLimiter(self.config.max_rpm) if self.config.max_rpm else None
         )
+        # Circuit breaker over every engine call: repeated backend
+        # failures flip to fast-fail (the HTTP edge maps CircuitOpenError
+        # to 503) instead of piling retry budgets onto a dead device.
+        rel = self.config.reliability
+        self.breaker: Optional[CircuitBreaker] = (
+            CircuitBreaker(
+                failure_threshold=rel.breaker_failure_threshold,
+                recovery_timeout=rel.breaker_recovery_timeout,
+                half_open_max=rel.breaker_half_open_max,
+                name=self.config.model_name,
+            )
+            if rel.breaker_enabled else None
+        )
         self._log = get_logger("engine.handler")
         self._started = False
 
@@ -171,9 +192,26 @@ class LLMHandler:
             messages, tools, params, json_mode, json_schema
         )
 
+        deadline = params.deadline
         last_error: Optional[Exception] = None
         for attempt in range(self.config.retries + 1):
+            # Deadline first (before the breaker reserves a probe slot):
+            # a request whose budget is gone must not consume anything.
+            if deadline is not None and time.monotonic() >= deadline:
+                raise DeadlineExceeded(
+                    f"request deadline exhausted after {attempt} attempt(s)"
+                ) from last_error
+            if self.breaker is not None and not self.breaker.allow():
+                raise self.breaker.open_error() from last_error
+            # allow() may have reserved a half-open probe slot; every exit
+            # from this attempt must settle it (record_*) or release it
+            # (the finally below) — a cancellation between the two would
+            # otherwise leak the slot and wedge the breaker permanently.
+            settled = False
             try:
+                # Chaos point: simulate a wedged backend at the handler
+                # boundary (arm with exc=asyncio.TimeoutError).
+                global_injector.fire("handler.timeout")
                 if self._limiter:
                     await self._limiter.acquire()
                 async with self._semaphore:
@@ -181,10 +219,26 @@ class LLMHandler:
                         "engine.generate", model=self.config.model_name
                     ):
                         start = time.perf_counter()
-                        response = await asyncio.wait_for(
-                            self.backend.generate(msgs, specs or None, params),
-                            timeout=self.config.timeout,
-                        )
+                        budget = self.config.timeout
+                        if deadline is not None:
+                            budget = min(budget, deadline - time.monotonic())
+                        try:
+                            response = await asyncio.wait_for(
+                                self.backend.generate(msgs, specs or None, params),
+                                timeout=max(budget, 1e-3),
+                            )
+                        except asyncio.TimeoutError:
+                            if (
+                                deadline is not None
+                                and time.monotonic() >= deadline
+                            ):
+                                raise DeadlineExceeded(
+                                    "request deadline exceeded mid-generation"
+                                ) from None
+                            raise
+                if self.breaker is not None:
+                    self.breaker.record_success()
+                settled = True
                 latency = time.perf_counter() - start
                 global_metrics.observe("engine.request_latency", latency)
                 global_metrics.inc("engine.requests")
@@ -195,21 +249,73 @@ class LLMHandler:
                     "engine.completion_tokens", response.usage.completion_tokens
                 )
                 return response
+            except EngineOverloaded:
+                # Shed at admission: the engine is alive and protecting
+                # itself. Not a device failure (it must not open the
+                # breaker) and not retryable here — an immediate retry
+                # defeats the shed; push-back belongs to the caller.
+                if self.breaker is not None:
+                    self.breaker.record_success()
+                settled = True
+                global_metrics.inc("engine.errors")
+                raise
+            except DeadlineExceeded:
+                # Terminal for this request. It still counts against the
+                # breaker: deadline blowouts cluster exactly when the
+                # backend is wedged or drowning, and fast-failing the
+                # herd until a probe succeeds is the desired behavior.
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                settled = True
+                global_metrics.inc("engine.errors")
+                raise
             except Exception as exc:  # noqa: BLE001 - retry boundary
                 last_error = exc
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                settled = True
                 global_metrics.inc("engine.errors")
                 if attempt < self.config.retries:
-                    delay = self.config.retry_delay * (attempt + 1)
+                    delay = self._backoff_delay(attempt)
+                    if (
+                        deadline is not None
+                        and time.monotonic() + delay >= deadline
+                    ):
+                        # The backoff sleep alone would outlive the
+                        # deadline — fail now, not after sleeping.
+                        raise DeadlineExceeded(
+                            f"request deadline exhausted after "
+                            f"{attempt + 1} attempt(s)"
+                        ) from exc
                     self._log.warning(
-                        "generate attempt %d failed (%s); retrying in %.1fs",
+                        "generate attempt %d failed (%s); retrying in %.2fs",
                         attempt + 1,
                         exc,
                         delay,
                     )
                     await asyncio.sleep(delay)
+            finally:
+                if self.breaker is not None and not settled:
+                    # Cancelled (or otherwise aborted) with no verdict:
+                    # give the half-open probe slot back.
+                    self.breaker.release_probe()
         raise RuntimeError(
             f"LLM generation failed after {self.config.retries + 1} attempts"
         ) from last_error
+
+    def _backoff_delay(self, attempt: int) -> float:
+        """Capped exponential backoff with jitter. The seed's linear
+        ``retry_delay * (attempt + 1)`` schedule had no randomness, so a
+        wave of requests failing together retried in lockstep against a
+        just-recovered backend (thundering herd). Jitter spreads each
+        delay uniformly over [0.5x, 1.0x] of the exponential step."""
+        rel = self.config.reliability
+        delay = min(
+            self.config.retry_delay * (2.0 ** attempt), rel.retry_max_delay
+        )
+        if rel.retry_jitter and delay > 0:
+            delay *= 0.5 + 0.5 * random.random()
+        return delay
 
     async def astream(
         self,
@@ -237,55 +343,100 @@ class LLMHandler:
             messages, tools, params, json_mode, json_schema
         )
 
-        if self._limiter:
-            await self._limiter.acquire()
-        async with self._semaphore:
-            with global_tracer.span(
-                "engine.generate_stream", model=self.config.model_name
-            ):
-                start = time.perf_counter()
-                n_chars = 0
-                try:
-                    gen = self.backend.generate_stream(
-                        msgs, specs or None, params, info=info
-                    )
-                except TypeError:
-                    # Pre-`info` backend signature (user-supplied
-                    # backends): argument binding fails at call time,
-                    # before any iteration — safe to retry without.
-                    gen = self.backend.generate_stream(
-                        msgs, specs or None, params
-                    )
-                agen = gen.__aiter__()
-                failed = True  # timeout/backend error until proven otherwise
-                try:
-                    while True:
-                        try:
-                            delta = await asyncio.wait_for(
-                                agen.__anext__(), timeout=self.config.timeout
-                            )
-                        except StopAsyncIteration:
-                            break
-                        n_chars += len(delta)
-                        yield delta
-                    failed = False
-                except GeneratorExit:
-                    failed = False  # consumer chose to stop — not an error
-                    raise
-                finally:
-                    # Consumer break / timeout / error: close the backend
-                    # generator so its request is cancelled and the slot
-                    # freed (native engines cancel in their finally).
-                    await agen.aclose()
-                    # Metrics land on EVERY outcome (generate_response
-                    # parity: errors are counted, requests never vanish).
-                    global_metrics.observe(
-                        "engine.request_latency", time.perf_counter() - start
-                    )
-                    global_metrics.inc("engine.requests")
-                    global_metrics.inc("engine.stream_chars", n_chars)
-                    if failed:
-                        global_metrics.inc("engine.errors")
+        deadline = params.deadline
+        if self.breaker is not None and not self.breaker.allow():
+            raise self.breaker.open_error()
+        # allow() may have reserved a half-open probe slot: every exit
+        # path must settle it (the inner finally below) or release it
+        # (the BaseException arm at the bottom — cancellation while
+        # acquiring the limiter/semaphore, or a failed generator
+        # creation, would otherwise leak the slot and wedge the breaker).
+        settled = False
+        try:
+            if self._limiter:
+                await self._limiter.acquire()
+            async with self._semaphore:
+                with global_tracer.span(
+                    "engine.generate_stream", model=self.config.model_name
+                ):
+                    start = time.perf_counter()
+                    n_chars = 0
+                    try:
+                        gen = self.backend.generate_stream(
+                            msgs, specs or None, params, info=info
+                        )
+                    except TypeError:
+                        # Pre-`info` backend signature (user-supplied
+                        # backends): argument binding fails at call time,
+                        # before any iteration — safe to retry without.
+                        gen = self.backend.generate_stream(
+                            msgs, specs or None, params
+                        )
+                    agen = gen.__aiter__()
+                    failed = True  # error until proven otherwise
+                    shed = False
+                    try:
+                        while True:
+                            wait = self.config.timeout
+                            if deadline is not None:
+                                wait = min(wait, deadline - time.monotonic())
+                            try:
+                                delta = await asyncio.wait_for(
+                                    agen.__anext__(), timeout=max(wait, 1e-3)
+                                )
+                            except StopAsyncIteration:
+                                break
+                            except asyncio.TimeoutError:
+                                if (
+                                    deadline is not None
+                                    and time.monotonic() >= deadline
+                                ):
+                                    raise DeadlineExceeded(
+                                        "request deadline exceeded mid-stream"
+                                    ) from None
+                                raise
+                            n_chars += len(delta)
+                            yield delta
+                        failed = False
+                    except GeneratorExit:
+                        failed = False  # consumer chose to stop — not an error
+                        raise
+                    except EngineOverloaded:
+                        # Shed at admission: counts as an error for the
+                        # request metrics but NOT against the breaker —
+                        # unary-path parity (a shed proves the engine is
+                        # alive and protecting itself).
+                        shed = True
+                        raise
+                    finally:
+                        # Consumer break / timeout / error: close the backend
+                        # generator so its request is cancelled and the slot
+                        # freed (native engines cancel in their finally).
+                        await agen.aclose()
+                        # Metrics land on EVERY outcome (generate_response
+                        # parity: errors are counted, requests never vanish).
+                        global_metrics.observe(
+                            "engine.request_latency",
+                            time.perf_counter() - start,
+                        )
+                        global_metrics.inc("engine.requests")
+                        global_metrics.inc("engine.stream_chars", n_chars)
+                        if failed:
+                            global_metrics.inc("engine.errors")
+                        settled = True
+                        if self.breaker is not None:
+                            # Pair the allow() above: streams report into
+                            # the breaker like unary calls (consumer breaks
+                            # count as success — the backend was serving
+                            # fine).
+                            if failed and not shed:
+                                self.breaker.record_failure()
+                            else:
+                                self.breaker.record_success()
+        except BaseException:
+            if self.breaker is not None and not settled:
+                self.breaker.release_probe()
+            raise
 
     async def apredict(self, prompt: str, **kwargs: Any) -> str:
         """Plain string-in/string-out (reference ``llm.py:181-199``)."""
@@ -312,4 +463,8 @@ class LLMHandler:
             "backend": self.backend.get_metrics(),
             "requests": global_metrics.get("engine.requests"),
             "errors": global_metrics.get("engine.errors"),
+            **(
+                {"breaker": self.breaker.snapshot()}
+                if self.breaker is not None else {}
+            ),
         }

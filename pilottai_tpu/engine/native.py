@@ -230,6 +230,7 @@ class NativeEngine(LLMBackend):
             pipeline_depth=self.config.engine_pipeline,
             schema_bank=self.schema_bank,
             prefill_chunk=self.config.engine_prefill_chunk,
+            max_queue_depth=self.config.reliability.max_queue_depth,
         )
         self.batcher.start()
         self.batcher.warmup()
@@ -306,6 +307,7 @@ class NativeEngine(LLMBackend):
                 or self._json_tables is not None
             ),
             json_schema_id=schema_id,
+            deadline=params.deadline,
         )
 
     def schema_support(self, schema: Dict[str, Any]) -> Optional[str]:

@@ -70,6 +70,14 @@ class GenerationParams(BaseModel):
     # json_schema. Byte-tokenizer engines enforce it by construction;
     # unsupported schemas / subword vocabs degrade to generic json_mode.
     json_schema: Optional[Dict[str, Any]] = None
+    # End-to-end request deadline: ABSOLUTE ``time.monotonic()`` time (not
+    # a relative budget — a deadline survives queueing and retries without
+    # re-arming). Set by the HTTP edge from ``timeout``/``x-request-timeout``
+    # (reliability.deadline_from_timeout); every layer that can spend time
+    # (handler retry loop, batcher admission and decode) checks it and
+    # fails with reliability.DeadlineExceeded when it passes. None = no
+    # deadline (the seed behavior).
+    deadline: Optional[float] = None
 
 
 class Usage(BaseModel):

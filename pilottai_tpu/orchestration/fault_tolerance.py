@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional
 from pilottai_tpu.core.agent import BaseAgent
 from pilottai_tpu.core.config import FaultToleranceConfig
 from pilottai_tpu.core.status import AgentStatus, HealthStatus
+from pilottai_tpu.reliability import global_injector
 from pilottai_tpu.utils.logging import get_logger
 from pilottai_tpu.utils.metrics import global_metrics
 
@@ -89,6 +90,9 @@ class FaultTolerance:
 
     def unregister_agent(self, agent_id: str) -> None:
         self.health.pop(agent_id, None)
+        # Drop the health gauge with the record: a stale gauge for a
+        # removed agent reads as a live health report forever.
+        global_metrics.remove_gauge(f"fault.health.{agent_id}")
 
     async def _monitoring_loop(self) -> None:
         while True:
@@ -106,6 +110,14 @@ class FaultTolerance:
         health = self.health[agent.id]
         info = agent.get_health()
         health.last_heartbeat = info["last_heartbeat"]
+        # Chaos point: an injected heartbeat stall of ``value=`` seconds —
+        # the agent looks silent without actually wedging anything, so the
+        # monitor's stale-heartbeat → recover/replace path is testable.
+        stall = global_injector.fire("agent.heartbeat.stall", agent_id=agent.id)
+        if stall:
+            health.last_heartbeat = min(
+                health.last_heartbeat, time.time() - float(stall)
+            )
         health.error_count = info["error_count"]
         health.stuck_tasks = sum(
             1
@@ -139,8 +151,10 @@ class FaultTolerance:
         for agent in self.orchestrator.agent_list():
             health = self._assess(agent)
             statuses[agent.id] = health.status
+            # Key by FULL id: 8-char prefixes can collide across agents,
+            # silently merging two agents' health into one gauge.
             global_metrics.set_gauge(
-                f"fault.health.{agent.id[:8]}",
+                f"fault.health.{agent.id}",
                 list(HealthStatus).index(health.status),
             )
             if health.status == HealthStatus.UNHEALTHY:
@@ -148,11 +162,13 @@ class FaultTolerance:
             elif health.status == HealthStatus.CRITICAL:
                 if not await self._try_recover(agent, health):
                     await self._replace_agent(agent, health)
-        # Reap health records of agents no longer in the pool.
+        # Reap health records (and their gauges) of agents no longer in
+        # the pool.
         live = {a.id for a in self.orchestrator.agent_list()}
         for agent_id in list(self.health):
             if agent_id not in live:
                 del self.health[agent_id]
+                global_metrics.remove_gauge(f"fault.health.{agent_id}")
         return statuses
 
     # ------------------------------------------------------------------ #

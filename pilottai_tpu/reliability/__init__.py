@@ -1,0 +1,54 @@
+"""Reliability layer: end-to-end deadlines, overload shedding, circuit
+breaking and a fault-injection (chaos) harness.
+
+The serving north star is heavy traffic against finite hardware; this
+package holds the pieces that keep overload and failure *bounded*:
+
+* ``deadline`` — one deadline/overload error vocabulary plus monotonic
+  deadline helpers, threaded HTTP edge → handler → batcher.
+* ``inject`` — named failure points (no-ops in production) that chaos
+  tests script to provoke the failure paths the tree claims to handle.
+* ``breaker`` — a circuit breaker wrapping engine calls so repeated
+  device failures flip to fast-fail 503s with half-open probing.
+
+Import cost: utils-only dependencies, no jax — safe for control-plane
+processes.
+"""
+
+from pilottai_tpu.reliability.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    CircuitOpenError,
+)
+from pilottai_tpu.reliability.deadline import (
+    DeadlineExceeded,
+    EngineOverloaded,
+    deadline_from_timeout,
+    expired,
+    remaining,
+)
+from pilottai_tpu.reliability.inject import (
+    Fault,
+    FaultInjector,
+    global_injector,
+    inject,
+)
+
+__all__ = [
+    "CLOSED",
+    "HALF_OPEN",
+    "OPEN",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "DeadlineExceeded",
+    "EngineOverloaded",
+    "Fault",
+    "FaultInjector",
+    "deadline_from_timeout",
+    "expired",
+    "global_injector",
+    "inject",
+    "remaining",
+]

@@ -536,7 +536,14 @@ class Serve:
                 priority=TaskPriority.coerce(spec.get("priority", task.priority)),
                 parent_task_id=task.id,
                 payload=task.payload,
-                timeout=task.timeout,
+                # Inherit the parent's budget only when one was explicitly
+                # set — passing the 300s default through would mark every
+                # subtask as explicitly-budgeted and re-cap deployments
+                # that raised config.task_timeout.
+                **(
+                    {"timeout": task.timeout}
+                    if "timeout" in task.model_fields_set else {}
+                ),
             )
             deps = spec.get("depends_on", []) or []
             sub.dependencies = [
@@ -565,7 +572,15 @@ class Serve:
     async def execute_task(
         self, task: Task | Dict[str, Any] | str, timeout: Optional[float] = None
     ) -> TaskResult:
-        """Submit and wait for the final result."""
+        """Submit and wait for the final result. An explicit ``timeout``
+        is the caller's end-to-end budget: it bounds the wait AND is
+        threaded into ``task.timeout`` so the execution side (processor
+        ``wait_for``, decomposed subtasks, agents' stuck-task checks)
+        honors the same deadline instead of running to the config default
+        long after the caller gave up."""
+        task = self._coerce_task(task)
+        if timeout is not None:
+            task.timeout = min(task.timeout, timeout)
         task = await self.add_task(task)
         return await self.wait_for(task.id, timeout=timeout)
 
@@ -660,17 +675,25 @@ class Serve:
         return True, None
 
     async def _execute_with_limit(self, task: Task) -> None:
+        # An EXPLICIT per-task timeout (execute_task's caller budget, or
+        # set on the Task at construction) tightens the orchestrator
+        # default, never loosens it. Explicitness matters: Task.timeout
+        # has a non-None default (300s) that would otherwise silently cap
+        # a deployment's raised config.task_timeout.
+        budget = self.config.task_timeout
+        if "timeout" in task.model_fields_set:
+            budget = min(budget, task.timeout)
         async with self._exec_semaphore:
             try:
                 await asyncio.wait_for(
-                    self._execute_task(task), timeout=self.config.task_timeout
+                    self._execute_task(task), timeout=budget
                 )
             except asyncio.TimeoutError:
                 self._finalize(
                     task,
                     TaskResult(
                         success=False,
-                        error=f"orchestrator timeout after {self.config.task_timeout}s",
+                        error=f"orchestrator timeout after {budget}s",
                     ),
                 )
             except Exception as exc:  # noqa: BLE001 - task boundary

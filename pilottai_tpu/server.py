@@ -46,6 +46,11 @@ import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from pilottai_tpu.engine.types import GenerationParams, ToolSpec
+from pilottai_tpu.reliability import (
+    CircuitOpenError,
+    DeadlineExceeded,
+    EngineOverloaded,
+)
 from pilottai_tpu.utils.logging import get_logger
 from pilottai_tpu.utils.metrics import global_metrics
 
@@ -54,17 +59,44 @@ _MAX_BODY = 10 * 1024 * 1024
 
 _STATUS_TEXT = {
     200: "OK", 400: "Bad Request", 401: "Unauthorized", 404: "Not Found",
-    405: "Method Not Allowed", 413: "Payload Too Large",
+    405: "Method Not Allowed", 408: "Request Timeout",
+    413: "Payload Too Large", 429: "Too Many Requests",
     500: "Internal Server Error", 503: "Service Unavailable",
 }
 
 
 class _HttpError(Exception):
-    def __init__(self, status: int, message: str, kind: str = "invalid_request_error"):
+    def __init__(
+        self,
+        status: int,
+        message: str,
+        kind: str = "invalid_request_error",
+        extra: Optional[Dict[str, Any]] = None,
+    ):
         super().__init__(message)
         self.status = status
         self.message = message
         self.kind = kind
+        self.extra = extra or {}
+
+
+def _overload_error(exc: Exception) -> _HttpError:
+    """Reliability exceptions → structured HTTP errors (documented in
+    docs/SERVING.md "Overload & failure semantics"): deadline exceeded →
+    408 timeout_error; breaker open → 503 overloaded_error (with a
+    retry_after hint); queue shed → 429 overloaded_error."""
+    if isinstance(exc, DeadlineExceeded):
+        return _HttpError(
+            408, str(exc) or "request deadline exceeded", "timeout_error"
+        )
+    if isinstance(exc, CircuitOpenError):
+        return _HttpError(
+            503, str(exc), "overloaded_error",
+            extra={"retry_after": round(exc.retry_after, 3)},
+        )
+    return _HttpError(
+        429, str(exc) or "engine overloaded; request shed", "overloaded_error"
+    )
 
 
 class APIServer:
@@ -144,6 +176,11 @@ class APIServer:
                 await self._route(method, path, headers, body, writer)
             except _HttpError as exc:
                 await self._send_error(writer, exc)
+            except (DeadlineExceeded, EngineOverloaded, CircuitOpenError) as exc:
+                # Overload/deadline shedding is routine under load — a
+                # structured client error, not a 500 with a stack trace.
+                global_metrics.inc("server.shed_responses")
+                await self._send_error(writer, _overload_error(exc))
             except (ConnectionError, asyncio.IncompleteReadError):
                 # Routine client drop (usually mid-SSE): no error log, and
                 # never write a 500 body into an already-started response.
@@ -232,7 +269,7 @@ class APIServer:
     async def _send_error(self, writer: asyncio.StreamWriter, exc: _HttpError) -> None:
         await self._send(
             writer, exc.status,
-            {"error": {"message": exc.message, "type": exc.kind}},
+            {"error": {"message": exc.message, "type": exc.kind, **exc.extra}},
         )
 
     # Shared SSE scaffolding — one definition for every streaming route
@@ -255,7 +292,17 @@ class APIServer:
 
     def _sse_error(self, writer: asyncio.StreamWriter, exc: Exception) -> None:
         """In-band error event: the 200 + SSE status line is already on
-        the wire, so errors can't change it anymore."""
+        the wire, so errors can't change it anymore. Reliability errors
+        keep their structured type (timeout_error / overloaded_error) so
+        SSE clients can tell a shed from a crash."""
+        if isinstance(exc, (DeadlineExceeded, EngineOverloaded, CircuitOpenError)):
+            err = _overload_error(exc)
+            self._log.warning("stream shed: %s", exc)
+            self._sse_event(
+                writer,
+                {"error": {"message": err.message, "type": err.kind, **err.extra}},
+            )
+            return
         self._log.error("stream failed: %s", exc, exc_info=True)
         self._sse_event(
             writer, {"error": {"message": str(exc), "type": "server_error"}}
@@ -294,7 +341,7 @@ class APIServer:
         elif path == "/v1/chat/completions":
             if method != "POST":
                 raise _HttpError(405, "POST required")
-            await self._chat_completions(_parse_json(body), writer)
+            await self._chat_completions(_parse_json(body), writer, headers)
         elif path == "/v1/embeddings":
             if method != "POST":
                 raise _HttpError(405, "POST required")
@@ -302,7 +349,7 @@ class APIServer:
         elif path == "/v1/tasks":
             if method != "POST":
                 raise _HttpError(405, "POST required")
-            await self._submit_task(_parse_json(body), writer)
+            await self._submit_task(_parse_json(body), writer, headers)
         else:
             raise _HttpError(404, f"no route for {method} {path}")
 
@@ -419,11 +466,48 @@ class APIServer:
             raise _HttpError(400, f"invalid sampling parameter: {exc}") from exc
         return messages, tools, params, strict
 
+    def _request_deadline(
+        self, req: Dict[str, Any], headers: Dict[str, str], handler: Any
+    ) -> Optional[float]:
+        """Derive the request's absolute monotonic deadline: body
+        ``timeout`` beats the ``x-request-timeout`` header beats the
+        deployment's ``ReliabilityConfig.default_timeout``; whatever wins
+        is capped at ``max_timeout``. None = no deadline."""
+        raw = req.get("timeout")
+        if raw is None:
+            raw = headers.get("x-request-timeout")
+        rel = getattr(
+            getattr(handler, "config", None), "reliability", None
+        )
+        if raw is None and rel is not None:
+            raw = rel.default_timeout
+        if raw is None:
+            return None
+        if isinstance(raw, bool) or not isinstance(raw, (int, float, str)):
+            raise _HttpError(400, "'timeout' must be a number of seconds")
+        try:
+            t = float(raw)
+        except ValueError as exc:
+            raise _HttpError(
+                400, "'timeout' must be a number of seconds"
+            ) from exc
+        if t <= 0:
+            raise _HttpError(400, "'timeout' must be > 0")
+        if rel is not None:
+            t = min(t, rel.max_timeout)
+        return time.monotonic() + t
+
     async def _chat_completions(
-        self, req: Dict[str, Any], writer: asyncio.StreamWriter
+        self,
+        req: Dict[str, Any],
+        writer: asyncio.StreamWriter,
+        headers: Optional[Dict[str, str]] = None,
     ) -> None:
         messages, tools, params, strict = self._gen_params(req)
         handler = self._pick_handler(req.get("model"))
+        deadline = self._request_deadline(req, headers or {}, handler)
+        if deadline is not None:
+            params = params.model_copy(update={"deadline": deadline})
         model = req.get("model") or getattr(
             getattr(handler, "config", None), "model_name", "default"
         )
@@ -602,7 +686,10 @@ class APIServer:
     # ------------------------------------------------------------------ #
 
     async def _submit_task(
-        self, req: Dict[str, Any], writer: asyncio.StreamWriter
+        self,
+        req: Dict[str, Any],
+        writer: asyncio.StreamWriter,
+        headers: Optional[Dict[str, str]] = None,
     ) -> None:
         if self.serve is None:
             raise _HttpError(
@@ -612,11 +699,26 @@ class APIServer:
         task = req.get("task") or req.get("description")
         if not task:
             raise _HttpError(400, "'task' (or 'description') is required")
+        # Same precedence and caps as chat completions: body beats the
+        # x-request-timeout header beats reliability.default_timeout, all
+        # capped at max_timeout. Serve threads the budget into
+        # ``task.timeout`` so agents honor it too.
         timeout = req.get("timeout")
+        if timeout is None:
+            timeout = (headers or {}).get("x-request-timeout")
+        rel = getattr(
+            getattr(self.handler, "config", None), "reliability", None
+        )
+        if timeout is None and rel is not None:
+            timeout = rel.default_timeout
         try:
             timeout = float(timeout) if timeout is not None else None
         except (TypeError, ValueError) as exc:
             raise _HttpError(400, "'timeout' must be a number") from exc
+        if timeout is not None and timeout <= 0:
+            raise _HttpError(400, "'timeout' must be > 0")
+        if timeout is not None and rel is not None:
+            timeout = min(timeout, rel.max_timeout)
 
         def result_payload(result) -> Dict[str, Any]:
             return {
@@ -676,7 +778,16 @@ class APIServer:
             await self._sse_done(writer)
             return
 
-        result = await self.serve.execute_task(task, timeout=timeout)
+        try:
+            result = await self.serve.execute_task(task, timeout=timeout)
+        except asyncio.TimeoutError:
+            # The caller's budget elapsed before the orchestrator finished
+            # (execute_task threaded the same budget into task.timeout, so
+            # the execution side is winding the task down too).
+            raise _HttpError(
+                408, f"task did not complete within {timeout}s",
+                "timeout_error",
+            ) from None
         await self._send(writer, 200, result_payload(result))
 
 

@@ -69,6 +69,12 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[name] = value
 
+    def remove_gauge(self, name: str) -> None:
+        """Drop a gauge entirely (e.g. a reaped agent's health gauge —
+        a stale last value would read as a live report forever)."""
+        with self._lock:
+            self._gauges.pop(name, None)
+
     def observe(self, name: str, value: float) -> None:
         with self._lock:
             if name not in self._histograms:

@@ -1,0 +1,672 @@
+"""Chaos suite: fault-injection driven tests of the reliability layer.
+
+Every test here provokes a failure path through the *named injection
+registry* (pilottai_tpu/reliability/inject.py) — no monkeypatching of
+engine internals — and asserts the system stays bounded: deadlines bound
+wall time end-to-end, overload sheds instead of queueing unboundedly,
+the breaker fast-fails and recovers, and an injected device failure
+fails exactly the in-flight work while queued requests survive.
+
+The whole module carries the ``chaos`` marker (the CI chaos job runs
+``pytest -m chaos``); soak variants are additionally ``slow`` so they
+stay out of the tier-1 lane.
+"""
+
+import asyncio
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pilottai_tpu.core.config import (
+    AgentConfig,
+    FaultToleranceConfig,
+    LLMConfig,
+    ReliabilityConfig,
+    ServeConfig,
+)
+from pilottai_tpu.engine.batcher import ContinuousBatcher, GenRequest
+from pilottai_tpu.engine.handler import LLMHandler
+from pilottai_tpu.engine.mock import MockBackend
+from pilottai_tpu.engine.types import GenerationParams
+from pilottai_tpu.models.common import init_params
+from pilottai_tpu.models.registry import get_model_config
+from pilottai_tpu.reliability import (
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceeded,
+    EngineOverloaded,
+    FaultInjector,
+    global_injector,
+    inject,
+)
+from pilottai_tpu.utils.metrics import global_metrics
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    global_injector.reset()
+    yield
+    global_injector.reset()
+
+
+def _tiny_batcher(max_seq=64, n_slots=2, **kw):
+    cfg = get_model_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return ContinuousBatcher(
+        cfg, params, n_slots=n_slots, max_seq_len=max_seq,
+        cache_dtype=jnp.float32, **kw,
+    )
+
+
+# ----------------------------- injector -------------------------------- #
+
+def test_injector_noop_arm_times_and_scope():
+    # Unarmed = production fast path: returns None, no record.
+    assert global_injector.fire("engine.step") is None
+    assert global_injector.fired("engine.step") == 0
+
+    global_injector.arm("x.point", value=42, times=2)
+    assert global_injector.fire("x.point") == 42
+    assert global_injector.armed("x.point")
+    assert global_injector.fire("x.point") == 42
+    # times exhausted -> auto-disarmed, count survives.
+    assert not global_injector.armed("x.point")
+    assert global_injector.fire("x.point") is None
+    assert global_injector.fired("x.point") == 2
+
+    with inject("y.point", RuntimeError, times=None):
+        with pytest.raises(RuntimeError, match="injected fault at 'y.point'"):
+            global_injector.fire("y.point")
+    # Context exit disarms even with times=None.
+    assert global_injector.fire("y.point") is None
+
+
+def test_injector_probability_is_seeded_and_partial():
+    def run(seed):
+        reg = FaultInjector(seed=seed)
+        reg.arm("p", value=1, times=None, probability=0.5)
+        return [reg.fire("p") for _ in range(200)]
+
+    fires = sum(v == 1 for v in run(7))
+    assert 40 < fires < 160  # partial, not all-or-nothing
+    assert run(7) == run(7)  # reproducible chaos soaks
+
+
+def test_injector_delay_blocks_then_returns():
+    global_injector.arm("d", delay=0.05, value="v")
+    t0 = time.perf_counter()
+    assert global_injector.fire("d") == "v"
+    assert time.perf_counter() - t0 >= 0.05
+
+
+# ----------------------------- breaker --------------------------------- #
+
+def test_breaker_opens_after_threshold_and_fast_fails():
+    br = CircuitBreaker(failure_threshold=3, recovery_timeout=30.0, name="t1")
+    for _ in range(2):
+        assert br.allow()
+        br.record_failure()
+    assert br.state == "closed"
+    assert br.allow()
+    br.record_failure()  # third consecutive -> open
+    assert br.state == "open"
+    assert not br.allow()
+    assert br.retry_after() > 0
+    err = br.open_error()
+    assert isinstance(err, CircuitOpenError) and err.retry_after > 0
+
+
+def test_breaker_half_open_probe_paths():
+    br = CircuitBreaker(
+        failure_threshold=1, recovery_timeout=0.05, half_open_max=1, name="t2"
+    )
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    time.sleep(0.06)
+    assert br.state == "half_open"
+    assert br.allow()       # the probe slot
+    assert not br.allow()   # only half_open_max probes pass
+    br.record_failure()     # probe failed -> re-open, window re-armed
+    assert br.state == "open"
+    time.sleep(0.06)
+    assert br.allow()
+    br.record_success()     # probe succeeded -> closed
+    assert br.state == "closed" and br.allow()
+
+
+def test_breaker_released_probe_does_not_wedge_half_open():
+    # A probe that ends with NO verdict (cancelled mid-flight) must give
+    # its slot back — leaked slots would pin allow() False forever.
+    br = CircuitBreaker(
+        failure_threshold=1, recovery_timeout=0.05, half_open_max=1, name="t4"
+    )
+    br.record_failure()
+    time.sleep(0.06)
+    assert br.allow()        # probe reserved...
+    br.release_probe()       # ...but the call was cancelled: release
+    assert br.allow()        # the slot is available again
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_breaker_success_resets_consecutive_count():
+    br = CircuitBreaker(failure_threshold=2, name="t3")
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.state == "closed"  # never 2 consecutive
+
+
+# ------------------------- handler reliability -------------------------- #
+
+def _handler(backend, **rel_kw):
+    cfg_kw = {
+        k: rel_kw.pop(k)
+        for k in ("retries", "retry_delay", "timeout")
+        if k in rel_kw
+    }
+    return LLMHandler(
+        LLMConfig(
+            provider="mock",
+            reliability=ReliabilityConfig(**rel_kw),
+            **cfg_kw,
+        ),
+        backend=backend,
+    )
+
+
+def test_backoff_is_exponential_capped_and_jittered():
+    h = _handler(
+        MockBackend(), retries=0, retry_delay=1.0,
+        retry_max_delay=4.0, retry_jitter=False,
+    )
+    assert [h._backoff_delay(a) for a in range(4)] == [1.0, 2.0, 4.0, 4.0]
+    hj = _handler(
+        MockBackend(), retries=0, retry_delay=1.0, retry_max_delay=4.0,
+    )
+    for attempt, base in enumerate([1.0, 2.0, 4.0, 4.0]):
+        for _ in range(20):
+            d = hj._backoff_delay(attempt)
+            assert 0.5 * base <= d <= base
+
+
+@pytest.mark.asyncio
+async def test_handler_breaker_opens_then_recovers_half_open():
+    calls = {"n": 0, "healthy": False}
+
+    class Flaky(MockBackend):
+        async def generate(self, messages, tools=None, params=None):
+            calls["n"] += 1
+            if not calls["healthy"]:
+                raise RuntimeError("device gone")
+            return await super().generate(messages, tools, params)
+
+    h = _handler(
+        Flaky(), retries=0, retry_delay=0.0,
+        breaker_failure_threshold=2, breaker_recovery_timeout=0.1,
+    )
+    for _ in range(2):
+        with pytest.raises(RuntimeError):
+            await h.apredict("x")
+    assert calls["n"] == 2 and h.breaker.state == "open"
+    # Open -> fast fail without touching the backend.
+    with pytest.raises(CircuitOpenError):
+        await h.apredict("x")
+    assert calls["n"] == 2
+    # Recovery window -> half-open probe -> success closes it.
+    calls["healthy"] = True
+    await asyncio.sleep(0.12)
+    assert await h.apredict("x")
+    assert h.breaker.state == "closed" and calls["n"] == 3
+
+
+@pytest.mark.asyncio
+async def test_handler_timeout_injection_feeds_breaker():
+    """Breaker open -> fast-fail -> half-open recovery, driven purely by
+    the injection registry (acceptance criterion)."""
+    backend_calls = {"n": 0}
+
+    class Counting(MockBackend):
+        async def generate(self, messages, tools=None, params=None):
+            backend_calls["n"] += 1
+            return await super().generate(messages, tools, params)
+
+    h = _handler(
+        Counting(), retries=0, retry_delay=0.0,
+        breaker_failure_threshold=2, breaker_recovery_timeout=0.1,
+    )
+    global_injector.arm("handler.timeout", asyncio.TimeoutError, times=2)
+    for _ in range(2):
+        with pytest.raises(RuntimeError, match="failed after 1 attempt"):
+            await h.apredict("x")
+    assert backend_calls["n"] == 0  # fault fired before the backend
+    assert h.breaker.state == "open"
+    with pytest.raises(CircuitOpenError):
+        await h.apredict("x")
+    await asyncio.sleep(0.12)
+    assert await h.apredict("x")  # injection exhausted -> probe succeeds
+    assert h.breaker.state == "closed"
+    assert global_injector.fired("handler.timeout") == 2
+
+
+@pytest.mark.asyncio
+async def test_handler_deadline_preempts_backend_and_backoff():
+    calls = {"n": 0}
+
+    class Slow(MockBackend):
+        async def generate(self, messages, tools=None, params=None):
+            calls["n"] += 1
+            await asyncio.sleep(0.5)
+            return await super().generate(messages, tools, params)
+
+    h = _handler(Slow(), retries=3, retry_delay=5.0, breaker_enabled=False)
+    # Born expired: no backend call at all.
+    with pytest.raises(DeadlineExceeded):
+        await h.apredict(
+            "x", params=GenerationParams(deadline=time.monotonic() - 1)
+        )
+    assert calls["n"] == 0
+    # Deadline clips the wait: fails in ~0.1s, and the 5s backoff must
+    # not be slept through either (the deadline pre-empts the retry).
+    t0 = time.perf_counter()
+    with pytest.raises(DeadlineExceeded):
+        await h.apredict(
+            "x", params=GenerationParams(deadline=time.monotonic() + 0.1)
+        )
+    assert time.perf_counter() - t0 < 0.45
+    assert calls["n"] == 1
+
+
+@pytest.mark.asyncio
+async def test_handler_overload_is_not_retried_and_not_breaker_failure():
+    calls = {"n": 0}
+
+    class Shedding(MockBackend):
+        async def generate(self, messages, tools=None, params=None):
+            calls["n"] += 1
+            raise EngineOverloaded("queue full")
+
+    h = _handler(
+        Shedding(), retries=3, retry_delay=0.0, breaker_failure_threshold=1,
+    )
+    with pytest.raises(EngineOverloaded):
+        await h.apredict("x")
+    assert calls["n"] == 1  # no retry: push-back means push-back
+    assert h.breaker.state == "closed"  # shed != device failure
+
+
+@pytest.mark.asyncio
+async def test_astream_shed_is_not_a_breaker_failure():
+    class SheddingStream(MockBackend):
+        async def generate_stream(
+            self, messages, tools=None, params=None, info=None
+        ):
+            raise EngineOverloaded("stream shed")
+            yield  # pragma: no cover — makes this an async generator
+
+    h = _handler(SheddingStream(), retries=0, breaker_failure_threshold=1)
+    with pytest.raises(EngineOverloaded):
+        async for _ in h.astream("x"):
+            pass
+    assert h.breaker.state == "closed"  # unary-path parity: shed != failure
+
+
+# --------------------------- batcher chaos ------------------------------ #
+
+def test_deadline_bounds_request_against_slow_engine():
+    """Acceptance: a short deadline against a chaos-slowed engine returns
+    a structured timeout error and the slot is NOT leaked (n_slots=1 —
+    the follow-up request can only complete through the freed slot)."""
+    b = _tiny_batcher(n_slots=1)
+    b.start()
+    try:
+        with inject("engine.prefill", delay=0.3, times=None):
+            req = GenRequest(
+                prompt_ids=[3, 4, 5], max_new_tokens=48,
+                deadline=time.monotonic() + 0.1,
+            )
+            fut = b.submit(req)
+            with pytest.raises(DeadlineExceeded):
+                fut.result(timeout=120)
+        assert global_injector.fired("engine.prefill") >= 1
+        # Slot freed: a fresh request (no deadline) completes through it.
+        req2 = GenRequest(prompt_ids=[6, 7], max_new_tokens=4)
+        out = b.submit(req2).result(timeout=120)
+        assert isinstance(out, list) and len(out) >= 1
+        assert b._thread.is_alive()
+    finally:
+        b.stop()
+
+
+def test_deadline_expired_in_backlog_rejected_at_admission():
+    b = _tiny_batcher(n_slots=1)
+    req = GenRequest(
+        prompt_ids=[3, 4], max_new_tokens=4,
+        deadline=time.monotonic() + 0.05,
+    )
+    fut = b.submit(req)  # queued while the loop isn't running yet
+    time.sleep(0.1)
+    before = global_metrics.get("engine.expired")
+    b.start()
+    try:
+        with pytest.raises(DeadlineExceeded, match="before admission"):
+            fut.result(timeout=60)
+        assert global_metrics.get("engine.expired") >= before + 1
+    finally:
+        b.stop()
+
+
+def test_deadline_expired_before_submit_costs_nothing():
+    b = _tiny_batcher(n_slots=1)  # never started: submit path only
+    req = GenRequest(
+        prompt_ids=[3], max_new_tokens=4, deadline=time.monotonic() - 1,
+    )
+    fut = b.submit(req)
+    with pytest.raises(DeadlineExceeded, match="before submit"):
+        fut.result(timeout=1)
+    assert b.queue_depth() == 0  # no queue entry exists for it
+
+
+def test_queue_depth_shedding_while_inflight_completes():
+    """Acceptance: submits beyond max_queue_depth raise EngineOverloaded
+    (the HTTP edge maps it to 429) while already-accepted requests
+    complete untouched."""
+    b = _tiny_batcher(n_slots=1, max_queue_depth=2)
+    futs = [
+        b.submit(GenRequest(prompt_ids=[3, 4], max_new_tokens=3))
+        for _ in range(2)
+    ]
+    assert b.saturated()
+    with pytest.raises(EngineOverloaded, match="shedding"):
+        b.submit(GenRequest(prompt_ids=[5], max_new_tokens=3))
+    assert global_metrics.get("engine.shed") >= 1
+    b.start()
+    try:
+        for fut in futs:  # the accepted work still completes
+            assert isinstance(fut.result(timeout=120), list)
+    finally:
+        b.stop()
+
+
+def test_injected_step_failure_fails_occupied_not_queued():
+    """Satellite: chaos-driven regression for the device-failure path —
+    _fail_occupied_slots fails the in-flight request with the ORIGINAL
+    exception; the queued request survives and completes."""
+    b = _tiny_batcher(n_slots=1)
+    global_injector.arm(
+        "engine.step", RuntimeError("injected device failure"), times=1
+    )
+    b.start()
+    try:
+        fut1 = b.submit(GenRequest(prompt_ids=[3, 4, 5], max_new_tokens=32))
+        fut2 = b.submit(GenRequest(prompt_ids=[6, 7], max_new_tokens=4))
+        with pytest.raises(RuntimeError, match="injected device failure"):
+            fut1.result(timeout=120)
+        out = fut2.result(timeout=120)  # queued work survived the failure
+        assert isinstance(out, list) and len(out) >= 1
+        assert b._thread.is_alive() and b._reader.is_alive()
+        assert global_injector.fired("engine.step") == 1
+    finally:
+        b.stop()
+
+
+@pytest.mark.slow
+def test_chaos_soak_probabilistic_step_failures():
+    """Soak (chaos lane only): every request resolves — result or the
+    injected error — under randomized dispatch failures, and the engine
+    stays serviceable afterwards."""
+    b = _tiny_batcher(n_slots=2)
+    b.start()
+    try:
+        with inject(
+            "engine.step", RuntimeError("soak fault"),
+            times=None, probability=0.3,
+        ):
+            futs = [
+                b.submit(GenRequest(
+                    prompt_ids=[3 + i, 4, 5], max_new_tokens=8, seed=i,
+                ))
+                for i in range(12)
+            ]
+            resolved = 0
+            for fut in futs:
+                try:
+                    assert isinstance(fut.result(timeout=180), list)
+                except RuntimeError as exc:
+                    assert "soak fault" in str(exc)
+                resolved += 1
+            assert resolved == 12
+        out = b.submit(
+            GenRequest(prompt_ids=[9, 9], max_new_tokens=4)
+        ).result(timeout=120)
+        assert isinstance(out, list)
+    finally:
+        b.stop()
+
+
+# ----------------------------- HTTP edge -------------------------------- #
+
+async def _request(port, method, path, body=None, headers=None):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    payload = json.dumps(body).encode() if body is not None else b""
+    extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
+    writer.write(
+        f"{method} {path} HTTP/1.1\r\nHost: t\r\n"
+        f"Content-Length: {len(payload)}\r\n{extra}"
+        f"Connection: close\r\n\r\n".encode() + payload
+    )
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    await writer.wait_closed()
+    head, _, body_bytes = raw.partition(b"\r\n\r\n")
+    status = int(head.split(b"\r\n")[0].split(b" ")[1])
+    return status, json.loads(body_bytes) if body_bytes else {}
+
+
+class _RaisingBackend(MockBackend):
+    def __init__(self, exc):
+        super().__init__()
+        self._exc = exc
+
+    async def generate(self, messages, tools=None, params=None):
+        raise self._exc
+
+
+@pytest.mark.asyncio
+async def test_http_shed_is_429_with_structured_error():
+    from pilottai_tpu.server import APIServer
+
+    h = _handler(_RaisingBackend(EngineOverloaded("queue depth 64 at limit")))
+    server = await APIServer(h).start()
+    try:
+        status, data = await _request(
+            server.port, "POST", "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "hi"}]},
+        )
+        assert status == 429
+        assert data["error"]["type"] == "overloaded_error"
+        assert "queue depth" in data["error"]["message"]
+    finally:
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_http_deadline_is_408_and_breaker_open_is_503():
+    from pilottai_tpu.server import APIServer
+
+    class Slow(MockBackend):
+        async def generate(self, messages, tools=None, params=None):
+            await asyncio.sleep(0.5)
+            return await super().generate(messages, tools, params)
+
+    h = _handler(
+        Slow(), retries=0, retry_delay=0.0,
+        breaker_failure_threshold=1, breaker_recovery_timeout=60.0,
+    )
+    server = await APIServer(h).start()
+    try:
+        # Deadline from the x-request-timeout header -> structured 408.
+        status, data = await _request(
+            server.port, "POST", "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "hi"}]},
+            headers={"x-request-timeout": "0.05"},
+        )
+        assert status == 408
+        assert data["error"]["type"] == "timeout_error"
+        # That deadline blowout opened the breaker (threshold 1):
+        # the next request fast-fails 503 with a retry_after hint.
+        status, data = await _request(
+            server.port, "POST", "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "hi"}]},
+        )
+        assert status == 503
+        assert data["error"]["type"] == "overloaded_error"
+        assert data["error"]["retry_after"] > 0
+    finally:
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_http_timeout_field_validation():
+    from pilottai_tpu.server import APIServer
+
+    server = await APIServer(_handler(MockBackend())).start()
+    try:
+        for bad in ("soon", -1, 0, True):
+            status, data = await _request(
+                server.port, "POST", "/v1/chat/completions",
+                {"messages": [{"role": "user", "content": "hi"}],
+                 "timeout": bad},
+            )
+            assert status == 400, bad
+        # A generous valid timeout: request completes normally.
+        status, data = await _request(
+            server.port, "POST", "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "hi"}],
+             "timeout": 30},
+        )
+        assert status == 200
+    finally:
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_http_task_timeout_is_408():
+    from pilottai_tpu.server import APIServer
+
+    class HangingServe:
+        async def execute_task(self, task, timeout=None):
+            await asyncio.wait_for(asyncio.sleep(60), timeout)
+
+    server = await APIServer(
+        _handler(MockBackend()), serve=HangingServe()
+    ).start()
+    try:
+        status, data = await _request(
+            server.port, "POST", "/v1/tasks",
+            {"task": "hangs forever", "timeout": 0.1},
+        )
+        assert status == 408
+        assert data["error"]["type"] == "timeout_error"
+    finally:
+        await server.stop()
+
+
+# ------------------------ orchestration chaos --------------------------- #
+
+def _worker(**cfg):
+    from pilottai_tpu.core.agent import BaseAgent
+
+    return BaseAgent(
+        config=AgentConfig(role="worker", **cfg),
+        llm=LLMHandler(LLMConfig(provider="mock")),
+    )
+
+
+@pytest.mark.asyncio
+async def test_heartbeat_stall_injection_degrades_health():
+    from pilottai_tpu.core.status import HealthStatus
+    from pilottai_tpu.orchestration.fault_tolerance import FaultTolerance
+    from pilottai_tpu.serve import Serve
+
+    agent = _worker()
+    await agent.start()
+    serve = Serve(name="chaos", agents=[agent])
+    ft = FaultTolerance(serve, FaultToleranceConfig(
+        heartbeat_timeout=60.0, max_recovery_attempts=0,
+    ))
+    ft.register_agent(agent)
+    assert (await ft.check_once())[agent.id] == HealthStatus.HEALTHY
+    # Inject a 120s stall: the agent LOOKS silent without being wedged.
+    global_injector.arm("agent.heartbeat.stall", value=120.0, times=1)
+    assert (await ft.check_once())[agent.id] == HealthStatus.UNHEALTHY
+    # Injection consumed -> next pass sees the real (fresh) heartbeat.
+    assert (await ft.check_once())[agent.id] == HealthStatus.HEALTHY
+    await agent.stop()
+
+
+@pytest.mark.asyncio
+async def test_health_gauge_keyed_by_full_id_and_reaped():
+    from pilottai_tpu.orchestration.fault_tolerance import FaultTolerance
+    from pilottai_tpu.serve import Serve
+
+    agent = _worker()
+    await agent.start()
+    serve = Serve(name="chaos", agents=[agent])
+    ft = FaultTolerance(serve, FaultToleranceConfig(max_recovery_attempts=0))
+    await ft.check_once()
+    gauges = global_metrics.snapshot()["gauges"]
+    assert f"fault.health.{agent.id}" in gauges  # full id, not id[:8]
+    assert f"fault.health.{agent.id[:8]}" not in gauges
+    # Agent leaves the pool -> record AND gauge reaped.
+    serve.agents.pop(agent.id)
+    await ft.check_once()
+    gauges = global_metrics.snapshot()["gauges"]
+    assert f"fault.health.{agent.id}" not in gauges
+    assert agent.id not in ft.health
+    await agent.stop()
+
+
+@pytest.mark.asyncio
+async def test_execute_task_timeout_threads_into_task_timeout():
+    from pilottai_tpu.serve import Serve
+
+    agent = _worker()
+    serve = Serve(
+        name="chaos", agents=[agent],
+        manager_llm=LLMHandler(LLMConfig(provider="mock")),
+        config=ServeConfig(max_concurrent_tasks=2),
+    )
+    await serve.start()
+    try:
+        result = await serve.execute_task("trivial thing", timeout=7.5)
+        assert result.success
+        task = next(
+            t for t in serve.all_tasks.values()
+            if t.description == "trivial thing"
+        )
+        assert task.timeout == 7.5  # agents see the caller's budget
+    finally:
+        await serve.stop()
+
+
+def test_journal_write_failure_degrades_not_crashes(tmp_path):
+    from pilottai_tpu.checkpoint.journal import TaskJournal
+    from pilottai_tpu.core.task import Task
+
+    journal = TaskJournal(tmp_path / "j.jsonl")
+    before = global_metrics.get("journal.write_failures")
+    global_injector.arm("checkpoint.write", OSError("disk full"), times=1)
+    journal.record_task(Task(description="survives injected disk failure"))
+    assert global_metrics.get("journal.write_failures") == before + 1
+    # Disk "recovers": subsequent records land and replay sees them.
+    t2 = Task(description="after recovery")
+    journal.record_task(t2)
+    journal.close()
+    assert t2.id in TaskJournal.replay(journal.path)
