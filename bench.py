@@ -121,36 +121,54 @@ async def bench_model(cfg, concurrency, steps, epochs, n_chips=1,
 
     runs = [await epoch() for _ in range(epochs)]
 
-    # Transport-independent truth (VERDICT r4 weak #2): one extra wave
-    # under jax.profiler — the device's own busy time per step can't be
-    # confused with tunnel weather. steps_per_sec_device_only is what
-    # co-located hardware would sustain if the device were the only
-    # bottleneck; busy_frac shows how much of the wall the tunnel ate.
+    # Transport-independent truth (VERDICT r4 weak #2, methodology fixed
+    # per VERDICT r5 next-step 2): a STEADY-STATE window under
+    # jax.profiler — the device's own busy time per step can't be
+    # confused with tunnel weather. One un-traced settle wave first (so
+    # first-wave admission, compile stragglers and the acceptance EMA
+    # never pollute the trace — r5's single isolated wave reported an
+    # internally impossible 104.9 device-only vs 146.3 wall), then the
+    # trace starts mid-epoch and spans ≥3 consecutive waves.
+    # steps_per_sec_device_only is what co-located hardware would
+    # sustain if the device were the only bottleneck; busy_frac shows
+    # how much of the window the tunnel ate.
+    PROFILE_WAVES = 3
     device = None
     if cfg.provider != "cpu":
         from pilottai_tpu.utils.device_profile import DeviceWindow
 
         try:
+            await asyncio.gather(  # settle wave — excluded from trace
+                *[one_step() for _ in range(concurrency)]
+            )
             win = DeviceWindow().start()
+            t0 = time.perf_counter()
             try:
-                await asyncio.gather(
-                    *[one_step() for _ in range(concurrency)]
-                )
+                for _ in range(PROFILE_WAVES):
+                    await asyncio.gather(
+                        *[one_step() for _ in range(concurrency)]
+                    )
             finally:
                 # The profiler trace is process-global: leaving it
                 # running after a failed wave breaks every later
                 # section's profiling.
+                window_wall = time.perf_counter() - t0
                 prof = win.stop()
+            profiled = PROFILE_WAVES * concurrency
             if prof["device_busy_s"] > 0:
                 device = {
                     "device_ms_per_step": round(
-                        prof["device_busy_s"] * 1000.0 / concurrency, 2
+                        prof["device_busy_s"] * 1000.0 / profiled, 2
                     ),
                     "steps_per_sec_device_only": round(
-                        concurrency / prof["device_busy_s"] / n_chips, 3
+                        profiled / prof["device_busy_s"] / n_chips, 3
                     ),
                     "device_busy_frac": round(prof["busy_frac"], 3),
-                    "profiled_steps": concurrency,
+                    "profiled_steps": profiled,
+                    "profiled_waves": PROFILE_WAVES,
+                    "profiled_window_steps_per_sec": round(
+                        profiled / window_wall / n_chips, 3
+                    ),
                 }
         except Exception as exc:  # noqa: BLE001 — profiling is best-effort
             _note("device profile FAILED", {"error": str(exc)})
@@ -163,6 +181,31 @@ async def bench_model(cfg, concurrency, steps, epochs, n_chips=1,
     latencies, wall = max(runs, key=lambda e: len(e[0]) / e[1])
     steps_per_sec = len(latencies) / wall / n_chips
     p50_ms = statistics.median(latencies) * 1000.0
+
+    # Internal-consistency check BEFORE the number is emitted (VERDICT
+    # r5 next-step 2): (a) the device can't be slower than the wall that
+    # includes transport — steps_per_sec_device_only ≥ the wall rate;
+    # (b) busy_frac × device-only rate must reproduce the profiled
+    # window's own wall rate within tolerance (they are the same window
+    # measured two ways). A violation means the profiled window was not
+    # steady-state — the r5 failure mode this check exists to catch.
+    if device is not None:
+        dev_rate = device["steps_per_sec_device_only"]
+        window_rate = device["profiled_window_steps_per_sec"]
+        product = device["device_busy_frac"] * dev_rate
+        rel_err = abs(product - window_rate) / max(window_rate, 1e-9)
+        device["device_consistency"] = {
+            "device_only_ge_wall": bool(dev_rate >= steps_per_sec * 0.98),
+            "busy_x_device_vs_window_rel_err": round(rel_err, 3),
+            "ok": bool(dev_rate >= steps_per_sec * 0.98 and rel_err <= 0.25),
+        }
+        _note(f"device consistency [{cfg.model_name}]", {
+            "steps_per_sec_device_only": dev_rate,
+            "steps_per_sec_per_chip": round(steps_per_sec, 3),
+            "busy_frac_x_device_only": round(product, 3),
+            "profiled_window_steps_per_sec": window_rate,
+            **device["device_consistency"],
+        })
     n_params = get_model_config(cfg.model_name).param_count()
     on_accel = cfg.provider != "cpu"
     decode_tok_s = len(latencies) * MAX_NEW_TOKENS / wall / n_chips
@@ -497,6 +540,17 @@ async def run_bench():
             **({sec_8b_8k["model"]: sec_8b_8k} if sec_8b_8k else {}),
         },
     }
+    # The driver captures the LAST 2,000 bytes of output: the
+    # orchestrator headline (pipeline/swarm success — or the error that
+    # replaced it when a section failed) must be the final keys or the
+    # big `models` dict truncates it away — the round-5 12/12 and 96/96
+    # claims were unverifiable from BENCH_r05.json for exactly this
+    # reason (VERDICT r5 next-step 3a).
+    for key in (
+        "pipeline_error", "swarm_error", "pipeline_success", "swarm_success",
+    ):
+        if key in out:
+            out[key] = out.pop(key)
     print(json.dumps(out))
 
 

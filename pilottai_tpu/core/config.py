@@ -152,6 +152,14 @@ class LLMConfig(BaseModel):
     engine_paged_kv: Optional[bool] = None
     engine_kv_pages: Optional[int] = None
     engine_page_size: int = Field(default=128, ge=8)
+    # Pages per paged-attention grid cell (the strip width of
+    # ops/pallas/paged_attention.py). The long-context decode path is
+    # grid-cell-latency bound (round-5 page A/B: 64→268, 128→243,
+    # 256→309 device ms/step — a per-cell launch/index floor), so wider
+    # strips amortize the per-cell overhead. None = autotune over
+    # {1, 2, 4, 8} at warmup on TPU (result cached alongside the compile
+    # cache); an explicit int forces it.
+    engine_page_strip: Optional[int] = Field(default=None, ge=1)
     # Speculative decoding: verify-blocks of N tokens per weight pass via
     # n-gram self-drafting (0 = off; >= 2 enables; dense KV only). Decode
     # is weight-stream-bound, so accepted drafts are nearly free tokens

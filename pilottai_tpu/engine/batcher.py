@@ -147,6 +147,8 @@ class ContinuousBatcher:
         paged: bool = False,
         page_size: int = 128,
         num_pages: Optional[int] = None,
+        page_strip: Optional[int] = None,  # pages per paged-kernel grid
+                                           # cell (None = autotune at warmup)
         json_tables: Optional[Tuple[Any, Any]] = None,
         speculate: int = 0,
         prefix_cache: int = 4,  # mirrors LLMConfig.engine_prefix_cache
@@ -294,6 +296,25 @@ class ContinuousBatcher:
             if usable < self.max_seq_len:
                 self.max_seq_len = usable
             self.max_pages_per_slot = -(-self.max_seq_len // page_size)
+        # Paged-kernel strip width: pages per grid cell
+        # (ops/pallas/paged_attention.py). The 8K decode path is
+        # grid-cell-latency bound, so the per-cell launch/index floor
+        # amortizes over the strip. None → warmup() times {1, 2, 4, 8}
+        # on the real pool and keeps the winner (persisted alongside the
+        # compile cache); until then a VMEM-safe default serves.
+        self._strip_autotune_pending = (
+            page_strip is None and paged and self.use_pallas and self.on_tpu
+        )
+        if paged:
+            if page_strip is not None:
+                self.page_strip = max(1, min(page_strip,
+                                             self.max_pages_per_slot))
+            elif self.use_pallas and self.on_tpu:
+                self.page_strip = self._max_safe_strip(4)
+            else:
+                self.page_strip = 1
+        else:
+            self.page_strip = 1
         # Chunked prefill (VERDICT r5 #6): long cold prompts admit in
         # page-aligned segments, one per device-loop cycle, so live
         # slots' decode chunks interleave instead of stalling behind one
@@ -414,6 +435,100 @@ class ContinuousBatcher:
                 slot.request.future.set_exception(RuntimeError("engine stopped"))
         self._slots = [None] * self.n_slots
 
+    def _max_safe_strip(self, want: int) -> int:
+        """Largest strip ≤ ``want`` whose double-buffered K/V blocks stay
+        within a conservative VMEM budget (the pipeline keeps two strips
+        in flight; blowing VMEM fails at compile time, mid-serving)."""
+        from pilottai_tpu.ops.pallas.paged_attention import strip_vmem_bytes
+
+        item = 1 if self.kv_quantize else jnp.dtype(self.cache_dtype).itemsize
+        budget = 8 * 1024 * 1024  # half of a v5e core's ~16 MB VMEM
+        strip = max(1, min(want, self.max_pages_per_slot))
+        while strip > 1 and strip_vmem_bytes(
+            strip, self.page_size, self.cfg.n_kv_heads, self.cfg.head_dim,
+            item, self.kv_quantize,
+        ) * 2 > budget:
+            strip //= 2
+        return strip
+
+    def _autotune_page_strip(self) -> None:
+        """Pick the paged-kernel strip width by timing the real kernel on
+        the real pool (device thread idle — called from warmup before the
+        compile sweep, so the decode ladder compiles against the winner).
+        The result persists alongside the XLA compile cache: a warm
+        restart reloads the strip its cached executables were built with
+        instead of re-timing and recompiling."""
+        from pilottai_tpu.utils.compile_cache import (
+            load_autotune,
+            store_autotune,
+        )
+        from pilottai_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention,
+        )
+
+        key = (
+            f"paged_strip:{self.cfg.name}:P{self.page_size}"
+            f":nb{self.max_pages_per_slot}:K{self.cfg.n_kv_heads}"
+            f":H{self.cfg.head_dim}:hd{self.cfg.n_heads}"
+            f":q{int(self.kv_quantize)}:B{self.n_slots}"
+        )
+        cached = load_autotune(key)
+        if cached is not None:
+            self.page_strip = self._max_safe_strip(int(cached))
+            self._log.info(
+                "paged strip %d (autotune cache)", self.page_strip
+            )
+            return
+        try:
+            n_blocks = self.max_pages_per_slot
+            B = self.n_slots
+            # Full-occupancy worst case: every slot at capacity, pages
+            # cycling over the real pool (contents are zeros — timing
+            # only; the kernel's work is shape-, not value-, dependent).
+            tbl = np.arange(B * n_blocks, dtype=np.int32).reshape(
+                B, n_blocks
+            ) % max(self.num_pages - 1, 1)
+            tbl_j = jnp.asarray(tbl)
+            last = jnp.full((B,), self.max_seq_len - 1, jnp.int32)
+            q = jnp.zeros(
+                (B, self.cfg.n_heads, self.cfg.head_dim), self.cfg.dtype
+            )
+            k_pool, v_pool = self.cache.layers[0]
+            sc = None if self.cache.scales is None else self.cache.scales[0]
+            candidates = sorted({
+                self._max_safe_strip(s) for s in (1, 2, 4, 8)
+            })
+            timings = {}
+            for strip in candidates:
+                def run(strip=strip):
+                    return paged_decode_attention(
+                        q, k_pool, v_pool, tbl_j, last,
+                        n_blocks=n_blocks, n_strip=strip,
+                        softcap=self.cfg.attn_softcap,
+                        k_scales=None if sc is None else sc[0],
+                        v_scales=None if sc is None else sc[1],
+                    )
+                jax.block_until_ready(run())  # compile outside the timer
+                reps = 10
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    out = run()
+                jax.block_until_ready(out)
+                timings[strip] = (time.perf_counter() - t0) / reps
+            best = min(timings, key=timings.get)
+            self.page_strip = best
+            store_autotune(key, best)
+            self._log.info(
+                "paged strip autotune: %s -> strip %d",
+                {s: f"{t * 1e3:.2f}ms" for s, t in sorted(timings.items())},
+                best,
+            )
+        except Exception as exc:  # noqa: BLE001 — tuning is best-effort
+            self._log.warning(
+                "paged strip autotune failed (%s); keeping strip %d",
+                exc, self.page_strip,
+            )
+
     def warmup(self, prompt_lens: Optional[Tuple[int, ...]] = None) -> None:
         """Compile the admission path for EVERY prefill bucket plus the
         decode chunk up front, so steady-state serving never waits on the
@@ -437,6 +552,11 @@ class ContinuousBatcher:
             ))
             if self.prefill_chunk and self.max_seq_len > cap:
                 prompt_lens = prompt_lens + (self.max_seq_len - 8,)
+        # Strip autotune BEFORE the sweep: the sweep compiles the decode
+        # ladder, and it must compile against the strip that will serve.
+        if self._strip_autotune_pending:
+            self._strip_autotune_pending = False
+            self._autotune_page_strip()
         self._warming = True
         try:
             for plen in prompt_lens:
@@ -1286,6 +1406,7 @@ class ContinuousBatcher:
                     json_tables=chunk_json, schema_tables=chunk_schema,
                     table=table,
                     use_pallas=self.paged and use_pallas_now,
+                    page_strip=self.page_strip,
                     draft_layers=self.draft_layers,
                     draft_mode=(
                         jnp.asarray(self._draft_on)
@@ -1299,6 +1420,7 @@ class ContinuousBatcher:
                         self.sampling, self.chunk_size, use_pallas_now,
                         prefix_bound=prefix_bound, table=table,
                         json_tables=chunk_json, schema_tables=chunk_schema,
+                        page_strip=self.page_strip,
                     )
                 )
         # Start the D2H transfer as soon as the chunk finishes computing,
@@ -1554,7 +1676,8 @@ class ContinuousBatcher:
             "pending": self._pending.qsize() + len(self._backlog),
             **(
                 {"kv_pages_free": self.alloc.free_pages,
-                 "kv_pages_total": self.num_pages - 1}
+                 "kv_pages_total": self.num_pages - 1,
+                 "page_strip": self.page_strip}
                 if self.alloc is not None else {}
             ),
             **(

@@ -241,7 +241,9 @@ def _combine_stats(acc_a, m_a, l_a, acc_b, m_b, l_b):
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "n_steps", "use_pallas", "prefix_bound"),
+    static_argnames=(
+        "cfg", "n_steps", "use_pallas", "prefix_bound", "page_strip",
+    ),
     donate_argnames=("cache", "dstate", "sampling"),
 )
 def decode_chunk(
@@ -258,6 +260,8 @@ def decode_chunk(
     schema_tables: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
     # ^ SchemaBank (ALLOWED, NEXT, MINCOST) — schema-constrained slots
     # ^ (token_bytes [Vt, L], token_len [Vt]) — subword JSON grammar mask
+    page_strip: int = 1,  # static — pages per paged-kernel grid cell
+                          # (autotuned by the batcher at warmup)
 ) -> Tuple[jax.Array, jax.Array, KVCache, DecodeState, SamplingState]:
     """Run ``n_steps`` decode steps for every slot in one dispatch.
 
@@ -357,31 +361,40 @@ def decode_chunk(
 
             qf = q[:, 0]                                  # [B, N, H]
             if paged and use_pallas:
-                acc_p, m_p, l_p = paged_decode_attention(
+                # One fused kernel invocation per layer: the page strip
+                # streams the prefix AND the final grid cell folds the
+                # chunk ring in (the separate per-layer ring dispatch +
+                # combine this path used to pay per step is gone) — the
+                # plain-decode stats contract allows it because the
+                # ring's validity is the shared scalar `i`.
+                acc_p, _, l_p = paged_decode_attention(
                     qf, layer_k, layer_v, table, prefix_last,
-                    q_positions=pos, n_blocks=n_blocks,
+                    q_positions=pos, n_blocks=n_blocks, n_strip=page_strip,
                     scale=qscale, softcap=cfg.attn_softcap, window=window,
                     k_scales=None if kv_scales is None else kv_scales[l][0],
                     v_scales=None if kv_scales is None else kv_scales[l][1],
+                    ring_k=rk, ring_v=rv, ring_step=i,
                 )
-            elif use_pallas and not paged:
-                acc_p, m_p, l_p = decode_attention(
-                    qf, layer_k, layer_v, prefix_last, q_positions=pos,
-                    scale=qscale, softcap=cfg.attn_softcap, window=window,
-                    return_stats=True,
-                )
+                attn = acc_p / jnp.maximum(l_p, 1e-30)[..., None]
             else:
-                acc_p, m_p, l_p = _prefix_stats_dense(
+                if use_pallas and not paged:
+                    acc_p, m_p, l_p = decode_attention(
+                        qf, layer_k, layer_v, prefix_last, q_positions=pos,
+                        scale=qscale, softcap=cfg.attn_softcap, window=window,
+                        return_stats=True,
+                    )
+                else:
+                    acc_p, m_p, l_p = _prefix_stats_dense(
+                        qf.reshape(B, cfg.n_kv_heads, G, cfg.head_dim),
+                        layer_k, layer_v, prefix_last, pos,
+                        qscale, cfg.attn_softcap, window,
+                        kv_scales=layer_sc,
+                    )
+                acc_c, m_c, l_c = _ring_stats(
                     qf.reshape(B, cfg.n_kv_heads, G, cfg.head_dim),
-                    layer_k, layer_v, prefix_last, pos,
-                    qscale, cfg.attn_softcap, window,
-                    kv_scales=layer_sc,
+                    rk, rv, i, qscale, cfg.attn_softcap, window,
                 )
-            acc_c, m_c, l_c = _ring_stats(
-                qf.reshape(B, cfg.n_kv_heads, G, cfg.head_dim),
-                rk, rv, i, qscale, cfg.attn_softcap, window,
-            )
-            attn = _combine_stats(acc_p, m_p, l_p, acc_c, m_c, l_c)
+                attn = _combine_stats(acc_p, m_p, l_p, acc_c, m_c, l_c)
 
             x = _layer_tail(
                 cfg, lp, x,
@@ -584,6 +597,7 @@ def _model_drafts(
                     paged_kernel["table"], last, q_positions=qpos,
                     n_blocks=paged_kernel["n_blocks"], scale=qscale,
                     softcap=cfg.attn_softcap, window=window,
+                    n_strip=paged_kernel["n_strip"],
                     k_scales=None if sc is None else sc[l][0],
                     v_scales=None if sc is None else sc[l][1],
                 )
@@ -792,7 +806,7 @@ def _spec_block_attn(
     jax.jit,
     static_argnames=(
         "cfg", "n_steps", "draft_len", "prefix_bound", "use_pallas",
-        "draft_layers",
+        "draft_layers", "page_strip",
     ),
     donate_argnames=("cache", "dstate", "sampling", "history"),
 )
@@ -815,6 +829,7 @@ def decode_chunk_spec(
     draft_mode: Optional[jax.Array] = None,  # [B] bool — slots whose
                                         # drafts come from the model
                                         # instead of the n-gram lookup
+    page_strip: int = 1,     # static — pages per paged-kernel grid cell
 ) -> Tuple[jax.Array, jax.Array, KVCache, DecodeState, SamplingState, jax.Array]:
     """Speculative fused chunk: ``n_steps`` verify-blocks of ``draft_len``
     tokens per dispatch. Same contract as ``decode_chunk`` except the
@@ -899,7 +914,7 @@ def decode_chunk_spec(
             # still n-gram-happy.
             pk_info = (
                 {"table": table, "n_blocks": n_blocks,
-                 "kv_scales": kv_scales}
+                 "kv_scales": kv_scales, "n_strip": page_strip}
                 if (paged and use_pallas) else None
             )
             mode = (
@@ -945,6 +960,7 @@ def decode_chunk_spec(
                     qg.reshape(B, cfg.n_kv_heads * G * D, cfg.head_dim),
                     layer_k, layer_v, table, prefix_last,
                     q_positions=pos, n_blocks=n_blocks, q_blocks=D,
+                    n_strip=page_strip,
                     scale=qscale, softcap=cfg.attn_softcap, window=window,
                     k_scales=None if kv_scales is None else kv_scales[l][0],
                     v_scales=None if kv_scales is None else kv_scales[l][1],

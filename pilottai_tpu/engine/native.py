@@ -222,6 +222,7 @@ class NativeEngine(LLMBackend):
             paged=paged,
             page_size=self.config.engine_page_size,
             num_pages=self.config.engine_kv_pages,
+            page_strip=self.config.engine_page_strip,
             json_tables=self._json_tables,
             speculate=self.config.engine_speculate,
             prefix_cache=self.config.engine_prefix_cache,

@@ -45,6 +45,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+from pilottai_tpu.parallel.mesh import compat_shard_map
+
 NEG_INF = -2.0**30
 
 
@@ -651,7 +653,7 @@ def flash_attention_sharded(
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
     head = head_axis if head_axis in mesh.axis_names else None
-    return jax.shard_map(
+    return compat_shard_map(
         fn,
         mesh=mesh,
         in_specs=(

@@ -3,24 +3,43 @@
 The paged cache (``ops/paged.py``) stores K/V in a shared page pool with
 block-table indirection; this kernel reads ONLY the pages a slot
 actually occupies. The trick is scalar-prefetched index maps: the block
-table lands in SMEM before the grid runs, and each (slot, page-slot)
-grid cell's BlockSpec *computes its pool coordinates from the table* —
-pages stream HBM→VMEM directly by id, no dense [B, S, H] gather ever
-exists.
+table lands in SMEM before the grid runs, and each grid cell's
+BlockSpecs *compute their pool coordinates from the table* — pages
+stream HBM→VMEM directly by id, no dense [B, S, H] gather ever exists.
 
-Grid is (B, bounded-page-count) with the page dim innermost; the
-(acc, m, l) online-softmax outputs map to the same block for every page
-step, so they stay VMEM-resident and accumulate across pages (the same
-revisited-output reduction the flash backward uses). Cells whose page
-slot is unallocated or fully past the valid length clamp their DMA to
-the scratch page and skip compute with ``pl.when``.
+Grid is (B, page-strip-count) with the strip dim innermost. Each cell
+processes a **strip of ``n_strip`` pages** (round-5 profiling: one page
+per cell left the 8K section grid-cell-latency bound — page A/B
+64→268, 128→243, 256→309 device ms/step showed a per-cell launch/index
+floor, not a bandwidth floor). The strip rides as ``n_strip`` replicated
+BlockSpecs over the same pool, each with its own scalar-prefetched index
+map, so one cell's prefetch wave covers N pages and the launch/index
+overhead amortizes N-fold. The (acc, m, l) online-softmax outputs map to
+the same block for every strip step, so they stay VMEM-resident and
+accumulate across the whole strip sequence (the same revisited-output
+reduction the flash backward uses). Pages that are unallocated, fully
+past the valid length, or padding past ``n_blocks`` clamp their DMA to
+the scratch page and skip compute with ``pl.when`` — page-for-page the
+math is identical to the single-page kernel, so strip results are
+bit-identical (pinned by tests/test_paged_strip.py).
 
-Returns unnormalized (acc, m, l) stats — the fused decode chunk
-(``engine/decode.py``) combines them with the in-chunk ring attention,
-same contract as ``decode_attention(return_stats=True)``.
+Optionally the **in-chunk ring attention fuses into the same
+invocation** (``ring_k``/``ring_v``/``ring_step``): the final grid cell
+runs the ring block and merges it with the page stats exactly like
+``engine/decode.py:_merge_stats``, eliminating the separate per-layer
+ring dispatch + combine the plain decode chunk used to pay per step.
+The speculative chunk keeps its separate passes (its block attention
+carries intra-block causal masking this kernel does not model — the
+stats contract does not allow the fusion there).
+
+Returns unnormalized (acc, m, l) stats — with the ring fused the caller
+only normalizes; without it the fused decode chunk combines them with
+the in-chunk ring attention, same contract as
+``decode_attention(return_stats=True)``.
 
 Design follows the ragged paged attention literature cited in PAPERS.md.
-No reference counterpart; VERDICT.md next-step 7.
+No reference counterpart; VERDICT r5 next-step 1 (amortize the paged
+kernel's grid-cell latency).
 """
 
 from __future__ import annotations
@@ -37,26 +56,46 @@ NEG_INF = -2.0**30
 
 
 def _paged_kernel(
-    table_ref,  # SMEM (B, max_pages) int32 (scalar prefetch)
-    last_ref,   # SMEM (B,) int32 — max valid key index per slot
-    qpos_ref,   # SMEM (B,) int32 — query absolute position (sliding window)
-    q_ref,      # VMEM (1, K, G, H)
-    k_ref,      # VMEM (K, 1, P, H) — one page, all kv heads
-    v_ref,      # VMEM (K, 1, P, H)
-    *rest,      # [ks_ref (K,1,P,1), vs_ref (K,1,P,1) when quantized,]
-                # acc_ref (1,K,G,H) f32, m_ref (1,K,G,1), l_ref (1,K,G,1)
+    *refs,
+    # refs layout (scalar prefetch first):
+    #   table_ref  SMEM (B, max_pages) int32
+    #   last_ref   SMEM (B,) int32 — max valid key index per slot
+    #   qpos_ref   SMEM (B,) int32 — query absolute position (window)
+    #   [rstep_ref SMEM (1,) int32 — valid ring rows - 1, when ring]
+    #   q_ref      VMEM (1, K, G, H)
+    #   k_refs × n_strip   VMEM (K, 1, P, H) — one page each
+    #   v_refs × n_strip   VMEM (K, 1, P, H)
+    #   [ks/vs_refs × n_strip  VMEM (K, 1, P, 1) when quantized]
+    #   [ringk_ref, ringv_ref  VMEM (1, K, R, H) when ring]
+    #   acc_ref (1, K, G, H) f32, m_ref (1, K, G, 1), l_ref (1, K, G, 1)
     scale: float,
     softcap: float,
     window: int,
     page_size: int,
     sentinel: int,
+    max_pages: int,
     q_blocks: int,
     quantized: bool,
+    n_strip: int,
+    n_blocks: int,
+    ring: bool,
 ):
+    it = iter(range(len(refs)))
+    table_ref, last_ref, qpos_ref = (refs[next(it)] for _ in range(3))
+    rstep_ref = refs[next(it)] if ring else None
+    q_ref = refs[next(it)]
+    k_refs = [refs[next(it)] for _ in range(n_strip)]
+    v_refs = [refs[next(it)] for _ in range(n_strip)]
     if quantized:
-        ks_ref, vs_ref, acc_ref, m_ref, l_ref = rest
+        ks_refs = [refs[next(it)] for _ in range(n_strip)]
+        vs_refs = [refs[next(it)] for _ in range(n_strip)]
     else:
-        acc_ref, m_ref, l_ref = rest
+        ks_refs = vs_refs = [None] * n_strip
+    if ring:
+        ringk_ref = refs[next(it)]
+        ringv_ref = refs[next(it)]
+    acc_ref, m_ref, l_ref = (refs[next(it)] for _ in range(3))
+
     b = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -68,20 +107,12 @@ def _paged_kernel(
 
     last = last_ref[b]
     qpos = qpos_ref[b]
-    page = table_ref[b, j]
-    j0 = j * page_size
-    live = (page != sentinel) & (j0 <= last)
-    if window > 0:
-        # Most-permissive query decides page liveness: (qpos_row - col) <
-        # window is EASIEST to satisfy at the smallest position, i.e.
-        # row d=0 at qpos — later rows only tighten, and the per-row
-        # mask below applies them exactly.
-        live &= (qpos - (j0 + page_size - 1)) < window
 
-    @pl.when(live)
-    def _attend():
-        q = q_ref[0]                                          # [K, G, H]
-        k = k_ref[:, 0]                                       # [K, P, H]
+    def _attend_page(k_ref, v_ref, ks_ref, vs_ref, j0):
+        """One page's online-softmax update — byte-identical math to the
+        pre-strip single-page kernel (the parity suite pins this)."""
+        q = q_ref[0]                                      # [K, G, H]
+        k = k_ref[:, 0]                                   # [K, P, H]
         v = v_ref[:, 0]
         if quantized:
             # In-VMEM dequant: the HBM→VMEM stream stays int8-sized.
@@ -94,7 +125,7 @@ def _paged_kernel(
             q, k,
             dimension_numbers=(((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        ) * scale                                             # [K, G, P]
+        ) * scale                                         # [K, G, P]
         if softcap > 0.0:
             s = jnp.tanh(s / softcap) * softcap
         col = j0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
@@ -109,10 +140,10 @@ def _paged_kernel(
             mask &= (qpos_row - col) < window
         s = jnp.where(mask, s, NEG_INF)
 
-        m_prev = m_ref[0, :, :, :]                            # [K, G, 1]
+        m_prev = m_ref[0, :, :, :]                        # [K, G, 1]
         l_prev = l_ref[0, :, :, :]
         acc_prev = acc_ref[0]
-        m_blk = jnp.max(s, axis=-1, keepdims=True)            # [K, G, 1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)        # [K, G, 1]
         m_new = jnp.maximum(m_prev, m_blk)
         p = jnp.where(m_new > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
         corr = jnp.where(
@@ -123,15 +154,82 @@ def _paged_kernel(
             p.astype(v.dtype), v,
             dimension_numbers=(((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        )                                                     # [K, G, H]
+        )                                                 # [K, G, H]
         acc_ref[0] = acc_prev * corr + pv
         m_ref[0, :, :, :] = m_new
+
+    # The strip: pages j*n_strip .. j*n_strip + n_strip - 1, in order —
+    # same visit order as the single-page grid, so accumulation order
+    # (and therefore every float) is unchanged. Dead strip elements
+    # (unallocated page, fully past `last`, outside the window, or
+    # padding past n_blocks) skip their update entirely.
+    for t in range(n_strip):
+        jt = j * n_strip + t
+        j0 = jt * page_size
+        page = table_ref[b, jnp.minimum(jt, max_pages - 1)]
+        live = (jt < n_blocks) & (page != sentinel) & (j0 <= last)
+        if window > 0:
+            # Most-permissive query decides page liveness: (qpos_row -
+            # col) < window is EASIEST to satisfy at the smallest
+            # position, i.e. row d=0 at qpos — later rows only tighten,
+            # and the per-row mask inside applies them exactly.
+            live &= (qpos - (j0 + page_size - 1)) < window
+
+        @pl.when(live)
+        def _attend(t=t, j0=j0):
+            _attend_page(k_refs[t], v_refs[t], ks_refs[t], vs_refs[t], j0)
+
+    if ring:
+        # Fused in-chunk ring attention: the LAST cell computes the ring
+        # block's own stats and merges them exactly like
+        # engine/decode.py:_merge_stats (ring row r sits at
+        # chunk-relative offset r; rows 0..step are valid — decode.py's
+        # _ring_stats contract). Row `step` is always live, so m_r is
+        # never NEG_INF.
+        @pl.when(j == pl.num_programs(1) - 1)
+        def _ring():
+            step = rstep_ref[0]
+            q = q_ref[0]                                  # [K, G, H]
+            rk = ringk_ref[0]                             # [K, R, H]
+            rv = ringv_ref[0]
+            s = jax.lax.dot_general(
+                q, rk,
+                dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            ) * scale                                     # [K, G, R]
+            if softcap > 0.0:
+                s = jnp.tanh(s / softcap) * softcap
+            r = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+            mask = r <= step
+            if window > 0:
+                mask &= (step - r) < window
+            s = jnp.where(mask, s, NEG_INF)
+            m_r = jnp.max(s, axis=-1, keepdims=True)      # [K, G, 1]
+            p = jnp.exp(s - m_r)
+            l_r = jnp.sum(p, axis=-1, keepdims=True)
+            acc_r = jax.lax.dot_general(
+                p.astype(rv.dtype), rv,
+                dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            m_prev = m_ref[0, :, :, :]
+            l_prev = l_ref[0, :, :, :]
+            acc_prev = acc_ref[0]
+            m_new = jnp.maximum(m_prev, m_r)
+            wa = jnp.where(
+                m_prev > NEG_INF / 2, jnp.exp(m_prev - m_new), 0.0
+            )
+            wb = jnp.where(m_r > NEG_INF / 2, jnp.exp(m_r - m_new), 0.0)
+            acc_ref[0] = acc_prev * wa + acc_r * wb
+            l_ref[0, :, :, :] = l_prev * wa + l_r * wb
+            m_ref[0, :, :, :] = m_new
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "n_blocks", "scale", "softcap", "window", "q_blocks", "interpret"
+        "n_blocks", "scale", "softcap", "window", "q_blocks", "n_strip",
+        "interpret",
     ),
 )
 def paged_decode_attention(
@@ -151,19 +249,31 @@ def paged_decode_attention(
     q_blocks: int = 1,   # static — queries per head row (speculation's D)
     k_scales: Optional[jax.Array] = None,  # [K, num_pages, P] — int8 pools
     v_scales: Optional[jax.Array] = None,
+    n_strip: int = 1,    # static — pages per grid cell (autotuned by the
+                         # batcher at warmup; amortizes per-cell latency)
+    ring_k: Optional[jax.Array] = None,  # [B, K, R, H] — fuse the chunk
+    ring_v: Optional[jax.Array] = None,  # ring into this invocation
+    ring_step: Optional[jax.Array] = None,  # scalar int32 — rows 0..step
+                                            # of the ring are valid
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Ragged paged GQA decode attention. Returns unnormalized
     ``(acc [B,N,H] fp32, m [B,N], l [B,N])`` online-softmax stats over
-    each slot's first ``n_blocks`` pages."""
+    each slot's first ``n_blocks`` pages, processed ``n_strip`` pages
+    per grid cell — plus the in-chunk ring when ``ring_k`` is given."""
     B, N, H = q.shape
     K, num_pages, P, _ = k_pool.shape
     assert N % K == 0
     G = N // K
     assert G % q_blocks == 0
-    assert 1 <= n_blocks <= table.shape[1]
+    max_pages = table.shape[1]
+    assert 1 <= n_blocks <= max_pages
     scale = scale if scale is not None else H ** -0.5
     sentinel = num_pages - 1
+    # A strip wider than the visit count just re-reads clamped pages for
+    # masked-off cells; clamp so the grid never carries dead DMA waves.
+    n_strip = max(1, min(n_strip, n_blocks))
+    n_cells = -(-n_blocks // n_strip)
 
     qg = q.reshape(B, K, G, H)
     last_valid = jnp.asarray(last_valid, jnp.int32).reshape(B)
@@ -174,39 +284,64 @@ def paged_decode_attention(
 
     quantized = k_scales is not None
     assert (k_scales is None) == (v_scales is None)
+    ring = ring_k is not None
+    if ring:
+        assert ring_v is not None and ring_step is not None
+        assert q_blocks == 1, "ring fusion is the plain-decode contract"
     kernel = functools.partial(
         _paged_kernel,
         scale=scale, softcap=softcap, window=window,
-        page_size=P, sentinel=sentinel, q_blocks=q_blocks,
-        quantized=quantized,
+        page_size=P, sentinel=sentinel, max_pages=max_pages,
+        q_blocks=q_blocks, quantized=quantized,
+        n_strip=n_strip, n_blocks=n_blocks, ring=ring,
     )
 
-    def page_map(b, j, table_ref, last_ref, qpos_ref):
-        # Clamp sentinel to a real page id: the DMA must target valid
-        # memory; the kernel's `live` predicate skips the compute.
-        return (0, jnp.minimum(table_ref[b, j], sentinel), 0, 0)
+    def page_map(t):
+        # Strip element t of cell j covers logical page slot
+        # j*n_strip + t. Clamp twice: the slot index to the table width
+        # (padding cells past n_blocks) and the sentinel to a real page
+        # id (the DMA must target valid memory); the kernel's `live`
+        # predicate skips the compute either way.
+        def _map(b, j, table_ref, *_):
+            jt = jnp.minimum(j * n_strip + t, max_pages - 1)
+            return (0, jnp.minimum(table_ref[b, jt], sentinel), 0, 0)
+        return _map
 
-    in_specs = [
-        pl.BlockSpec((1, K, G, H), lambda b, j, *_: (b, 0, 0, 0)),
-        pl.BlockSpec((K, 1, P, H), page_map),
-        pl.BlockSpec((K, 1, P, H), page_map),
-    ]
-    operands = [qg, k_pool, v_pool]
+    in_specs = [pl.BlockSpec((1, K, G, H), lambda b, j, *_: (b, 0, 0, 0))]
+    operands = [qg]
+    # The strip rides as n_strip replicated pool operands, one
+    # scalar-prefetched index map each: one grid cell's prefetch wave
+    # fetches the whole strip.
+    in_specs += [pl.BlockSpec((K, 1, P, H), page_map(t)) for t in range(n_strip)]
+    operands += [k_pool] * n_strip
+    in_specs += [pl.BlockSpec((K, 1, P, H), page_map(t)) for t in range(n_strip)]
+    operands += [v_pool] * n_strip
     if quantized:
         # Trailing singleton: TPU lowering requires the last two block
         # dims be (8k, 128k) or equal the array dims — (P, 1) qualifies.
+        ks_op = k_scales.astype(jnp.float32)[..., None]
+        vs_op = v_scales.astype(jnp.float32)[..., None]
         in_specs += [
-            pl.BlockSpec((K, 1, P, 1), page_map),
-            pl.BlockSpec((K, 1, P, 1), page_map),
+            pl.BlockSpec((K, 1, P, 1), page_map(t)) for t in range(n_strip)
         ]
-        operands += [
-            k_scales.astype(jnp.float32)[..., None],
-            v_scales.astype(jnp.float32)[..., None],
+        operands += [ks_op] * n_strip
+        in_specs += [
+            pl.BlockSpec((K, 1, P, 1), page_map(t)) for t in range(n_strip)
         ]
+        operands += [vs_op] * n_strip
+    scalars = [table, last_valid, q_positions]
+    if ring:
+        R = ring_k.shape[2]
+        scalars.append(jnp.asarray(ring_step, jnp.int32).reshape(1))
+        in_specs += [
+            pl.BlockSpec((1, K, R, H), lambda b, j, *_: (b, 0, 0, 0)),
+            pl.BlockSpec((1, K, R, H), lambda b, j, *_: (b, 0, 0, 0)),
+        ]
+        operands += [ring_k, ring_v]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,  # table, last, qpos in SMEM
-        grid=(B, n_blocks),
+        num_scalar_prefetch=len(scalars),  # table, last, qpos[, step]
+        grid=(B, n_cells),
         in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, K, G, H), lambda b, j, *_: (b, 0, 0, 0)),
@@ -223,8 +358,20 @@ def paged_decode_attention(
             jax.ShapeDtypeStruct((B, K, G, 1), jnp.float32),
         ),
         interpret=interpret,
-    )(table, last_valid, q_positions, *operands)
+    )(*scalars, *operands)
     return acc.reshape(B, N, H), m.reshape(B, N), l.reshape(B, N)
 
 
-__all__ = ["paged_decode_attention"]
+def strip_vmem_bytes(
+    n_strip: int, page_size: int, n_kv_heads: int, head_dim: int,
+    itemsize: int, quantized: bool,
+) -> int:
+    """Estimated VMEM the strip's K/V blocks pin per pipeline stage —
+    the batcher's autotuner rejects candidates whose double-buffered
+    strip would crowd the ~16 MB VMEM budget."""
+    kv = 2 * n_kv_heads * page_size * head_dim * itemsize
+    sc = 2 * n_kv_heads * page_size * 4 if quantized else 0
+    return n_strip * (kv + sc)
+
+
+__all__ = ["paged_decode_attention", "strip_vmem_bytes"]

@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from pilottai_tpu.parallel.mesh import compat_shard_map
+
 
 def pipeline_apply(
     block_fn: Callable[[Any, jax.Array], jax.Array],
@@ -83,7 +85,7 @@ def pipeline_apply(
         )
         return out
 
-    return jax.shard_map(
+    return compat_shard_map(
         per_stage,
         mesh=mesh,
         in_specs=(param_specs, x_spec),

@@ -26,7 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pilottai_tpu.models.common import ModelConfig, init_params, param_logical_axes
 from pilottai_tpu.models.transformer import forward_train
-from pilottai_tpu.parallel.mesh import create_mesh
+from pilottai_tpu.parallel.mesh import compat_set_mesh, create_mesh
 from pilottai_tpu.parallel.sharding import (
     logical_to_spec,
     shard_params,
@@ -117,6 +117,7 @@ class Trainer:
         self.optimizer = make_optimizer(self.train_cfg)
         self._param_axes = param_logical_axes(model_cfg)
         self._param_specs = spec_tree_for(self._param_axes, rules)
+        self._opt_shardings_tree = None
         self._step = self._build_step()
 
     # ------------------------------------------------------------- #
@@ -141,8 +142,16 @@ class Trainer:
             opt_state = self.optimizer.init(params)
             return params, opt_state
 
-        with jax.set_mesh(self.mesh):
-            return jax.jit(_init)(rng)
+        # Pin the opt-state layout here AND on the train step's outputs:
+        # leaving it unspecified lets the two jitted programs pick
+        # different layouts, and the step's donated state then fails
+        # aliasing at dispatch (jax 0.4.x rejects it; newer jax silently
+        # copies — either way the donation is lost).
+        with compat_set_mesh(self.mesh):
+            return jax.jit(
+                _init,
+                out_shardings=(param_shardings, self._opt_shardings()),
+            )(rng)
 
     # ------------------------------------------------------------- #
     # Train step
@@ -209,23 +218,52 @@ class Trainer:
             train_step,
             in_shardings=(
                 param_shardings,
-                None,  # opt_state: inherit placement from init
+                self._opt_shardings(),  # must match init's output layout
                 NamedSharding(self.mesh, batch_spec),
                 NamedSharding(self.mesh, valid_spec),
                 NamedSharding(self.mesh, valid_spec),  # loss_start
             ),
-            # Pin output params to the same placement as the inputs so the
-            # state round-trips through step() without resharding.
-            out_shardings=(param_shardings, None, None),
+            # Pin output params AND opt state to the same placement as
+            # the inputs so the donated state aliases cleanly and
+            # round-trips through step() without resharding.
+            out_shardings=(param_shardings, self._opt_shardings(), None),
             donate_argnums=(0, 1),
         )
+
+    def _opt_shardings(self):
+        """NamedShardings for the optimizer state: moment leaves mirror
+        their parameters' shardings (``optax.tree_map_params`` walks the
+        state's param-shaped subtrees), everything else — step counts,
+        empty states — replicates. One tree shared by ``init`` and the
+        train step keeps the donated state's layout bit-stable across
+        both programs."""
+        if self._opt_shardings_tree is None:
+            param_shardings = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s), self._param_specs
+            )
+            shapes = jax.eval_shape(
+                lambda r: init_params(
+                    self.model_cfg, r, dtype=self.train_cfg.param_dtype
+                ),
+                jax.random.key(0),
+            )
+            opt_shape = jax.eval_shape(self.optimizer.init, shapes)
+            repl = NamedSharding(self.mesh, P())
+            self._opt_shardings_tree = optax.tree_map_params(
+                self.optimizer,
+                lambda _leaf, sharding: sharding,
+                opt_shape,
+                param_shardings,
+                transform_non_params=lambda _leaf: repl,
+            )
+        return self._opt_shardings_tree
 
     def step(
         self, state: Tuple[Any, Any], batch: Dict[str, jax.Array]
     ) -> Tuple[Tuple[Any, Any], Dict[str, jax.Array]]:
         params, opt_state = state
         tokens, valid, loss_start = self.shard_batch(batch)
-        with jax.set_mesh(self.mesh):
+        with compat_set_mesh(self.mesh):
             params, opt_state, metrics = self._step(
                 params, opt_state, tokens, valid, loss_start
             )

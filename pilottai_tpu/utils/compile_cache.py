@@ -108,5 +108,53 @@ def cache_hits() -> int:
     return int(global_metrics.get(HIT_METRIC) or 0)
 
 
+# --------------------------------------------------------------------- #
+# Autotune results live NEXT TO the compiled executables: both are
+# warm-restart state keyed by program shape, and a FaultTolerance respawn
+# that reloads executables from here should reload the strip choice the
+# executables were compiled WITH (re-timing would risk picking a different
+# strip and recompiling the whole decode ladder it just restored).
+# --------------------------------------------------------------------- #
+
+_AUTOTUNE_FILE = "autotune.json"
+
+
+def _autotune_path() -> Path:
+    return Path(_enabled_dir or default_cache_dir()) / _AUTOTUNE_FILE
+
+
+def load_autotune(key: str) -> Optional[int]:
+    """Best-effort read of a previously tuned integer for ``key``."""
+    try:
+        import json
+
+        data = json.loads(_autotune_path().read_text())
+        val = data.get(key)
+        return int(val) if val is not None else None
+    except Exception:  # noqa: BLE001 — a missing/corrupt cache just re-tunes
+        return None
+
+
+def store_autotune(key: str, value: int) -> None:
+    """Best-effort persist of a tuned integer under ``key``."""
+    try:
+        import json
+
+        path = _autotune_path()
+        path.parent.mkdir(parents=True, exist_ok=True)
+        try:
+            data = json.loads(path.read_text())
+        except Exception:  # noqa: BLE001 — start fresh on absence/corruption
+            data = {}
+        data[key] = int(value)
+        tmp = path.with_suffix(f".tmp{os.getpid()}")
+        tmp.write_text(json.dumps(data, indent=2, sort_keys=True))
+        tmp.replace(path)
+    except Exception as exc:  # noqa: BLE001 — tuning cache is an optimization
+        get_logger("utils.compile_cache").warning(
+            "autotune cache write failed: %s", exc
+        )
+
+
 __all__ = ["enable_compilation_cache", "cache_hits", "default_cache_dir",
-           "HIT_METRIC"]
+           "load_autotune", "store_autotune", "HIT_METRIC"]

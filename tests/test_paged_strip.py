@@ -1,0 +1,314 @@
+"""Multi-page strip parity for the paged-attention kernel
+(ops/pallas/paged_attention.py, VERDICT r5 next-step 1).
+
+The strip kernel visits pages in the same order and runs byte-identical
+per-page math as the single-page grid (``n_strip=1`` — the pre-strip
+kernel); regrouping pages into strips only changes how many a grid cell
+covers. These tests pin that claim bit-for-bit across page sizes, strip
+widths, int8-quantized pools, sliding windows, ragged slot lengths, and
+the unallocated-page / partial-final-page edge cells — plus the
+fused-ring variant against the separate ring-pass + merge it replaces.
+
+``n_strip=1`` itself stays pinned against the dense gather oracle by
+tests/test_paged.py, so the chain is strip == single-page == dense.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pilottai_tpu.engine.decode import (
+    _combine_stats,
+    _prefix_stats_dense,
+    _ring_stats,
+)
+from pilottai_tpu.ops.kvcache import quantize_kv
+from pilottai_tpu.ops.paged import PageAllocator, gather_pages
+from pilottai_tpu.ops.pallas.paged_attention import (
+    paged_decode_attention,
+    strip_vmem_bytes,
+)
+
+B, K, H = 4, 2, 64
+MAX_PAGES = 4
+
+
+def _ragged_lengths(P):
+    """One of each edge case: partial final page, exactly one full page
+    (page slots 1..3 unallocated), empty slot (whole table sentinel),
+    one-past-a-page-boundary partial."""
+    return (2 * P + P // 2 + 3, P, 0, 3 * P + 1)
+
+
+def _mk_pool(rng, P, quantized=False):
+    lengths = _ragged_lengths(P)
+    num_pages = B * MAX_PAGES + 1
+    alloc = PageAllocator(num_pages, P, B, max_pages_per_slot=MAX_PAGES)
+    k_pool = np.zeros((K, num_pages, P, H), np.float32)
+    v_pool = np.zeros((K, num_pages, P, H), np.float32)
+    for b, ln in enumerate(lengths):
+        if ln == 0:
+            continue
+        assert alloc.allocate(b, ln)
+        for j in range(alloc.pages_needed(ln)):
+            pg = alloc.table[b, j]
+            k_pool[:, pg] = rng.normal(size=(K, P, H))
+            v_pool[:, pg] = rng.normal(size=(K, P, H))
+    k_pool = jnp.asarray(k_pool)
+    v_pool = jnp.asarray(v_pool)
+    scales = None
+    if quantized:
+        k_pool, ksc = quantize_kv(k_pool)
+        v_pool, vsc = quantize_kv(v_pool)
+        scales = (ksc, vsc)
+    return (
+        k_pool, v_pool, scales, jnp.asarray(alloc.table),
+        jnp.asarray(lengths, jnp.int32),
+    )
+
+
+def _run(q, pool, n_strip, window=0, softcap=0.0, q_blocks=1, **kw):
+    k_pool, v_pool, scales, table, lengths = pool
+    return paged_decode_attention(
+        q, k_pool, v_pool, table, lengths - 1, q_positions=lengths,
+        n_blocks=MAX_PAGES, scale=H ** -0.5, softcap=softcap,
+        window=window, q_blocks=q_blocks, n_strip=n_strip,
+        k_scales=None if scales is None else scales[0],
+        v_scales=None if scales is None else scales[1],
+        interpret=True, **kw,
+    )
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+@pytest.mark.parametrize("window_frac", [0, 1])
+def test_strip_matches_single_page_bitwise(quantized, window_frac):
+    """Every strip width returns BYTE-identical (acc, m, l) to the
+    single-page grid — including strip 3 (n_blocks=4 is not a multiple:
+    the padded final cell must contribute nothing)."""
+    P = 64
+    rng = np.random.default_rng(0)
+    pool = _mk_pool(rng, P, quantized=quantized)
+    q = jnp.asarray(rng.normal(size=(B, 4, H)), jnp.float32)
+    window = (P + P // 2 + 5) * window_frac
+    base = _run(q, pool, n_strip=1, window=window, softcap=30.0)
+    for strip in (2, 3, 4, 8):
+        got = _run(q, pool, n_strip=strip, window=window, softcap=30.0)
+        for name, a, b in zip("acc m l".split(), base, got):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg=f"P={P} strip={strip} stat={name}",
+            )
+
+
+@pytest.mark.parametrize("P", [128, 256])
+def test_strip_large_pages_bitwise(P):
+    """The serving page sizes (128 and 256), one representative config
+    each — int8 pool + sliding window, the full-feature cell — so the
+    {64, 128, 256} page-size axis stays covered without the full
+    cross-product's interpret-mode cost (that runs at P=64 above)."""
+    rng = np.random.default_rng(6)
+    pool = _mk_pool(rng, P, quantized=True)
+    q = jnp.asarray(rng.normal(size=(B, 4, H)), jnp.float32)
+    base = _run(q, pool, n_strip=1, window=P + P // 2 + 5, softcap=30.0)
+    for strip in (2, 3, 8):
+        got = _run(q, pool, n_strip=strip, window=P + P // 2 + 5,
+                   softcap=30.0)
+        for a, b in zip(base, got):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("P", [64, 128])
+def test_strip_q_blocks_matches_single_page_bitwise(P):
+    """The speculative shape (D packed queries per head row, per-row
+    window offsets) under strips == the single-page grid, bit for bit."""
+    rng = np.random.default_rng(1)
+    D, G = 3, 2
+    pool = _mk_pool(rng, P)
+    q = jnp.asarray(rng.normal(size=(B, K * G * D, H)), jnp.float32)
+    base = _run(q, pool, n_strip=1, window=P + 7, q_blocks=D)
+    for strip in (2, 4):
+        got = _run(q, pool, n_strip=strip, window=P + 7, q_blocks=D)
+        for a, b in zip(base, got):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_strip_empty_and_unallocated_rows_stay_empty():
+    """The length-0 slot (whole table sentinel) and slots whose table has
+    sentinel page slots past their allocation must produce l == 0 /
+    untouched stats exactly like the single-page kernel."""
+    rng = np.random.default_rng(2)
+    pool = _mk_pool(rng, 64)
+    lengths = np.asarray(pool[4])
+    q = jnp.asarray(rng.normal(size=(B, 4, H)), jnp.float32)
+    _, _, l = _run(q, pool, n_strip=4)
+    assert float(np.asarray(l)[lengths == 0].max(initial=0.0)) == 0.0
+    # Live rows match the dense oracle (strip == single page == dense).
+    acc, m, l = _run(q, pool, n_strip=4)
+    k_pool, v_pool, _, table, lens = pool
+    acc_r, m_r, l_r = _prefix_stats_dense(
+        q.reshape(B, K, 2, H),
+        gather_pages(k_pool, table, MAX_PAGES),
+        gather_pages(v_pool, table, MAX_PAGES),
+        lens - 1, lens, H ** -0.5, 0.0, 0,
+    )
+    live = lengths > 0
+    np.testing.assert_allclose(
+        np.asarray(acc)[live], np.asarray(acc_r)[live],
+        rtol=2e-4, atol=2e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(l)[live], np.asarray(l_r)[live], rtol=1e-5
+    )
+
+
+@pytest.mark.parametrize("window", [0, 40])
+@pytest.mark.parametrize("step", [0, 3, 7])
+def test_fused_ring_matches_separate_merge(window, step):
+    """The fused in-chunk ring (final grid cell) must reproduce the
+    separate ring pass + ``_merge_stats`` combine that the plain decode
+    chunk used to dispatch per layer — the exact contract
+    ``engine/decode.py`` now relies on."""
+    rng = np.random.default_rng(3)
+    pool = _mk_pool(rng, 64)
+    G = 2
+    q = jnp.asarray(rng.normal(size=(B, K * G, H)), jnp.float32)
+    R = 8
+    rk = jnp.asarray(rng.normal(size=(B, K, R, H)), jnp.float32)
+    rv = jnp.asarray(rng.normal(size=(B, K, R, H)), jnp.float32)
+    for strip in (1, 2, 4):
+        acc, m, l = _run(
+            q, pool, n_strip=strip,
+            window=window, ring_k=rk, ring_v=rv,
+            ring_step=jnp.int32(step),
+        )
+        fused = np.asarray(acc) / np.maximum(np.asarray(l), 1e-30)[..., None]
+        acc_p, m_p, l_p = _run(q, pool, n_strip=strip, window=window)
+        acc_c, m_c, l_c = _ring_stats(
+            q.reshape(B, K, G, H), rk, rv, jnp.int32(step),
+            H ** -0.5, 0.0, window,
+        )
+        ref = np.asarray(
+            _combine_stats(acc_p, m_p, l_p, acc_c, m_c, l_c)
+        )
+        np.testing.assert_allclose(fused, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_ring_identical_across_strips():
+    """Strip width must not change the fused result at all (pages merge
+    before the ring in every variant)."""
+    rng = np.random.default_rng(4)
+    pool = _mk_pool(rng, 64, quantized=True)
+    q = jnp.asarray(rng.normal(size=(B, 4, H)), jnp.float32)
+    R = 6
+    rk = jnp.asarray(rng.normal(size=(B, K, R, H)), jnp.float32)
+    rv = jnp.asarray(rng.normal(size=(B, K, R, H)), jnp.float32)
+    kw = dict(ring_k=rk, ring_v=rv, ring_step=jnp.int32(2))
+    base = _run(q, pool, n_strip=1, **kw)
+    for strip in (2, 4):
+        got = _run(q, pool, n_strip=strip, **kw)
+        for a, b in zip(base, got):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_strip_wider_than_blocks_clamps():
+    """A strip wider than the visit count clamps instead of reading
+    garbage (the batcher may autotune 8 on a 4-page bound)."""
+    rng = np.random.default_rng(5)
+    pool = _mk_pool(rng, 64)
+    q = jnp.asarray(rng.normal(size=(B, 4, H)), jnp.float32)
+    base = _run(q, pool, n_strip=1)
+    got = _run(q, pool, n_strip=16)
+    for a, b in zip(base, got):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fused_decode_chunk_matches_xla_fallback(monkeypatch):
+    """End-to-end wiring of the fused path through ``decode_chunk``:
+    paged + Pallas (kernel routed through interpret mode, strip 2,
+    ring_step threaded from the while_loop carry) must emit the same
+    greedy tokens as the XLA gather fallback — the cross-backend pin
+    the engine's long-context path rests on."""
+    import functools
+
+    import jax
+
+    import pilottai_tpu.engine.decode as dec
+    from pilottai_tpu.engine.decode import (
+        DecodeState,
+        admit_group,
+        decode_chunk,
+    )
+    from pilottai_tpu.engine.sampling import SamplingState
+    from pilottai_tpu.models.common import init_params
+    from pilottai_tpu.models.registry import get_model_config
+    from pilottai_tpu.ops.paged import PagedKVCache
+
+    cfg = get_model_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    Bs, S, A, T, P = 4, 128, 4, 64, 32
+    rng = np.random.default_rng(0)
+    lens = np.array([17, 33, 0, 0], np.int32)
+    tokens = np.zeros((A, T), np.int32)
+    for i in range(2):
+        tokens[i, : lens[i]] = rng.integers(2, cfg.vocab_size, lens[i])
+    slots = jnp.asarray([0, 2, Bs, Bs], jnp.int32)
+    positions = jnp.broadcast_to(
+        jnp.arange(T, dtype=jnp.int32)[None], (A, T)
+    )
+    base_args = (
+        jnp.asarray(tokens), positions, jnp.asarray(lens), slots,
+        jnp.zeros((A,), jnp.float32), jnp.zeros(A, jnp.int32),
+        jnp.ones(A), jnp.arange(10, 10 + A, dtype=jnp.int32),
+        jnp.full((A,), -1, jnp.int32), jnp.zeros((A,), bool),
+        jnp.asarray([12, 12, 0, 0], jnp.int32),
+    )
+
+    def admit():
+        alloc = PageAllocator(4 * Bs + 1, P, Bs, max_pages_per_slot=S // P)
+        for row, slot in enumerate([0, 2]):
+            assert alloc.allocate(slot, int(lens[row]) + 13)
+        pr = np.full((A, S // P), alloc.sentinel, np.int32)
+        pr[0] = alloc.table[0]
+        pr[1] = alloc.table[2]
+        cache = PagedKVCache.create(
+            cfg.n_layers, Bs, 4 * Bs + 1, P, cfg.n_kv_heads, cfg.head_dim,
+            dtype=jnp.float32,
+        )
+        out = admit_group(
+            params, cfg, cache, DecodeState.create(Bs),
+            SamplingState.create(Bs), *base_args, use_flash=False,
+            page_rows=jnp.asarray(pr),
+        )
+        return out, jnp.asarray(alloc.table)
+
+    (c, d, s, first_a, _), table = admit()
+    ref = []
+    for _ in range(2):
+        t_, v_, c, d, s = decode_chunk(
+            params, cfg, c, d, s, 8, use_pallas=False, table=table
+        )
+        ref.append((np.asarray(t_), np.asarray(v_)))
+
+    monkeypatch.setattr(
+        dec, "paged_decode_attention",
+        functools.partial(dec.paged_decode_attention, interpret=True),
+    )
+    (c, d, s, first_b, _), table = admit()
+    np.testing.assert_array_equal(np.asarray(first_a), np.asarray(first_b))
+    for i in range(2):
+        t_, v_, c, d, s = decode_chunk(
+            params, cfg, c, d, s, 8, use_pallas=True, table=table,
+            page_strip=2,
+        )
+        np.testing.assert_array_equal(ref[i][1], np.asarray(v_))
+        np.testing.assert_array_equal(ref[i][0], np.asarray(t_))
+
+
+def test_strip_vmem_estimate_monotone():
+    """The autotuner's VMEM guard: estimates grow with strip width and
+    count the scale planes only when quantized."""
+    a = strip_vmem_bytes(2, 128, 8, 128, 2, False)
+    b = strip_vmem_bytes(4, 128, 8, 128, 2, False)
+    c = strip_vmem_bytes(4, 128, 8, 128, 1, True)
+    assert b == 2 * a
+    assert c > strip_vmem_bytes(4, 128, 8, 128, 1, False)
