@@ -87,6 +87,13 @@ async def bench_model(cfg, concurrency, steps, epochs, n_chips=1,
     from pilottai_tpu.models.registry import get_model_config
 
     handler = LLMHandler(cfg)
+    # Section-pure phase percentiles: drop the previous section's
+    # request-phase samples so the `phases` block below describes ONLY
+    # this section's traffic (counts and windows included).
+    from pilottai_tpu.utils.metrics import global_metrics as _gm
+
+    _gm.reset_histograms("request.")
+    _gm.reset_histograms("engine.prefill_latency")
     params = GenerationParams(max_new_tokens=MAX_NEW_TOKENS, temperature=0.0)
     uid = [0]
 
@@ -173,6 +180,14 @@ async def bench_model(cfg, concurrency, steps, epochs, n_chips=1,
         except Exception as exc:  # noqa: BLE001 — profiling is best-effort
             _note("device profile FAILED", {"error": str(exc)})
 
+    # Per-phase breakdown (queue wait / prefill / TTFT / TPOT / ITL
+    # percentiles) from the flight-recorder histograms, captured while
+    # this section's samples are still the recent window — future perf
+    # PRs get a phase-attributed trajectory, not just aggregate rates.
+    from pilottai_tpu.obs import phase_summary
+
+    phases = phase_summary()
+
     await handler.stop()
     del handler
     gc.collect()
@@ -226,6 +241,9 @@ async def bench_model(cfg, concurrency, steps, epochs, n_chips=1,
         "paged": bool(cfg.engine_paged_kv),
         "kv_quantize": cfg.engine_kv_quantize,
         "epoch_steps_per_sec": epoch_rates,
+        # Section-pure: the request-phase histograms were reset at this
+        # section's start, so counts and percentiles cover only it.
+        "phases": phases,
         **(device or {}),
     }
 
@@ -374,6 +392,7 @@ def _note(tag, payload):
 
 async def run_bench():
     from pilottai_tpu.core.config import LLMConfig
+    from pilottai_tpu.obs import phase_summary
 
     platform = jax.default_backend()
     on_accel = platform not in ("cpu",)
@@ -531,6 +550,10 @@ async def run_bench():
         ),
         **sec_pipeline,
         **(sec_swarm or {}),
+        # Orchestrator-path phase percentiles: traffic since the last
+        # engine section's reset — i.e. the pipeline + swarm sections
+        # (per engine-section values live under models.*.phases).
+        "phases": phase_summary(),
         "provider": "tpu" if on_accel else "cpu",
         "n_chips": n_chips,
         "models": {

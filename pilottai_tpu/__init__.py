@@ -61,6 +61,15 @@ _LAZY = {
     "EngineOverloaded": ("pilottai_tpu.reliability", "EngineOverloaded"),
     "FaultInjector": ("pilottai_tpu.reliability", "FaultInjector"),
     "global_injector": ("pilottai_tpu.reliability", "global_injector"),
+    # Observability surface (pilottai_tpu/obs — docs/OBSERVABILITY.md).
+    "FlightRecorder": ("pilottai_tpu.obs", "FlightRecorder"),
+    "global_flight": ("pilottai_tpu.obs", "global_flight"),
+    "global_steps": ("pilottai_tpu.obs", "global_steps"),
+    "global_blackbox": ("pilottai_tpu.obs", "global_blackbox"),
+    "metrics_snapshot": ("pilottai_tpu.obs", "metrics_snapshot"),
+    "prometheus_text": ("pilottai_tpu.obs", "prometheus_text"),
+    "perfetto_trace": ("pilottai_tpu.obs", "perfetto_trace"),
+    "MetricsDashboard": ("pilottai_tpu.utils.dashboard", "MetricsDashboard"),
 }
 
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional, TextIO
@@ -210,3 +211,74 @@ class TaskJournal:
     @property
     def writes(self) -> int:
         return self._writes
+
+
+class BlackBoxJournal:
+    """Append-only JSONL sink for observability black-box dumps.
+
+    The flight recorder (``pilottai_tpu/obs/blackbox.py``) writes one
+    record per triggering event — deadline expiry, breaker open, request
+    error — containing the last engine steps and the request's span
+    tree. Same posture as ``TaskJournal``: writes degrade instead of
+    crash (a full disk must not take serving down with it) and pass the
+    ``checkpoint.write`` chaos point so fault tests can script failures.
+    """
+
+    def __init__(self, path: str | Path, fsync: bool = False) -> None:
+        self.path = Path(path)
+        self.fsync = fsync
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._fh: Optional[TextIO] = open(self.path, "a", encoding="utf-8")
+        self._log = get_logger("checkpoint.blackbox")
+        self._lock = threading.Lock()
+        self.writes = 0
+
+    def write(self, record: Dict[str, Any]) -> bool:
+        """Append one dump record; returns False on a degraded write —
+        including writes racing a close/re-configure (a queued dump must
+        degrade, never raise, on failure paths)."""
+        try:
+            global_injector.fire("checkpoint.write")
+            line = json.dumps(record, default=str)
+            with self._lock:
+                if self._fh is None:
+                    global_metrics.inc("blackbox.write_failures")
+                    return False
+                self._fh.write(line + "\n")
+                self._fh.flush()
+                if self.fsync:
+                    os.fsync(self._fh.fileno())
+                self.writes += 1
+            return True
+        except OSError as exc:
+            global_metrics.inc("blackbox.write_failures")
+            self._log.error(
+                "black-box dump write failed (%s); dump for %s kept "
+                "in-memory only", exc, record.get("trace_id"),
+            )
+            return False
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    @staticmethod
+    def read(path: str | Path) -> List[Dict[str, Any]]:
+        """Load every dump record, skipping torn lines (the writer may
+        have died mid-dump — that's the scenario dumps exist for)."""
+        path = Path(path)
+        records: List[Dict[str, Any]] = []
+        if not path.exists():
+            return records
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+        return records

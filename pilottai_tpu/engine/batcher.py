@@ -60,6 +60,7 @@ from pilottai_tpu.models.common import ModelConfig
 from pilottai_tpu.ops.kvcache import KVCache, free_slots
 from pilottai_tpu.ops.paged import PageAllocator, PagedKVCache
 from pilottai_tpu.ops.pallas.decode_attention import decode_shapes_ok
+from pilottai_tpu.obs import global_blackbox, global_flight, global_steps
 from pilottai_tpu.reliability import (
     DeadlineExceeded,
     EngineOverloaded,
@@ -67,6 +68,7 @@ from pilottai_tpu.reliability import (
 )
 from pilottai_tpu.utils.logging import get_logger
 from pilottai_tpu.utils.metrics import global_metrics
+from pilottai_tpu.utils.tracing import global_tracer
 
 
 @dataclass
@@ -104,6 +106,19 @@ class GenRequest:
     # cheap and non-blocking (bridge to asyncio via
     # ``loop.call_soon_threadsafe``); exceptions are swallowed.
     on_tokens: Optional[Any] = None
+    # Flight-recorder correlation (obs/flight.py): admission and token
+    # folds mark phases against ``flight_id`` (unique per request; falls
+    # back to trace_id for direct submitters), the request's engine span
+    # is emitted under ``trace_id``/``parent_span_id``, and black-box
+    # dumps on deadline expiry cite the trace. None (warmup, direct
+    # batcher tests) = untracked.
+    trace_id: Optional[str] = None
+    flight_id: Optional[str] = None
+    parent_span_id: Optional[str] = None
+
+    @property
+    def flight_key(self) -> Optional[str]:
+        return self.flight_id or self.trace_id
 
 
 @dataclass
@@ -591,6 +606,12 @@ class ContinuousBatcher:
         # into a structured 429 before any engine state exists for it.
         if self.saturated():
             global_metrics.inc("engine.shed")
+            global_steps.record(
+                "engine.shed",
+                queue_depth=self.queue_depth(),
+                max_queue_depth=self.max_queue_depth,
+                trace_id=request.trace_id,
+            )
             raise EngineOverloaded(
                 f"engine queue depth {self.queue_depth()} at configured "
                 f"limit {self.max_queue_depth}; shedding"
@@ -695,6 +716,7 @@ class ContinuousBatcher:
         None`` guard plus the admission generation stamp keep any
         still-in-flight chunk from folding into the freed slot."""
         now = time.monotonic()
+        expired: List[Tuple[int, _Slot]] = []
         with self._lock:
             for i, slot in enumerate(self._slots):
                 if slot is None:
@@ -706,11 +728,38 @@ class ContinuousBatcher:
                 self._release.append(i)
                 global_metrics.inc("engine.expired")
                 global_metrics.inc("engine.deadline_releases")
+                expired.append((i, slot))
                 if not req.future.done():
                     req.future.set_exception(DeadlineExceeded(
                         f"request deadline expired after "
                         f"{len(slot.generated)} generated token(s)"
                     ))
+        # Observability OUTSIDE the lock: the black-box dump snapshots
+        # the step ring and may write a journal line — file IO must not
+        # stall the reader thread's folds.
+        for i, slot in expired:
+            req = slot.request
+            if req.trace_id is None:
+                continue
+            end = time.perf_counter()
+            global_tracer.emit(
+                "engine.batch_decode",
+                trace_id=req.trace_id,
+                parent_id=req.parent_span_id,
+                start=req.submitted_at,
+                end=end,
+                slot=i,
+                prompt_len=slot.prompt_len,
+                tokens=len(slot.generated),
+                status="deadline",
+            )
+            global_blackbox.dump(
+                "deadline_expired",
+                trace_id=req.trace_id,
+                slot=i,
+                generated_tokens=len(slot.generated),
+                prompt_len=slot.prompt_len,
+            )
 
     def _admit(self) -> None:
         """Stop released slots, then prefill+install pending requests in
@@ -1163,6 +1212,7 @@ class ContinuousBatcher:
             first.copy_to_host_async()
         except AttributeError:
             pass
+        admit_at = time.perf_counter()
         with self._lock:
             for idx, req in group:
                 self._slots[idx] = _Slot(
@@ -1177,6 +1227,20 @@ class ContinuousBatcher:
             self._first_reads.append(
                 ([(idx, self._gen[idx]) for idx, _ in group], first)
             )
+            slots_active = sum(s is not None for s in self._slots)
+        for _, req in group:
+            # Queue wait = submit → slot granted: the flight's admitted
+            # mark is THE source of request.queue_wait_s (one histogram,
+            # one definition — a second batcher-side one with a slightly
+            # different start point would disagree at the tails).
+            if req.flight_key is not None:
+                global_flight.mark(req.flight_key, "admitted", at=admit_at)
+        global_steps.record(
+            "engine.admit",
+            n=len(group),
+            slots_active=slots_active,
+            queue_depth=self.queue_depth(),
+        )
         global_metrics.inc("engine.admitted", len(group))
 
     def _schema_tables(self):
@@ -1274,11 +1338,14 @@ class ContinuousBatcher:
                 tok = int(host[row])
                 slot.generated.append(tok)
                 req = slot.request
-                if (
-                    req.on_tokens is not None
-                    and tok != req.eos_id and tok not in req.stop_ids
-                ):
-                    emits.append((req.on_tokens, [tok]))
+                if tok != req.eos_id and tok not in req.stop_ids:
+                    # TTFT lands here: the flight's first token mark must
+                    # precede _check_finished (which may resolve the
+                    # future and let the handler close the flight).
+                    if req.flight_key is not None:
+                        global_flight.token(req.flight_key, 1)
+                    if req.on_tokens is not None:
+                        emits.append((req.on_tokens, [tok]))
                 self._check_finished(idx)
         return emits
 
@@ -1323,10 +1390,26 @@ class ContinuousBatcher:
         self._release.append(idx)
         if out and (out[-1] == req.eos_id or out[-1] in req.stop_ids):
             out = out[:-1]
-        latency = time.perf_counter() - req.submitted_at
+        now = time.perf_counter()
+        latency = now - req.submitted_at
         global_metrics.observe("engine.request_e2e_latency", latency)
         global_metrics.inc("engine.completed")
         global_metrics.inc("engine.generated_tokens", len(out))
+        if req.trace_id is not None:
+            # The device threads have no asyncio context; emit the
+            # request's engine span directly so its trace still nests
+            # server → handler → batcher (parent = the handler's
+            # engine.generate span id the request carried in).
+            global_tracer.emit(
+                "engine.batch_decode",
+                trace_id=req.trace_id,
+                parent_id=req.parent_span_id,
+                start=req.submitted_at,
+                end=now,
+                slot=idx,
+                prompt_len=slot.prompt_len,
+                tokens=len(out),
+            )
         if not req.future.done():
             req.future.set_result(out)
 
@@ -1496,12 +1579,32 @@ class ContinuousBatcher:
                     slot.generated.append(tok)
                     if tok != req.eos_id and tok not in req.stop_ids:
                         fresh.append(tok)
+                        # Per-token flight mark (ITL/TPOT) — before
+                        # _check_finished can resolve the future.
+                        if req.flight_key is not None:
+                            global_flight.token(req.flight_key, 1)
                     self._check_finished(b)
                     if self._slots[b] is None:
                         break
                 if fresh and req.on_tokens is not None:
                     emits.append((req.on_tokens, fresh))
+            slots_active = sum(s is not None for s in self._slots)
         self._fire_stream(emits)
+        # Engine step telemetry: one bounded ring record per folded chunk
+        # — what the black-box dump replays when a request dies.
+        global_steps.record(
+            "engine.chunk",
+            tokens=int(valid_h.sum()),
+            slots_active=slots_active,
+            queue_depth=self.queue_depth(),
+            page_strip=self.page_strip,
+            pipeline_depth=self.PIPELINE_DEPTH,
+            **(
+                {"kv_pages_free": self.alloc.free_pages,
+                 "kv_pages_total": self.num_pages - 1}
+                if self.alloc is not None else {}
+            ),
+        )
         if self.speculate:
             # Observed tokens-per-block over blocks that actually emitted
             # (done-slot and trailing no-op blocks excluded — counting

@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import random
 import time
+import uuid
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -24,6 +25,7 @@ from pilottai_tpu.engine.types import (
     LLMResponse,
     ToolSpec,
 )
+from pilottai_tpu.obs import global_blackbox, global_flight, global_steps
 from pilottai_tpu.reliability import (
     CircuitBreaker,
     CircuitOpenError,
@@ -121,6 +123,12 @@ class LLMHandler:
             )
             if rel.breaker_enabled else None
         )
+        if self.breaker is not None:
+            # Black-box context for every open: the step ring shows what
+            # the engine was doing while failures crossed the threshold.
+            self.breaker.on_open = lambda name: global_blackbox.dump(
+                "breaker_open", breaker=name, model=self.config.model_name,
+            )
         self._log = get_logger("engine.handler")
         self._started = False
 
@@ -174,6 +182,60 @@ class LLMHandler:
             )
         return msgs, specs, params
 
+    def _ensure_trace(self, params: GenerationParams) -> GenerationParams:
+        """Every engine request flies with a trace id: the HTTP edge sets
+        one from ``x-request-id``; orchestrator-driven calls adopt the
+        ambient span's trace (serve.execute_task / agent spans — the
+        nested engine.generate span inherits that trace anyway, and the
+        batcher's emitted span must land in the SAME trace or the tree
+        splits); bare callers get a fresh per-call id. Either way the
+        flight recorder covers all traffic, not just HTTP.
+
+        ``flight_id`` is always minted fresh: one ledger per engine
+        request even when many share a trace."""
+        update: Dict[str, Any] = {}
+        if params.trace_id is None:
+            ambient = global_tracer.current()
+            update["trace_id"] = (
+                ambient.trace_id if ambient is not None
+                else uuid.uuid4().hex[:16]
+            )
+        if params.flight_id is None:
+            update["flight_id"] = uuid.uuid4().hex[:16]
+        return params.model_copy(update=update) if update else params
+
+    def _finish_flight(
+        self,
+        flight_id: str,
+        trace_id: str,
+        status: str,
+        dump_reason: Optional[str] = None,
+        tokens: Optional[int] = None,
+        latency_s: Optional[float] = None,
+        **dump_extra: Any,
+    ) -> None:
+        """Close the request's flight record, append a handler step to
+        the telemetry ring, and (for failures worth a postmortem) write a
+        black-box dump."""
+        summary = global_flight.finish(flight_id, status)
+        step: Dict[str, Any] = {
+            "model": self.config.model_name, "status": status,
+        }
+        if tokens is not None:
+            step["tokens"] = tokens
+        if latency_s is not None:
+            step["latency_s"] = round(latency_s, 6)
+        if summary:
+            for key in ("ttft_s", "tpot_s", "e2e_s"):
+                if key in summary:
+                    step[key] = summary[key]
+        global_steps.record("handler.request", trace_id=trace_id, **step)
+        if dump_reason is not None:
+            global_blackbox.dump(
+                dump_reason, trace_id=trace_id,
+                model=self.config.model_name, **dump_extra,
+            )
+
     async def generate_response(
         self,
         messages: Sequence[ChatMessage | Dict[str, Any] | str],
@@ -191,10 +253,54 @@ class LLMHandler:
         msgs, specs, params = self._normalize(
             messages, tools, params, json_mode, json_schema
         )
+        params = self._ensure_trace(params)
+        trace_id, flight_id = params.trace_id, params.flight_id
+        global_flight.start(
+            flight_id, trace_id=trace_id, model=self.config.model_name
+        )
 
         deadline = params.deadline
+        try:
+            return await self._generate_attempts(msgs, specs, params, deadline)
+        except EngineOverloaded:
+            self._finish_flight(flight_id, trace_id, "shed")
+            raise
+        except CircuitOpenError:
+            self._finish_flight(flight_id, trace_id, "breaker_open")
+            raise
+        except DeadlineExceeded:
+            self._finish_flight(
+                flight_id, trace_id, "deadline",
+                dump_reason="deadline_expired",
+            )
+            raise
+        except asyncio.CancelledError:
+            self._finish_flight(flight_id, trace_id, "cancelled")
+            raise
+        except Exception as exc:  # noqa: BLE001 — flight/dump then re-raise
+            self._finish_flight(
+                flight_id, trace_id, "error", dump_reason="request_error",
+                error=str(exc),
+            )
+            raise
+
+    async def _generate_attempts(
+        self,
+        msgs: List[ChatMessage],
+        specs: List[ToolSpec],
+        params: GenerationParams,
+        deadline: Optional[float],
+    ) -> LLMResponse:
+        """The retry loop proper (flight/dump bookkeeping lives in
+        ``generate_response`` so every exit path settles exactly once)."""
+        trace_id, flight_id = params.trace_id, params.flight_id
         last_error: Optional[Exception] = None
         for attempt in range(self.config.retries + 1):
+            if attempt:
+                # Retry boundary: drop the aborted attempt's token
+                # timeline so the new attempt's first token doesn't read
+                # as a backoff-sized inter-token gap.
+                global_flight.reset_tokens(flight_id)
             # Deadline first (before the breaker reserves a probe slot):
             # a request whose budget is gone must not consume anything.
             if deadline is not None and time.monotonic() >= deadline:
@@ -216,15 +322,24 @@ class LLMHandler:
                     await self._limiter.acquire()
                 async with self._semaphore:
                     with global_tracer.span(
-                        "engine.generate", model=self.config.model_name
-                    ):
+                        "engine.generate", trace_id=trace_id,
+                        model=self.config.model_name, attempt=attempt,
+                    ) as span:
+                        # The batcher's threads can't see this asyncio
+                        # context; hand them the span id so the engine's
+                        # emitted span nests under this one.
+                        call_params = params.model_copy(
+                            update={"parent_span_id": span.span_id}
+                        )
                         start = time.perf_counter()
                         budget = self.config.timeout
                         if deadline is not None:
                             budget = min(budget, deadline - time.monotonic())
                         try:
                             response = await asyncio.wait_for(
-                                self.backend.generate(msgs, specs or None, params),
+                                self.backend.generate(
+                                    msgs, specs or None, call_params
+                                ),
                                 timeout=max(budget, 1e-3),
                             )
                         except asyncio.TimeoutError:
@@ -247,6 +362,19 @@ class LLMHandler:
                 )
                 global_metrics.inc(
                     "engine.completion_tokens", response.usage.completion_tokens
+                )
+                # Backends with no token visibility (mock, custom): model
+                # the tokens over the call envelope so TTFT/TPOT
+                # percentiles exist for every deployment. A no-op when
+                # the batcher already recorded real token marks.
+                global_flight.synthesize_tokens(
+                    flight_id, response.usage.completion_tokens,
+                    start, time.perf_counter(),
+                )
+                self._finish_flight(
+                    flight_id, trace_id, "ok",
+                    tokens=response.usage.completion_tokens,
+                    latency_s=latency,
                 )
                 return response
             except EngineOverloaded:
@@ -342,9 +470,16 @@ class LLMHandler:
         msgs, specs, params = self._normalize(
             messages, tools, params, json_mode, json_schema
         )
+        params = self._ensure_trace(params)
+        trace_id, flight_id = params.trace_id, params.flight_id
+        global_flight.start(
+            flight_id, trace_id=trace_id,
+            model=self.config.model_name, stream=True,
+        )
 
         deadline = params.deadline
         if self.breaker is not None and not self.breaker.allow():
+            self._finish_flight(flight_id, trace_id, "breaker_open")
             raise self.breaker.open_error()
         # allow() may have reserved a half-open probe slot: every exit
         # path must settle it (the inner finally below) or release it
@@ -357,24 +492,37 @@ class LLMHandler:
                 await self._limiter.acquire()
             async with self._semaphore:
                 with global_tracer.span(
-                    "engine.generate_stream", model=self.config.model_name
-                ):
+                    "engine.generate_stream", trace_id=trace_id,
+                    model=self.config.model_name,
+                ) as span:
+                    call_params = params.model_copy(
+                        update={"parent_span_id": span.span_id}
+                    )
                     start = time.perf_counter()
                     n_chars = 0
+                    n_deltas = 0
+                    first_delta_at: Optional[float] = None
+                    last_delta_at: Optional[float] = None
                     try:
                         gen = self.backend.generate_stream(
-                            msgs, specs or None, params, info=info
+                            msgs, specs or None, call_params, info=info
                         )
                     except TypeError:
                         # Pre-`info` backend signature (user-supplied
                         # backends): argument binding fails at call time,
                         # before any iteration — safe to retry without.
                         gen = self.backend.generate_stream(
-                            msgs, specs or None, params
+                            msgs, specs or None, call_params
                         )
                     agen = gen.__aiter__()
                     failed = True  # error until proven otherwise
                     shed = False
+                    # The in-flight exception, captured explicitly: an
+                    # async generator's finally can observe the CONSUMER
+                    # frame's already-handled exception via sys.exc_info()
+                    # on normal exhaustion, which would misclassify a
+                    # successful stream (review finding).
+                    stream_exc: Optional[BaseException] = None
                     try:
                         while True:
                             wait = self.config.timeout
@@ -396,17 +544,30 @@ class LLMHandler:
                                     ) from None
                                 raise
                             n_chars += len(delta)
+                            n_deltas += 1
+                            now = time.perf_counter()
+                            if first_delta_at is None:
+                                first_delta_at = now
+                                global_flight.mark(
+                                    flight_id, "first_delta", at=now
+                                )
+                            last_delta_at = now
                             yield delta
                         failed = False
-                    except GeneratorExit:
+                    except GeneratorExit as exc:
                         failed = False  # consumer chose to stop — not an error
+                        stream_exc = exc
                         raise
-                    except EngineOverloaded:
+                    except EngineOverloaded as exc:
                         # Shed at admission: counts as an error for the
                         # request metrics but NOT against the breaker —
                         # unary-path parity (a shed proves the engine is
                         # alive and protecting itself).
                         shed = True
+                        stream_exc = exc
+                        raise
+                    except BaseException as exc:
+                        stream_exc = exc
                         raise
                     finally:
                         # Consumer break / timeout / error: close the backend
@@ -423,6 +584,49 @@ class LLMHandler:
                         global_metrics.inc("engine.stream_chars", n_chars)
                         if failed:
                             global_metrics.inc("engine.errors")
+                        # Flight close — exactly once, on every outcome
+                        # (generate_response parity). Real token marks
+                        # come from the batcher; token-blind backends
+                        # fall back to the delta envelope the consumer
+                        # actually observed.
+                        n_tok = n_deltas
+                        if info is not None and isinstance(
+                            info.get("completion_tokens"), int
+                        ):
+                            n_tok = info["completion_tokens"]
+                        if n_tok and first_delta_at is not None:
+                            global_flight.set_token_envelope(
+                                flight_id, n_tok,
+                                first_delta_at, last_delta_at,
+                            )
+                        if isinstance(stream_exc, DeadlineExceeded):
+                            self._finish_flight(
+                                flight_id, trace_id, "deadline",
+                                dump_reason="deadline_expired",
+                            )
+                        elif shed:
+                            self._finish_flight(flight_id, trace_id, "shed")
+                        elif isinstance(
+                            stream_exc,
+                            (GeneratorExit, asyncio.CancelledError),
+                        ):
+                            self._finish_flight(
+                                flight_id, trace_id, "cancelled"
+                            )
+                        elif failed:
+                            self._finish_flight(
+                                flight_id, trace_id, "error",
+                                dump_reason="request_error",
+                                error=(
+                                    str(stream_exc)
+                                    if stream_exc is not None else None
+                                ),
+                            )
+                        else:
+                            self._finish_flight(
+                                flight_id, trace_id, "ok", tokens=n_tok,
+                                latency_s=time.perf_counter() - start,
+                            )
                         settled = True
                         if self.breaker is not None:
                             # Pair the allow() above: streams report into
@@ -433,9 +637,19 @@ class LLMHandler:
                                 self.breaker.record_failure()
                             else:
                                 self.breaker.record_success()
-        except BaseException:
+        except BaseException as exc:
             if self.breaker is not None and not settled:
                 self.breaker.release_probe()
+            if not settled:
+                # Failure before the stream's own finally ran (limiter
+                # acquire cancelled, generator creation failed): the
+                # flight is still open and must not leak as "active".
+                self._finish_flight(
+                    flight_id, trace_id,
+                    "cancelled"
+                    if isinstance(exc, (asyncio.CancelledError, GeneratorExit))
+                    else "error",
+                )
             raise
 
     async def apredict(self, prompt: str, **kwargs: Any) -> str:

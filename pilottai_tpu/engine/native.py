@@ -309,6 +309,12 @@ class NativeEngine(LLMBackend):
             ),
             json_schema_id=schema_id,
             deadline=params.deadline,
+            # Flight-recorder correlation: the batcher marks admission /
+            # token phases against the flight id and emits its span
+            # against the trace id.
+            trace_id=params.trace_id,
+            flight_id=params.flight_id,
+            parent_span_id=params.parent_span_id,
         )
 
     def schema_support(self, schema: Dict[str, Any]) -> Optional[str]:

@@ -1,0 +1,43 @@
+"""Observability layer: one request-to-kernel story.
+
+Correlates the three previously disconnected pieces — ``utils/metrics``
+(numbers), ``utils/tracing`` (span trees), ``utils/dashboard``/``server``
+(endpoints) — into a unified telemetry surface:
+
+* ``flight``   — per-request flight recorder (queue wait, TTFT, ITL,
+  TPOT phase ledger keyed by trace id, exported as histograms).
+* ``ring``     — bounded engine step telemetry ring (slot occupancy,
+  tokens/step, KV page utilization, strip width, pipeline depth).
+* ``blackbox`` — dump coordinator: last N steps + the affected request's
+  span tree, journaled on deadline expiry / breaker open / errors.
+* ``export``   — Prometheus text exposition, Chrome/Perfetto
+  ``trace_event`` JSON, the shared ``metrics_snapshot`` and the bench's
+  ``phase_summary``.
+
+Import cost: stdlib + utils + checkpoint.journal only — no jax, safe for
+control-plane processes (the same constraint as ``reliability``).
+"""
+
+from pilottai_tpu.obs.blackbox import BlackBox, global_blackbox
+from pilottai_tpu.obs.export import (
+    metrics_snapshot,
+    perfetto_trace,
+    phase_summary,
+    prometheus_text,
+)
+from pilottai_tpu.obs.flight import FlightRecorder, RequestFlight, global_flight
+from pilottai_tpu.obs.ring import StepRing, global_steps
+
+__all__ = [
+    "BlackBox",
+    "FlightRecorder",
+    "RequestFlight",
+    "StepRing",
+    "global_blackbox",
+    "global_flight",
+    "global_steps",
+    "metrics_snapshot",
+    "perfetto_trace",
+    "phase_summary",
+    "prometheus_text",
+]

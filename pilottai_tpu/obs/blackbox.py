@@ -1,0 +1,208 @@
+"""Black-box dumper: when a request dies, write down what the engine was
+doing.
+
+A deadline that expires, a breaker that opens or a request that errors
+out currently leaves only a status code; the context that explains it —
+was the queue deep? were slots full? was the page pool exhausted? — is
+gone by the time anyone looks. On each such event this module snapshots
+the last N step-ring records plus the affected request's span tree and
+flight ledger, keeps the dump in a bounded in-memory ring, and (when a
+dump path is configured) appends it to a ``BlackBoxJournal`` JSONL file
+via ``checkpoint/journal.py`` — the same degraded-write semantics and
+``checkpoint.write`` chaos point as the task journal.
+
+Dump record shape (one JSON object per line)::
+
+    {"ev": "blackbox", "ts": ..., "reason": "deadline_expired",
+     "trace_id": "...", "steps": [last N ring records],
+     "spans": [finished spans of the trace], "flight": {...}, ...extra}
+
+Repeated (reason, trace_id) pairs are deduplicated: the handler and the
+batcher both observe the same expiry, and one dump per event is the
+point — a dump storm during an outage would bury the first, most
+interesting record.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from pilottai_tpu.obs.flight import global_flight
+from pilottai_tpu.obs.ring import global_steps
+from pilottai_tpu.utils.logging import get_logger
+from pilottai_tpu.utils.metrics import global_metrics
+from pilottai_tpu.utils.tracing import global_tracer
+
+
+class BlackBox:
+    """Dump coordinator. Always-on in memory; file output is opt-in via
+    ``configure`` (serving deployments point it next to the task
+    journal; tests point it at tmp_path)."""
+
+    def __init__(
+        self,
+        keep_steps: int = 64,
+        max_recent: int = 16,
+        dedup_window: float = 30.0,
+    ) -> None:
+        self.keep_steps = keep_steps
+        self.dedup_window = dedup_window
+        self._journal = None  # BlackBoxJournal once configured
+        self._recent: Deque[Dict[str, Any]] = deque(maxlen=max_recent)
+        # (reason, trace_id) → last dump time. Time-bounded: trace ids
+        # are client-controlled (x-request-id), and a gateway reusing a
+        # fixed id must not suppress postmortem dumps forever — only
+        # the double-report of ONE event (handler + batcher observing
+        # the same expiry within seconds).
+        self._seen: Dict[Tuple[str, str], float] = {}
+        self._lock = threading.Lock()
+        # Journal writes run on a dedicated daemon thread: dump() is
+        # called from the batcher's device thread and the event loop —
+        # JSON serialization + file flush there would stall decode
+        # dispatch (or the loop) exactly when the engine is drowning.
+        self._write_q: "queue.Queue[Optional[Dict[str, Any]]]" = queue.Queue(
+            maxsize=64
+        )
+        self._writer: Optional[threading.Thread] = None
+        self._log = get_logger("obs.blackbox")
+
+    # ------------------------------------------------------------------ #
+
+    def configure(
+        self,
+        path: str,
+        keep_steps: Optional[int] = None,
+        fsync: bool = False,
+    ) -> "BlackBox":
+        """Attach (or re-point) the JSONL dump file."""
+        from pilottai_tpu.checkpoint.journal import BlackBoxJournal
+
+        with self._lock:
+            if self._journal is not None:
+                self._journal.close()
+            self._journal = BlackBoxJournal(path, fsync=fsync)
+            if keep_steps is not None:
+                self.keep_steps = keep_steps
+            if self._writer is None:
+                self._writer = threading.Thread(
+                    target=self._write_loop,
+                    name="pilottai-blackbox-writer",
+                    daemon=True,
+                )
+                self._writer.start()
+        self._log.info("black-box dumps -> %s", path)
+        return self
+
+    def disable(self) -> None:
+        self.flush()
+        with self._lock:
+            if self._journal is not None:
+                self._journal.close()
+                self._journal = None
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Block until every queued dump has been written (tests; clean
+        shutdown). Bounded wait — a wedged disk must not wedge stop()."""
+        deadline = time.monotonic() + timeout
+        # unfinished_tasks (not empty()): a record mid-write has left the
+        # queue but isn't on disk until task_done runs.
+        while self._write_q.unfinished_tasks and time.monotonic() < deadline:
+            time.sleep(0.01)
+
+    def _write_loop(self) -> None:
+        while True:
+            record = self._write_q.get()
+            try:
+                with self._lock:
+                    journal = self._journal
+                if journal is not None:
+                    journal.write(record)
+            except Exception:  # noqa: BLE001 — writer must survive
+                pass
+            finally:
+                self._write_q.task_done()
+
+    @property
+    def enabled(self) -> bool:
+        return self._journal is not None
+
+    # ------------------------------------------------------------------ #
+
+    def dump(
+        self,
+        reason: str,
+        trace_id: Optional[str] = None,
+        **extra: Any,
+    ) -> Optional[Dict[str, Any]]:
+        """Capture and persist one dump. Returns the record, or None when
+        this (reason, trace_id) was already dumped. Never raises — this
+        runs on failure paths that must stay failure paths."""
+        try:
+            if trace_id is not None:
+                # Dedup only trace-carrying dumps (trace-less events
+                # like breaker opens are intentionally never deduped),
+                # and only within a short horizon.
+                key = (reason, trace_id)
+                now = time.monotonic()
+                with self._lock:
+                    last = self._seen.get(key)
+                    if last is not None and now - last < self.dedup_window:
+                        return None
+                    if len(self._seen) > 1024:
+                        cutoff = now - self.dedup_window
+                        self._seen = {
+                            k: t for k, t in self._seen.items()
+                            if t > cutoff
+                        }
+                    self._seen[key] = now
+            record: Dict[str, Any] = {
+                "ev": "blackbox",
+                "ts": time.time(),
+                "reason": reason,
+                "trace_id": trace_id,
+                "steps": global_steps.snapshot(self.keep_steps),
+                "spans": (
+                    [s.to_dict() for s in global_tracer.for_trace(trace_id)]
+                    if trace_id is not None else []
+                ),
+                "flight": (
+                    global_flight.describe(trace_id)
+                    if trace_id is not None else None
+                ),
+                **extra,
+            }
+            with self._lock:
+                self._recent.append(record)
+                journal = self._journal
+            if journal is not None:
+                try:
+                    self._write_q.put_nowait(record)
+                except queue.Full:
+                    # A dump storm outran the disk: the in-memory recent
+                    # ring still has the record; count the drop.
+                    global_metrics.inc("blackbox.dropped")
+            global_metrics.inc("blackbox.dumps")
+            global_metrics.inc(f"blackbox.dumps.{reason}")
+            self._log.warning(
+                "black-box dump: %s trace_id=%s (%d steps captured)",
+                reason, trace_id, len(record["steps"]),
+            )
+            return record
+        except Exception as exc:  # noqa: BLE001 — never worsen a failure
+            try:
+                self._log.error("black-box dump failed: %s", exc)
+            except Exception:  # pragma: no cover
+                pass
+            return None
+
+    def recent(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            records = list(self._recent)
+        return records[-n:] if n is not None else records
+
+
+global_blackbox = BlackBox()

@@ -1,0 +1,294 @@
+"""Per-request flight recorder: phase timestamps from accept to last token.
+
+LLM-Pilot (arxiv 2410.02425) argues per-phase characterization — queue
+wait, prefill, time-to-first-token, per-token decode — is the
+prerequisite for capacity planning; an aggregate request latency can't
+tell an admission backlog from a slow decode. Each request therefore
+accumulates a ``RequestFlight``: the handler opens one keyed by a
+per-request ``flight_id`` (the shared ``trace_id`` rides along for
+correlation — many flights can share one orchestrator trace), the
+engine layers mark phases as they happen (admission on the device
+thread, token folds on the reader thread), and ``finish`` derives the
+serving metrics and feeds them into ``global_metrics`` histograms:
+
+===========================  ==========================================
+``request.queue_wait_s``     submit → batcher admission (slot granted)
+``request.ttft_s``           start → first generated token on the host
+``request.itl_s``            inter-token latency, observed per fold
+``request.tpot_s``           (last − first token) / (n − 1)
+``request.e2e_s``            start → finish
+===========================  ==========================================
+
+plus ``request.completed`` / ``request.failed`` counters labelled by the
+finish status in ``request.finished.<status>``.
+
+Backends that cannot see individual tokens (the mock, pre-token-callback
+custom backends) call ``synthesize_tokens`` with the response envelope —
+TTFT/TPOT become envelope-derived estimates rather than absent, so
+mock-engine runs still produce the full percentile surface.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional
+
+from pilottai_tpu.utils.metrics import MetricsRegistry, global_metrics
+
+
+@dataclass
+class RequestFlight:
+    """One request's phase ledger. All timestamps are
+    ``time.perf_counter()`` — the tracer's clock.
+
+    ``flight_id`` is the UNIQUE ledger key (one per engine request);
+    ``trace_id`` is the shared correlation id — orchestrator traffic
+    runs many engine calls under one trace, and keying the ledger by
+    trace would merge concurrent siblings' phases (review finding)."""
+
+    flight_id: str
+    trace_id: str
+    started: float = field(default_factory=time.perf_counter)
+    attributes: Dict[str, Any] = field(default_factory=dict)
+    marks: Dict[str, float] = field(default_factory=dict)
+    n_tokens: int = 0
+    first_token_at: Optional[float] = None
+    last_token_at: Optional[float] = None
+    status: Optional[str] = None  # set by finish()
+    ended: Optional[float] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "flight_id": self.flight_id,
+            "trace_id": self.trace_id,
+            "status": self.status,
+            "attributes": dict(self.attributes),
+            "marks": {
+                k: round(v - self.started, 6) for k, v in self.marks.items()
+            },
+            "tokens": self.n_tokens,
+        }
+        for name, value in self.derived().items():
+            out[name] = round(value, 6)
+        return out
+
+    def derived(self) -> Dict[str, float]:
+        """Phase durations computable from the ledger so far."""
+        out: Dict[str, float] = {}
+        admitted = self.marks.get("admitted")
+        if admitted is not None:
+            out["queue_wait_s"] = max(admitted - self.started, 0.0)
+        if self.first_token_at is not None:
+            out["ttft_s"] = max(self.first_token_at - self.started, 0.0)
+        if (
+            self.n_tokens > 1
+            and self.first_token_at is not None
+            and self.last_token_at is not None
+        ):
+            out["tpot_s"] = max(
+                (self.last_token_at - self.first_token_at)
+                / (self.n_tokens - 1),
+                0.0,
+            )
+        if self.ended is not None:
+            out["e2e_s"] = max(self.ended - self.started, 0.0)
+        return out
+
+
+class FlightRecorder:
+    """Registry of in-flight and recently finished request flights.
+
+    Thread-safe: the HTTP edge and handler run on the event loop while
+    the batcher marks phases from its device and reader threads. All
+    mutation happens under one lock; every method is a cheap no-op for
+    unknown trace ids, so instrumentation call sites never need guards.
+    """
+
+    def __init__(
+        self,
+        max_finished: int = 1024,
+        registry: MetricsRegistry = global_metrics,
+    ) -> None:
+        self._active: Dict[str, RequestFlight] = {}
+        self._finished: Deque[RequestFlight] = deque(maxlen=max_finished)
+        self._lock = threading.Lock()
+        self._registry = registry
+
+    # ------------------------------------------------------------------ #
+    # Lifecycle (handler / HTTP edge)
+    # ------------------------------------------------------------------ #
+
+    def start(
+        self,
+        flight_id: str,
+        trace_id: Optional[str] = None,
+        **attributes: Any,
+    ) -> RequestFlight:
+        """Get-or-create the active flight for ``flight_id`` (idempotent:
+        the server may open it before the handler enriches it).
+        ``trace_id`` defaults to the flight id for callers with a
+        one-request trace (the HTTP edge)."""
+        with self._lock:
+            flight = self._active.get(flight_id)
+            if flight is None:
+                flight = RequestFlight(
+                    flight_id=flight_id, trace_id=trace_id or flight_id
+                )
+                self._active[flight_id] = flight
+            flight.attributes.update(attributes)
+            return flight
+
+    def finish(self, flight_id: str, status: str = "ok") -> Optional[Dict[str, Any]]:
+        """Close the flight: derive phase metrics, observe them into the
+        registry, move the record to the finished ring. Returns the
+        flight's summary dict, or None when no active flight exists
+        (already finished, or never started) — safe to call from every
+        error path without bookkeeping.
+
+        Phase histograms are observed for ``ok`` flights ONLY: a storm
+        of shed/breaker-fast-fails would otherwise flood the (window-
+        aware) latency percentiles with ~0 ms samples and make p99 read
+        "healthy" mid-outage — failures are counted, not timed."""
+        with self._lock:
+            flight = self._active.pop(flight_id, None)
+            if flight is None:
+                return None
+            flight.status = status
+            flight.ended = time.perf_counter()
+            self._finished.append(flight)
+        if status == "ok":
+            for name, value in flight.derived().items():
+                self._registry.observe(f"request.{name}", value)
+            self._registry.inc("request.completed")
+        else:
+            self._registry.inc("request.failed")
+        self._registry.inc(f"request.finished.{status}")
+        return flight.to_dict()
+
+    # ------------------------------------------------------------------ #
+    # Phase marks (any thread)
+    # ------------------------------------------------------------------ #
+
+    def mark(self, flight_id: str, phase: str, at: Optional[float] = None) -> None:
+        """Stamp a named phase (first stamp wins — a retry re-entering a
+        phase must not erase when the request FIRST reached it)."""
+        with self._lock:
+            flight = self._active.get(flight_id)
+            if flight is not None:
+                flight.marks.setdefault(
+                    phase, at if at is not None else time.perf_counter()
+                )
+
+    def token(self, flight_id: str, n: int = 1, at: Optional[float] = None) -> None:
+        """Record ``n`` generated tokens surfacing on the host at ``at``.
+        The first call fixes TTFT; later calls observe the inter-token
+        gap (per token) into ``request.itl_s``."""
+        if n <= 0:
+            return
+        at = at if at is not None else time.perf_counter()
+        itl: Optional[float] = None
+        with self._lock:
+            flight = self._active.get(flight_id)
+            if flight is None:
+                return
+            if flight.first_token_at is None:
+                flight.first_token_at = at
+                if n > 1:
+                    itl = max(at - flight.started, 0.0) / n
+            else:
+                prev = flight.last_token_at or flight.first_token_at
+                itl = max(at - prev, 0.0) / n
+            flight.last_token_at = at
+            flight.n_tokens += n
+        if itl is not None:
+            self._registry.observe("request.itl_s", itl)
+
+    def synthesize_tokens(
+        self, flight_id: str, n: int, t_start: float, t_end: float
+    ) -> None:
+        """Envelope fallback for backends with no token visibility: model
+        ``n`` tokens spread uniformly over [t_start, t_end], so TTFT ≈
+        latency/n and TPOT ≈ latency/n. No-op when real token marks
+        already landed (the native engine's batcher feeds those)."""
+        if n <= 0:
+            return
+        with self._lock:
+            flight = self._active.get(flight_id)
+            if flight is None or flight.n_tokens:
+                return
+            per_tok = max(t_end - t_start, 0.0) / n
+            flight.first_token_at = t_start + per_tok
+            flight.last_token_at = t_end
+            flight.n_tokens = n
+
+    def reset_tokens(self, flight_id: str) -> None:
+        """Clear the token timeline at a retry boundary: a new attempt's
+        first token must not register as an inter-token gap from the
+        aborted attempt's last token (the backoff sleep would land in
+        ``request.itl_s`` as a multi-second sample). ``started`` and the
+        phase marks stay — TTFT/e2e remain client-perceived, retries
+        included."""
+        with self._lock:
+            flight = self._active.get(flight_id)
+            if flight is not None:
+                flight.n_tokens = 0
+                flight.first_token_at = None
+                flight.last_token_at = None
+
+    def set_token_envelope(
+        self, flight_id: str, n: int, first_at: float, last_at: float
+    ) -> None:
+        """Stream fallback: the consumer observed ``n`` deltas between
+        ``first_at``/``last_at`` but the backend recorded no per-token
+        marks (mock/custom backends) — adopt the delta envelope as the
+        token timeline. No-op when real marks exist."""
+        if n <= 0:
+            return
+        with self._lock:
+            flight = self._active.get(flight_id)
+            if flight is None or flight.n_tokens:
+                return
+            flight.first_token_at = first_at
+            flight.last_token_at = last_at
+            flight.n_tokens = n
+
+    # ------------------------------------------------------------------ #
+    # Inspection
+    # ------------------------------------------------------------------ #
+
+    def get(self, flight_id: str) -> Optional[RequestFlight]:
+        with self._lock:
+            return self._active.get(flight_id)
+
+    def describe(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        """Summary of the trace's most recent flight, active or finished
+        (black-box dumps call this for the request that tripped them —
+        by TRACE id, the correlation key the dump carries)."""
+        with self._lock:
+            flight = next(
+                (f for f in self._active.values() if f.trace_id == trace_id),
+                None,
+            )
+            if flight is None:
+                for done in reversed(self._finished):
+                    if done.trace_id == trace_id:
+                        flight = done
+                        break
+            return flight.to_dict() if flight is not None else None
+
+    def finished(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            records = list(self._finished)
+        if n is not None:
+            records = records[-n:]
+        return [f.to_dict() for f in records]
+
+    def active_count(self) -> int:
+        with self._lock:
+            return len(self._active)
+
+
+global_flight = FlightRecorder()

@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, Optional
 
 from pilottai_tpu.utils.metrics import global_metrics
 
@@ -71,6 +71,12 @@ class CircuitBreaker:
         self._failures = 0          # consecutive, while closed
         self._opened_at = 0.0
         self._probes = 0            # in-flight half-open probes
+        # Observability hook: fired (outside the lock) with the breaker's
+        # name each time it transitions closed/half-open → open. The
+        # handler wires this to the black-box dumper so the engine state
+        # surrounding the open is captured. Must be cheap-ish and never
+        # raise back into the breaker.
+        self.on_open: Optional[Callable[[str], None]] = None
         self._set_gauge()
 
     # ------------------------------------------------------------------ #
@@ -124,6 +130,7 @@ class CircuitBreaker:
 
     def record_failure(self) -> None:
         with self._lock:
+            prev = self._state
             if self._state == HALF_OPEN:
                 # The probe failed: the backend is still dead — re-open
                 # and re-arm the full recovery window.
@@ -133,6 +140,15 @@ class CircuitBreaker:
                 if self._failures >= self.failure_threshold:
                     self._open()
             self._set_gauge()
+            opened = self._state == OPEN and prev != OPEN
+        hook = self.on_open
+        if opened and hook is not None:
+            # Outside the lock: the hook may take its own locks / do IO
+            # (black-box dump) and must not be able to deadlock callers.
+            try:
+                hook(self.name)
+            except Exception:  # noqa: BLE001 — hook must not break the breaker
+                pass
 
     # ------------------------------------------------------------------ #
 

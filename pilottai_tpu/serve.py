@@ -939,7 +939,10 @@ class Serve:
             "engine": (
                 self.manager_llm.get_metrics() if self.manager_llm is not None else None
             ),
-            "steps_per_sec": global_metrics.rate("agent.steps"),
+            # Trailing-60s window, stated explicitly: this is CURRENT
+            # throughput (0 after a minute idle), not the run's all-time
+            # average — pass window=None for that.
+            "steps_per_sec": global_metrics.rate("agent.steps", window=60.0),
         }
 
     def __repr__(self) -> str:

@@ -22,8 +22,14 @@ Routes
 * ``POST /v1/tasks`` — framework-specific: submit a task description to
   an attached ``Serve`` orchestrator and wait for its ``TaskResult``
   (503 when the server wraps a bare handler).
-* ``GET /healthz`` — liveness; ``GET /metrics`` — handler + global
-  metrics snapshot (JSON).
+* ``GET /healthz`` — liveness; ``GET /metrics`` — the unified metrics
+  snapshot (JSON; same shape as the dashboard's ``/metrics.json``), or
+  Prometheus text exposition with ``?format=prometheus``.
+
+Every request accepts (and every completion/task response echoes) an
+``x-request-id`` header: the flight-recorder trace id correlating spans,
+structured logs, phase metrics and black-box dumps across the server →
+handler → batcher boundary (docs/OBSERVABILITY.md).
 
 Implementation is stdlib-asyncio only (``asyncio.start_server`` + a
 minimal HTTP/1.1 parser): SSE needs the event loop the engine's futures
@@ -41,11 +47,14 @@ from __future__ import annotations
 import asyncio
 import hmac
 import json
+import re
 import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs
 
 from pilottai_tpu.engine.types import GenerationParams, ToolSpec
+from pilottai_tpu.obs import metrics_snapshot, prometheus_text
 from pilottai_tpu.reliability import (
     CircuitOpenError,
     DeadlineExceeded,
@@ -53,6 +62,12 @@ from pilottai_tpu.reliability import (
 )
 from pilottai_tpu.utils.logging import get_logger
 from pilottai_tpu.utils.metrics import global_metrics
+from pilottai_tpu.utils.tracing import global_tracer
+
+# Client-supplied x-request-id values become trace ids threaded through
+# logs, span trees and black-box dumps — constrain the alphabet so a
+# hostile header can't inject into JSONL journals or log greps.
+_REQUEST_ID_RE = re.compile(r"[A-Za-z0-9._\-]{1,64}")
 
 _MAX_HEADER = 32 * 1024
 _MAX_BODY = 10 * 1024 * 1024
@@ -167,13 +182,15 @@ class APIServer:
     ) -> None:
         try:
             try:
-                method, path, headers, body = await self._read_request(reader)
+                method, path, query, headers, body = await self._read_request(
+                    reader
+                )
             except _HttpError as exc:
                 await self._send_error(writer, exc)
                 return
             try:
                 self._check_auth(path, headers)
-                await self._route(method, path, headers, body, writer)
+                await self._route(method, path, query, headers, body, writer)
             except _HttpError as exc:
                 await self._send_error(writer, exc)
             except (DeadlineExceeded, EngineOverloaded, CircuitOpenError) as exc:
@@ -201,7 +218,7 @@ class APIServer:
 
     async def _read_request(
         self, reader: asyncio.StreamReader
-    ) -> Tuple[str, str, Dict[str, str], bytes]:
+    ) -> Tuple[str, str, str, Dict[str, str], bytes]:
         try:
             head = await asyncio.wait_for(
                 reader.readuntil(b"\r\n\r\n"), timeout=30.0
@@ -241,7 +258,8 @@ class APIServer:
                 raise _HttpError(400, "timed out reading body") from exc
         else:
             body = b""
-        return method, path.split("?", 1)[0], headers, body
+        path, _, query = path.partition("?")
+        return method, path, query, headers, body
 
     def _check_auth(self, path: str, headers: Dict[str, str]) -> None:
         if self.auth_token is None or path == "/healthz":
@@ -256,14 +274,29 @@ class APIServer:
         writer: asyncio.StreamWriter,
         status: int,
         payload: Dict[str, Any],
+        extra_headers: Optional[Dict[str, str]] = None,
     ) -> None:
-        data = json.dumps(payload).encode()
-        writer.write(
-            f"HTTP/1.1 {status} {_STATUS_TEXT.get(status, '')}\r\n"
-            f"Content-Type: application/json\r\n"
-            f"Content-Length: {len(data)}\r\n"
-            f"Connection: close\r\n\r\n".encode() + data
+        await self._send_raw(
+            writer, status, json.dumps(payload).encode(),
+            "application/json", extra_headers,
         )
+
+    async def _send_raw(
+        self,
+        writer: asyncio.StreamWriter,
+        status: int,
+        data: bytes,
+        ctype: str,
+        extra_headers: Optional[Dict[str, str]] = None,
+    ) -> None:
+        head = (
+            f"HTTP/1.1 {status} {_STATUS_TEXT.get(status, '')}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(data)}\r\n"
+        )
+        for key, value in (extra_headers or {}).items():
+            head += f"{key}: {value}\r\n"
+        writer.write(head.encode() + b"Connection: close\r\n\r\n" + data)
         await writer.drain()
 
     async def _send_error(self, writer: asyncio.StreamWriter, exc: _HttpError) -> None:
@@ -277,13 +310,18 @@ class APIServer:
     # and terminator can't drift apart.
 
     @staticmethod
-    async def _sse_start(writer: asyncio.StreamWriter) -> None:
-        writer.write(
-            b"HTTP/1.1 200 OK\r\n"
-            b"Content-Type: text/event-stream\r\n"
-            b"Cache-Control: no-cache\r\n"
-            b"Connection: close\r\n\r\n"
+    async def _sse_start(
+        writer: asyncio.StreamWriter,
+        extra_headers: Optional[Dict[str, str]] = None,
+    ) -> None:
+        head = (
+            "HTTP/1.1 200 OK\r\n"
+            "Content-Type: text/event-stream\r\n"
+            "Cache-Control: no-cache\r\n"
         )
+        for key, value in (extra_headers or {}).items():
+            head += f"{key}: {value}\r\n"
+        writer.write(head.encode() + b"Connection: close\r\n\r\n")
         await writer.drain()
 
     @staticmethod
@@ -321,6 +359,7 @@ class APIServer:
         self,
         method: str,
         path: str,
+        query: str,
         headers: Dict[str, str],
         body: bytes,
         writer: asyncio.StreamWriter,
@@ -332,10 +371,32 @@ class APIServer:
                 {n: _jsonable(h.get_metrics()) for n, h in self.handlers.items()}
                 if self.handlers else _jsonable(self.handler.get_metrics())
             )
-            await self._send(writer, 200, {
-                "handler": handler_metrics,
-                "global": _jsonable(global_metrics.snapshot()),
-            })
+            # ONE snapshot shape shared with the dashboard
+            # (obs.metrics_snapshot); ?format=prometheus serves the text
+            # exposition a scraper consumes directly.
+            snap = metrics_snapshot(component=handler_metrics)
+            if parse_qs(query).get("format") == ["prometheus"]:
+                await self._send_raw(
+                    writer, 200, prometheus_text(snap).encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            else:
+                # Back-compat aliases: pre-unification clients read the
+                # handler block under "handler" and the registry
+                # snapshot under "global".
+                snap_j = _jsonable(snap)
+                await self._send(
+                    writer, 200,
+                    {
+                        **snap_j,
+                        "handler": handler_metrics,
+                        "global": {
+                            k: snap_j[k]
+                            for k in ("uptime_s", "counters", "gauges",
+                                      "histograms")
+                        },
+                    },
+                )
         elif path == "/v1/models" and method == "GET":
             await self._send(writer, 200, self._models())
         elif path == "/v1/chat/completions":
@@ -497,15 +558,44 @@ class APIServer:
             t = min(t, rel.max_timeout)
         return time.monotonic() + t
 
+    @staticmethod
+    def _trace_id(headers: Optional[Dict[str, str]]) -> str:
+        """The request's flight-recorder id: accept the client's
+        ``x-request-id`` (sanitized) or mint one. Echoed back as a
+        response header and threaded through handler → batcher spans,
+        logs and black-box dumps (docs/OBSERVABILITY.md)."""
+        raw = (headers or {}).get("x-request-id", "")
+        if raw and _REQUEST_ID_RE.fullmatch(raw):
+            return raw
+        return uuid.uuid4().hex[:16]
+
     async def _chat_completions(
         self,
         req: Dict[str, Any],
         writer: asyncio.StreamWriter,
         headers: Optional[Dict[str, str]] = None,
     ) -> None:
+        trace_id = self._trace_id(headers)
+        # Root span of the request's trace: the handler's engine.generate
+        # span nests under it (same asyncio task), the batcher's emitted
+        # span under that — one tree, server → handler → batcher.
+        with global_tracer.span(
+            "server.request", trace_id=trace_id,
+            route="/v1/chat/completions",
+        ):
+            await self._chat_completions_traced(req, writer, headers, trace_id)
+
+    async def _chat_completions_traced(
+        self,
+        req: Dict[str, Any],
+        writer: asyncio.StreamWriter,
+        headers: Optional[Dict[str, str]],
+        trace_id: str,
+    ) -> None:
         messages, tools, params, strict = self._gen_params(req)
         handler = self._pick_handler(req.get("model"))
         deadline = self._request_deadline(req, headers or {}, handler)
+        params = params.model_copy(update={"trace_id": trace_id})
         if deadline is not None:
             params = params.model_copy(update={"deadline": deadline})
         model = req.get("model") or getattr(
@@ -531,7 +621,7 @@ class APIServer:
         created = int(time.time())
 
         if req.get("stream"):
-            await self._sse_start(writer)
+            await self._sse_start(writer, {"x-request-id": trace_id})
 
             def chunk(delta: Dict[str, Any], finish: Optional[str],
                       **extra: Any) -> None:
@@ -627,7 +717,9 @@ class APIServer:
             # non-schema backends report not-enforced rather than None —
             # the field exists exactly so clients never have to guess).
             payload["schema_enforced"] = bool(response.schema_enforced)
-        await self._send(writer, 200, payload)
+        await self._send(
+            writer, 200, payload, extra_headers={"x-request-id": trace_id}
+        )
 
     # ------------------------------------------------------------------ #
     # /v1/embeddings
@@ -691,6 +783,22 @@ class APIServer:
         writer: asyncio.StreamWriter,
         headers: Optional[Dict[str, str]] = None,
     ) -> None:
+        trace_id = self._trace_id(headers)
+        # Same trace posture as chat completions: serve.execute_task's
+        # span (and every agent/engine span under it) joins this trace,
+        # so one x-request-id greps an entire task execution.
+        with global_tracer.span(
+            "server.request", trace_id=trace_id, route="/v1/tasks"
+        ):
+            await self._submit_task_traced(req, writer, headers, trace_id)
+
+    async def _submit_task_traced(
+        self,
+        req: Dict[str, Any],
+        writer: asyncio.StreamWriter,
+        headers: Optional[Dict[str, str]],
+        trace_id: str,
+    ) -> None:
         if self.serve is None:
             raise _HttpError(
                 503, "no orchestrator attached to this endpoint",
@@ -742,7 +850,7 @@ class APIServer:
             exec_task = None
             getter = None
             try:
-                await self._sse_start(writer)
+                await self._sse_start(writer, {"x-request-id": trace_id})
                 exec_task = asyncio.ensure_future(
                     self.serve.execute_task(task_obj, timeout=timeout)
                 )
@@ -788,7 +896,10 @@ class APIServer:
                 408, f"task did not complete within {timeout}s",
                 "timeout_error",
             ) from None
-        await self._send(writer, 200, result_payload(result))
+        await self._send(
+            writer, 200, result_payload(result),
+            extra_headers={"x-request-id": trace_id},
+        )
 
 
 def _parse_json(body: bytes) -> Dict[str, Any]:

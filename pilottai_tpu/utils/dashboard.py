@@ -3,12 +3,15 @@ static marketing SPA (``/root/reference/interface/src`` shows hardcoded
 stats like "10x Faster Development", ``Performance.js:8-20``; SURVEY.md
 §2.19 notes a real metrics dashboard would supersede it).
 
-Stdlib-only (http.server on a daemon thread), two routes:
+Stdlib-only (http.server on a daemon thread), three routes:
 
-* ``/metrics.json`` — the live ``global_metrics`` snapshot (counters,
-  gauges, histogram summaries) merged with the bound component's
-  ``get_metrics()`` (a ``Serve``, an ``LLMHandler`` — anything with that
-  method).
+* ``/metrics.json`` (alias ``/metrics``) — the unified snapshot
+  (``obs.metrics_snapshot``: counters, gauges, histogram summaries,
+  component ``get_metrics()``) — the SAME shape ``APIServer``'s
+  ``/metrics`` serves; add ``?format=prometheus`` for text exposition.
+* ``/trace.json`` — Chrome/Perfetto ``trace_event`` JSON of finished
+  span trees plus engine step-ring counters (``?trace_id=`` narrows to
+  one request); load it at https://ui.perfetto.dev.
 * ``/`` — a self-refreshing HTML table over the same JSON.
 
 Read-only and unauthenticated by design: bind to localhost (the default)
@@ -21,9 +24,16 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
+from urllib.parse import parse_qs
 
+from pilottai_tpu.obs import (
+    global_steps,
+    metrics_snapshot,
+    perfetto_trace,
+    prometheus_text,
+)
 from pilottai_tpu.utils.logging import get_logger
-from pilottai_tpu.utils.metrics import global_metrics
+from pilottai_tpu.utils.tracing import global_tracer
 
 _PAGE = """<!doctype html>
 <html><head><meta charset="utf-8"><title>pilottai-tpu metrics</title>
@@ -95,12 +105,31 @@ class MetricsDashboard:
                 dashboard._log.debug(fmt % args)
 
             def do_GET(self):  # noqa: N802 — http.server API
-                if self.path.split("?")[0] in ("/metrics.json", "/metrics"):
-                    body = json.dumps(
-                        dashboard.snapshot(), default=str
-                    ).encode()
+                path, _, query = self.path.partition("?")
+                params = parse_qs(query)
+                if path in ("/metrics.json", "/metrics"):
+                    if params.get("format") == ["prometheus"]:
+                        body = prometheus_text(dashboard.snapshot()).encode()
+                        ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    else:
+                        body = json.dumps(
+                            dashboard.snapshot(), default=str
+                        ).encode()
+                        ctype = "application/json"
+                elif path == "/trace.json":
+                    trace_id = (params.get("trace_id") or [None])[0]
+                    spans = (
+                        global_tracer.for_trace(trace_id)
+                        if trace_id else global_tracer.finished()
+                    )
+                    # default=str: span attributes are caller-supplied
+                    # (Tracer.span(**attrs) is public API) and one
+                    # non-serializable value must not 500 the trace view.
+                    body = json.dumps(perfetto_trace(
+                        spans, steps=global_steps.snapshot()
+                    ), default=str).encode()
                     ctype = "application/json"
-                elif self.path.split("?")[0] == "/":
+                elif path == "/":
                     body = _PAGE.encode()
                     ctype = "text/html; charset=utf-8"
                 else:
@@ -119,13 +148,8 @@ class MetricsDashboard:
         self._thread: Optional[threading.Thread] = None
 
     def snapshot(self) -> dict:
-        snap = global_metrics.snapshot()
-        if self.source is not None:
-            try:
-                snap["component"] = self.source.get_metrics()
-            except Exception as exc:  # noqa: BLE001 — metrics must not raise
-                snap["component"] = {"error": str(exc)}
-        return snap
+        # The ONE snapshot shape (shared with APIServer's /metrics).
+        return metrics_snapshot(component=self.source)
 
     def start(self) -> "MetricsDashboard":
         if self._thread is None:

@@ -38,10 +38,25 @@ class JsonFormatter(logging.Formatter):
             "logger": record.name,
             "msg": record.getMessage(),
         }
-        for key in ("agent_id", "task_id", "span_id", "component"):
+        for key in ("agent_id", "task_id", "span_id", "trace_id", "component"):
             value = getattr(record, key, None)
             if value is not None:
                 payload[key] = value
+        if "trace_id" not in payload:
+            # Correlate with the request's span tree: any log line emitted
+            # inside an active span (server request handling, handler
+            # retries, agent steps) carries that span's trace id, so one
+            # grep over trace_id follows a request across components.
+            # Lazy import: utils.logging loads before tracing in some
+            # control-plane paths and must never create a cycle.
+            try:
+                from pilottai_tpu.utils.tracing import global_tracer
+
+                span = global_tracer.current()
+                if span is not None:
+                    payload["trace_id"] = span.trace_id
+            except Exception:  # pragma: no cover — logging must not raise
+                pass
         if record.exc_info:
             payload["exc"] = self.formatException(record.exc_info)
         return json.dumps(payload, default=str)

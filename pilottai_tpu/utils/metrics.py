@@ -3,25 +3,38 @@
 The reference exposes pull-only ``get_metrics()`` dicts per component with
 no aggregation (SURVEY.md §5.5). Here one registry aggregates everything and
 is the source of the headline numbers (agent-steps/sec/chip, p50 step
-latency — BASELINE.json metric).
+latency — BASELINE.json metric) plus the request-phase histograms the
+observability layer (``pilottai_tpu/obs``) exports as Prometheus summaries.
 """
 
 from __future__ import annotations
 
-import bisect
 import threading
 import time
-from collections import defaultdict
-from typing import Any, Dict, List, Optional
+from collections import defaultdict, deque
+from typing import Any, Deque, Dict, Optional, Tuple
+
+# Longest sliding window rate() supports; counter event history is pruned
+# past it so hot counters stay O(events-in-window), not O(process-lifetime).
+_RATE_WINDOW_MAX = 300.0
 
 
 class _Histogram:
-    """Bounded reservoir of observations with percentile queries."""
+    """Bounded window of the most recent observations with percentile
+    queries, plus all-time count/total.
+
+    Percentiles are WINDOW-AWARE: ``values`` holds the last
+    ``max_samples`` observations in arrival order, so quantiles describe
+    recent behavior. (The previous design kept a sorted list and evicted
+    at a rotating *value-rank* index, which dropped arbitrary-aged
+    samples — percentiles silently mixed all-time and recent data.)
+    ``count``/``total`` (and therefore ``mean``) remain all-time.
+    """
 
     __slots__ = ("values", "count", "total", "max_samples")
 
     def __init__(self, max_samples: int = 4096) -> None:
-        self.values: List[float] = []
+        self.values: Deque[float] = deque(maxlen=max_samples)
         self.count = 0
         self.total = 0.0
         self.max_samples = max_samples
@@ -29,25 +42,32 @@ class _Histogram:
     def observe(self, value: float) -> None:
         self.count += 1
         self.total += value
-        if len(self.values) >= self.max_samples:
-            # Reservoir-style eviction keeping the list sorted: drop an
-            # element at a deterministic rotating index.
-            del self.values[self.count % self.max_samples]
-        bisect.insort(self.values, value)
+        self.values.append(value)
 
     def percentile(self, q: float) -> Optional[float]:
         if not self.values:
             return None
-        idx = min(len(self.values) - 1, int(q / 100.0 * len(self.values)))
-        return self.values[idx]
+        ordered = sorted(self.values)
+        idx = min(len(ordered) - 1, int(q / 100.0 * len(ordered)))
+        return ordered[idx]
 
     def summary(self) -> Dict[str, Any]:
+        ordered = sorted(self.values)
+
+        def pct(q: float) -> Optional[float]:
+            if not ordered:
+                return None
+            return ordered[min(len(ordered) - 1, int(q / 100.0 * len(ordered)))]
+
         return {
             "count": self.count,
             "mean": self.total / self.count if self.count else None,
-            "p50": self.percentile(50),
-            "p90": self.percentile(90),
-            "p99": self.percentile(99),
+            "p50": pct(50),
+            "p90": pct(90),
+            "p99": pct(99),
+            # Samples the percentiles above were computed over (≤
+            # max_samples; < count once eviction starts).
+            "window": len(ordered),
         }
 
 
@@ -57,13 +77,31 @@ class MetricsRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = defaultdict(float)
+        # Per-counter (timestamp, cumulative-after-inc) events for sliding
+        # window rates; pruned to _RATE_WINDOW_MAX keeping one event at or
+        # before the boundary as the window base.
+        self._events: Dict[str, Deque[Tuple[float, float]]] = {}
         self._gauges: Dict[str, float] = {}
         self._histograms: Dict[str, _Histogram] = {}
         self._started = time.time()
 
     def inc(self, name: str, value: float = 1.0) -> None:
+        now = time.time()
         with self._lock:
             self._counters[name] += value
+            ev = self._events.get(name)
+            if ev is None:
+                ev = self._events[name] = deque()
+            # Coalesce into per-second buckets: a hot counter (per-token
+            # incs at production rates) must stay O(window seconds), not
+            # O(increments) — both for memory and for rate()'s base scan.
+            if ev and int(ev[-1][0]) == int(now):
+                ev[-1] = (ev[-1][0], self._counters[name])
+            else:
+                ev.append((now, self._counters[name]))
+            cutoff = now - _RATE_WINDOW_MAX
+            while len(ev) >= 2 and ev[1][0] <= cutoff:
+                ev.popleft()
 
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
@@ -84,11 +122,32 @@ class MetricsRegistry:
     def timer(self, name: str) -> "_Timer":
         return _Timer(self, name)
 
-    def rate(self, name: str) -> float:
-        """Counter value per second since registry start."""
+    def rate(self, name: str, window: Optional[float] = 60.0) -> float:
+        """Counter value per second over the trailing ``window`` seconds
+        (capped at 300 s). The previous counter ÷ uptime-since-start
+        definition underreported current throughput after any idle
+        period; pass ``window=None`` for that all-time average.
+        """
         with self._lock:
-            elapsed = max(time.time() - self._started, 1e-9)
-            return self._counters.get(name, 0.0) / elapsed
+            now = time.time()
+            if window is None:
+                elapsed = max(now - self._started, 1e-9)
+                return self._counters.get(name, 0.0) / elapsed
+            window = min(window, _RATE_WINDOW_MAX)
+            cur = self._counters.get(name, 0.0)
+            ev = self._events.get(name)
+            if not ev:
+                return 0.0
+            cutoff = now - window
+            base = 0.0
+            for ts, cum in ev:
+                if ts > cutoff:
+                    break
+                base = cum
+            # A registry younger than the window divides by its actual
+            # age — otherwise a fresh process underreports for a minute.
+            elapsed = max(min(window, now - self._started), 1e-9)
+            return max(cur - base, 0.0) / elapsed
 
     def get(self, name: str) -> float:
         with self._lock:
@@ -105,9 +164,22 @@ class MetricsRegistry:
                 "histograms": {k: h.summary() for k, h in self._histograms.items()},
             }
 
+    def reset_histograms(self, prefix: str = "") -> None:
+        """Drop histograms whose name starts with ``prefix`` (all when
+        empty). Section-scoped measurement (bench) resets the request-
+        phase histograms between sections so each section's percentiles
+        describe ONLY its own traffic — the window alone still mixes a
+        small section with its large predecessor's samples."""
+        with self._lock:
+            for name in [
+                n for n in self._histograms if n.startswith(prefix)
+            ]:
+                del self._histograms[name]
+
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
+            self._events.clear()
             self._gauges.clear()
             self._histograms.clear()
             self._started = time.time()

@@ -38,6 +38,11 @@ class Span:
             "span_id": self.span_id,
             "parent_id": self.parent_id,
             "trace_id": self.trace_id,
+            # perf_counter timestamps: one process-wide monotonic clock,
+            # shared with the engine step ring — the Perfetto exporter
+            # relies on the two aligning.
+            "start": self.start,
+            "end": self.end,
             "duration": self.duration,
             "attributes": self.attributes,
         }
@@ -65,13 +70,26 @@ class Tracer:
         return stack[-1] if stack else None
 
     @contextlib.contextmanager
-    def span(self, name: str, device: bool = False, **attributes: Any) -> Iterator[Span]:
+    def span(
+        self,
+        name: str,
+        device: bool = False,
+        trace_id: Optional[str] = None,
+        **attributes: Any,
+    ) -> Iterator[Span]:
+        """Open a span. ``trace_id`` seeds a ROOT span's trace (the HTTP
+        edge passes the request's ``x-request-id`` here); a span with a
+        live parent always inherits the parent's trace instead — one
+        request, one trace, no matter what a nested caller passes."""
         parent = self.current()
         span = Span(
             name=name,
             span_id=uuid.uuid4().hex[:16],
             parent_id=parent.span_id if parent else None,
-            trace_id=parent.trace_id if parent else uuid.uuid4().hex[:16],
+            trace_id=(
+                parent.trace_id if parent
+                else (trace_id or uuid.uuid4().hex[:16])
+            ),
             attributes=attributes,
         )
         token = self._stack_var.set(self._stack_var.get() + (span,))
@@ -94,12 +112,49 @@ class Tracer:
                 if len(self._finished) > self._max_finished:
                     del self._finished[: len(self._finished) // 2]
 
+    def emit(
+        self,
+        name: str,
+        *,
+        trace_id: str,
+        start: float,
+        end: float,
+        parent_id: Optional[str] = None,
+        **attributes: Any,
+    ) -> Span:
+        """Record an already-finished span directly. For code that runs
+        outside any task context (the batcher's device/reader threads,
+        where the contextvar stack doesn't propagate): the engine emits
+        its per-request span at completion time with the parent span id
+        the request carried in, so the request's tree still nests
+        server → handler → batcher."""
+        span = Span(
+            name=name,
+            span_id=uuid.uuid4().hex[:16],
+            parent_id=parent_id,
+            trace_id=trace_id,
+            start=start,
+            end=end,
+            attributes=attributes,
+        )
+        with self._lock:
+            self._finished.append(span)
+            if len(self._finished) > self._max_finished:
+                del self._finished[: len(self._finished) // 2]
+        return span
+
     def finished(self, name: Optional[str] = None) -> List[Span]:
         with self._lock:
             spans = list(self._finished)
         if name is not None:
             spans = [s for s in spans if s.name == name]
         return spans
+
+    def for_trace(self, trace_id: str) -> List[Span]:
+        """Every finished span of one trace, in finish order (a flight
+        recorder dump wants exactly this tree)."""
+        with self._lock:
+            return [s for s in self._finished if s.trace_id == trace_id]
 
     def clear(self) -> None:
         with self._lock:

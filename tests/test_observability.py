@@ -1,0 +1,679 @@
+"""Observability layer tests: flight recorder, step ring, trace-id
+propagation, Prometheus/Perfetto exporters and black-box dumps
+(pilottai_tpu/obs + the metrics/tracing/logging satellites)."""
+
+import asyncio
+import json
+import logging
+import re
+import time
+from collections import deque
+
+import pytest
+
+from pilottai_tpu.core.config import LLMConfig
+from pilottai_tpu.engine.handler import LLMHandler
+from pilottai_tpu.engine.mock import MockBackend
+from pilottai_tpu.engine.types import GenerationParams
+from pilottai_tpu.obs import (
+    FlightRecorder,
+    StepRing,
+    global_blackbox,
+    global_flight,
+    global_steps,
+    metrics_snapshot,
+    perfetto_trace,
+    phase_summary,
+    prometheus_text,
+)
+from pilottai_tpu.reliability import DeadlineExceeded, inject
+from pilottai_tpu.server import APIServer
+from pilottai_tpu.utils.metrics import MetricsRegistry, _Histogram
+from pilottai_tpu.utils.tracing import Tracer, global_tracer
+
+from tests.test_server import _request
+
+
+def _mock_handler(**mock_kwargs) -> LLMHandler:
+    return LLMHandler(
+        LLMConfig(provider="mock", model_name="mock-1"),
+        backend=MockBackend(**mock_kwargs),
+    )
+
+
+# ---------------------------------------------------------------------- #
+# Satellite: metrics fixes
+# ---------------------------------------------------------------------- #
+
+
+def test_rate_sliding_window_vs_all_time():
+    """rate() defaults to a trailing window: a counter whose traffic all
+    landed recently reports CURRENT throughput, not counter ÷ uptime."""
+    reg = MetricsRegistry()
+    reg._started = time.time() - 1000.0  # long-idle process
+    reg.inc("win.counter", 100)          # burst arriving now
+    legacy = reg.rate("win.counter", window=None)
+    recent = reg.rate("win.counter", window=60.0)
+    assert legacy < 0.2                  # 100 / ~1000 s — the old bug
+    assert recent > 1.0                  # 100 / 60 s — actual throughput
+
+    # Traffic that STOPPED also reads as stopped: age the events past
+    # the window and the rate returns to ~0 instead of a stale average.
+    reg._events["win.counter"] = deque(
+        (ts - 200.0, cum) for ts, cum in reg._events["win.counter"]
+    )
+    assert reg.rate("win.counter", window=60.0) == 0.0
+
+
+def test_rate_young_registry_divides_by_age():
+    reg = MetricsRegistry()
+    reg.inc("young", 10)
+    # Registry is ~0 s old: dividing by the full 60 s window would
+    # underreport; dividing by age reports the actual burst rate.
+    assert reg.rate("young", window=60.0) > 10.0
+
+
+def test_histogram_percentiles_are_window_aware():
+    h = _Histogram(max_samples=100)
+    for v in range(1000):
+        h.observe(float(v))
+    s = h.summary()
+    assert s["count"] == 1000            # all-time
+    assert s["window"] == 100            # percentile basis
+    # Only the most recent 100 samples (900..999) back the percentiles —
+    # the old rotating-index eviction left arbitrary-aged values mixed in.
+    assert s["p50"] >= 900
+    assert s["p99"] >= 990
+    assert h.percentile(0) >= 900
+
+
+# ---------------------------------------------------------------------- #
+# Tracer: parentage, explicit trace ids, direct emission
+# ---------------------------------------------------------------------- #
+
+
+@pytest.mark.asyncio
+async def test_tracer_parentage_under_interleaved_tasks():
+    """Two asyncio tasks interleaving awaits inside nested spans must
+    each see their OWN stack: children parent to their task's root, and
+    the two tasks' trace ids stay distinct."""
+    tracer = Tracer()
+    roots = {}
+
+    async def worker(name):
+        with tracer.span(f"root.{name}") as root:
+            roots[name] = root
+            await asyncio.sleep(0.01)
+            with tracer.span(f"child.{name}") as child:
+                await asyncio.sleep(0.01)
+                assert child.parent_id == root.span_id
+                assert child.trace_id == root.trace_id
+
+    await asyncio.gather(worker("a"), worker("b"))
+    assert roots["a"].trace_id != roots["b"].trace_id
+    for name in ("a", "b"):
+        child = tracer.finished(f"child.{name}")[0]
+        assert child.parent_id == roots[name].span_id
+
+
+def test_tracer_explicit_trace_id_and_emit():
+    tracer = Tracer()
+    with tracer.span("root", trace_id="fixed-id") as root:
+        # A nested span inherits the parent's trace even when handed a
+        # different explicit id — one request, one trace.
+        with tracer.span("child", trace_id="other-id") as child:
+            pass
+    assert root.trace_id == "fixed-id"
+    assert child.trace_id == "fixed-id"
+
+    emitted = tracer.emit(
+        "engine.batch_decode", trace_id="fixed-id",
+        parent_id=child.span_id, start=child.start, end=child.end or 0.0,
+        tokens=4,
+    )
+    spans = tracer.for_trace("fixed-id")
+    assert {s.name for s in spans} == {"root", "child", "engine.batch_decode"}
+    assert emitted.attributes["tokens"] == 4
+
+
+# ---------------------------------------------------------------------- #
+# Exporters
+# ---------------------------------------------------------------------- #
+
+
+def test_perfetto_export_round_trip():
+    tracer = Tracer()
+    with tracer.span("server.request", trace_id="pft-1"):
+        with tracer.span("engine.generate"):
+            time.sleep(0.002)
+    ring = StepRing()
+    ring.record("engine.chunk", tokens=7, slots_active=2, queue_depth=0,
+                kv_pages_free=10)
+    ring.record("engine.admit", n=2, slots_active=2, queue_depth=1)
+
+    doc = json.loads(json.dumps(  # round-trip: valid trace_event JSON
+        perfetto_trace(tracer.for_trace("pft-1"), steps=ring.snapshot())
+    ))
+    events = doc["traceEvents"]
+    slices = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in slices} == {"server.request", "engine.generate"}
+    for e in slices:
+        assert set(e) >= {"name", "ph", "ts", "dur", "pid", "tid"}
+    # Nesting preserved: the child slice is contained in the parent's
+    # [ts, ts+dur] on the same track — how Perfetto reconstructs trees.
+    parent = next(e for e in slices if e["name"] == "server.request")
+    child = next(e for e in slices if e["name"] == "engine.generate")
+    assert child["tid"] == parent["tid"]
+    assert child["ts"] >= parent["ts"]
+    assert child["ts"] + child["dur"] <= parent["ts"] + parent["dur"] + 1e-3
+    # Engine steps ride along as counter tracks.
+    counters = [e for e in events if e["ph"] == "C"]
+    assert any(e["name"] == "engine/tokens" for e in counters)
+
+
+def test_prometheus_exposition_parseable():
+    reg = MetricsRegistry()
+    reg.inc("engine.requests", 5)
+    reg.set_gauge("engine.slots_active", 3)
+    for v in (0.1, 0.2, 0.3):
+        reg.observe("request.ttft_s", v)
+    text = prometheus_text(
+        metrics_snapshot(component={"requests": 5, "nested": {"x": 1.5}},
+                         registry=reg)
+    )
+    sample = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.e+-]+$"
+    )
+    lines = [ln for ln in text.strip().split("\n") if ln]
+    assert lines
+    for line in lines:
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            assert len(parts) == 4 and parts[3] in (
+                "counter", "gauge", "summary"
+            ), line
+        else:
+            assert sample.match(line), line
+    assert 'pilottai_request_ttft_s{quantile="0.5"}' in text
+    assert "pilottai_request_ttft_s_count 3.0" in text
+    assert "pilottai_engine_requests 5.0" in text
+    assert "pilottai_component_nested_x 1.5" in text
+
+
+# ---------------------------------------------------------------------- #
+# Flight recorder
+# ---------------------------------------------------------------------- #
+
+
+def test_flight_recorder_phase_ledger():
+    reg = MetricsRegistry()
+    rec = FlightRecorder(registry=reg)
+    rec.start("f1", model="m")
+    rec.mark("f1", "admitted")
+    rec.token("f1", 1)
+    time.sleep(0.005)
+    rec.token("f1", 4)
+    summary = rec.finish("f1", "ok")
+    assert summary["tokens"] == 5
+    assert summary["queue_wait_s"] >= 0
+    assert summary["ttft_s"] >= 0
+    assert summary["tpot_s"] > 0
+    hists = reg.snapshot()["histograms"]
+    for name in ("request.ttft_s", "request.tpot_s", "request.itl_s",
+                 "request.e2e_s", "request.queue_wait_s"):
+        assert hists[name]["count"] >= 1, name
+    # Double-finish and unknown ids are safe no-ops.
+    assert rec.finish("f1") is None
+    assert rec.finish("never-started") is None
+    rec.token("never-started", 3)
+    # The finished ring still describes the flight for dumps.
+    assert rec.describe("f1")["status"] == "ok"
+
+
+@pytest.mark.asyncio
+async def test_concurrent_same_trace_calls_get_separate_flights():
+    """Orchestrator fan-out: concurrent engine calls sharing one ambient
+    trace must keep SEPARATE phase ledgers (flight_id), not merge into
+    one blended TTFT/e2e record (review regression)."""
+    handler = _mock_handler(latency=0.01)
+    with global_tracer.span("serve.execute_task", trace_id="fanout-t1"):
+        await asyncio.gather(*[
+            handler.apredict(f"subtask {i}") for i in range(3)
+        ])
+    flights = [
+        r for r in global_flight.finished() if r["trace_id"] == "fanout-t1"
+    ]
+    assert len(flights) == 3
+    assert len({f["flight_id"] for f in flights}) == 3
+    assert all(f["status"] == "ok" and f["tokens"] >= 1 for f in flights)
+
+
+def test_failed_flights_do_not_pollute_latency_histograms():
+    """Shed/fast-fail flights are counted, not timed: an overload storm
+    of ~0 ms sheds must not drag the window-aware e2e percentiles toward
+    zero mid-outage (review regression)."""
+    reg = MetricsRegistry()
+    rec = FlightRecorder(registry=reg)
+    rec.start("ok-1")
+    rec.token("ok-1", 2)
+    time.sleep(0.002)
+    rec.finish("ok-1", "ok")
+    for i in range(50):
+        rec.start(f"shed-{i}")
+        rec.finish(f"shed-{i}", "shed")
+    snap = reg.snapshot()
+    assert snap["histograms"]["request.e2e_s"]["count"] == 1  # ok only
+    assert snap["counters"]["request.failed"] == 50
+    assert snap["counters"]["request.finished.shed"] == 50
+
+
+def test_step_ring_bounded_and_ordered():
+    ring = StepRing(capacity=8)
+    for i in range(20):
+        ring.record("engine.chunk", tokens=i)
+    snap = ring.snapshot()
+    assert len(snap) == 8 and len(ring) == 8
+    assert [r["tokens"] for r in snap] == list(range(12, 20))
+    assert snap[-1]["seq"] == 20
+    assert ring.snapshot(3) == snap[-3:]
+
+
+@pytest.mark.asyncio
+async def test_ttft_tpot_percentiles_from_mock_engine_run():
+    """A mock-engine run (no batcher, envelope-synthesized tokens) still
+    yields TTFT/TPOT percentile surfaces from MetricsRegistry."""
+    from pilottai_tpu.utils.metrics import global_metrics
+
+    before = global_metrics.snapshot()["histograms"]
+    n_before = (before.get("request.ttft_s") or {}).get("count", 0)
+    handler = _mock_handler(latency=0.002)
+    for i in range(4):
+        await handler.apredict(f"measure ttft {i}")
+    hists = global_metrics.snapshot()["histograms"]
+    for name in ("request.ttft_s", "request.tpot_s", "request.e2e_s"):
+        assert hists[name]["count"] >= n_before + 4, name
+        assert hists[name]["p50"] is not None
+        assert hists[name]["p99"] is not None
+    assert phase_summary()["ttft"]["p50_ms"] is not None
+
+
+# ---------------------------------------------------------------------- #
+# HTTP edge: trace ids, unified snapshot, Prometheus format
+# ---------------------------------------------------------------------- #
+
+
+@pytest.mark.asyncio
+async def test_stream_flight_status_unpoisoned_by_handled_exception():
+    """A successful astream consumed INSIDE an except block must finish
+    its flight as ok: an async generator's finally can see the consumer
+    frame's already-handled exception via sys.exc_info(), which used to
+    misclassify the retry as a deadline failure (review regression)."""
+    handler = _mock_handler(script=["first try", "retry works"])
+    params = GenerationParams(trace_id="retry-after-deadline-1")
+    try:
+        raise DeadlineExceeded("first attempt blew its budget")
+    except DeadlineExceeded:
+        # Retry while the handled exception is still "current".
+        chunks = [d async for d in handler.astream(
+            "retry please", params=params.model_copy(
+                update={"trace_id": "retry-after-deadline-2"}
+            ),
+        )]
+    assert "".join(chunks)
+    flight = next(
+        r for r in reversed(global_flight.finished())
+        if r["trace_id"] == "retry-after-deadline-2"
+    )
+    assert flight["status"] == "ok"
+    # And no spurious deadline dump was recorded for it.
+    assert not any(
+        r["trace_id"] == "retry-after-deadline-2"
+        for r in global_blackbox.recent()
+    )
+
+
+@pytest.mark.asyncio
+async def test_ambient_span_trace_adopted_for_direct_calls():
+    """Orchestrator-driven engine calls (no HTTP edge) join the ambient
+    span's trace instead of splitting the request across two ids."""
+    handler = _mock_handler()
+    with global_tracer.span("serve.execute_task", trace_id="ambient-t1"):
+        await handler.apredict("do the thing")
+    names = {s.name for s in global_tracer.for_trace("ambient-t1")}
+    assert "engine.generate" in names
+    assert any(
+        r["trace_id"] == "ambient-t1" for r in global_flight.finished()
+    )
+
+
+@pytest.mark.asyncio
+async def test_server_request_id_roundtrip_and_span_tree():
+    server = await APIServer(_mock_handler()).start()
+    try:
+        status, hdrs, _ = await _request(
+            server.port, "POST", "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "hello"}]},
+        )
+        assert status == 200
+        rid = hdrs["x-request-id"]  # server minted one
+        assert re.fullmatch(r"[0-9a-f]{16}", rid)
+
+        # Client-supplied ids are accepted and echoed...
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", server.port
+        )
+        body = json.dumps(
+            {"messages": [{"role": "user", "content": "hi"}]}
+        ).encode()
+        writer.write(
+            f"POST /v1/chat/completions HTTP/1.1\r\nHost: t\r\n"
+            f"x-request-id: my-req.01\r\nContent-Length: {len(body)}\r\n"
+            f"Connection: close\r\n\r\n".encode() + body
+        )
+        await writer.drain()
+        raw = await reader.read()
+        writer.close()
+        await writer.wait_closed()
+        assert b"x-request-id: my-req.01" in raw
+
+        # ...and the span tree nests server.request -> engine.generate
+        # under that exact trace id.
+        spans = global_tracer.for_trace("my-req.01")
+        root = next(s for s in spans if s.name == "server.request")
+        gen = next(s for s in spans if s.name == "engine.generate")
+        assert root.parent_id is None
+        assert gen.parent_id == root.span_id
+
+        # A hostile header (newline injection, oversize) is replaced.
+        status, hdrs, _ = await _request(
+            server.port, "POST", "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "x"}]},
+            token=None,
+        )
+        assert status == 200
+    finally:
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_server_metrics_unified_and_prometheus():
+    server = await APIServer(_mock_handler()).start()
+    try:
+        await _request(
+            server.port, "POST", "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "warm the metrics"}]},
+        )
+        # JSON: the unified snapshot shape (dashboard parity) + the
+        # back-compat "handler" alias.
+        status, _, body = await _request(server.port, "GET", "/metrics")
+        assert status == 200
+        snap = json.loads(body)
+        assert {"uptime_s", "counters", "gauges", "histograms",
+                "component", "handler"} <= set(snap)
+        assert snap["handler"] == snap["component"]
+
+        # Prometheus: parseable and carrying the ttft/tpot summaries.
+        status, hdrs, body = await _request(
+            server.port, "GET", "/metrics?format=prometheus"
+        )
+        assert status == 200
+        assert hdrs["content-type"].startswith("text/plain")
+        text = body.decode()
+        assert "pilottai_request_ttft_s" in text
+        assert "pilottai_request_tpot_s" in text
+        sample = re.compile(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.e+-]+$"
+        )
+        for line in text.strip().split("\n"):
+            assert line.startswith("# TYPE ") or sample.match(line), line
+    finally:
+        await server.stop()
+
+
+def test_dashboard_prometheus_and_trace_export():
+    import urllib.request
+
+    from pilottai_tpu.utils.dashboard import MetricsDashboard
+    from pilottai_tpu.utils.metrics import global_metrics
+
+    global_metrics.inc("dash.obs_counter", 2)
+    with global_tracer.span("server.request", trace_id="dash-trace-1"):
+        with global_tracer.span("engine.generate"):
+            pass
+    d = MetricsDashboard(port=0).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{d.port}/metrics.json?format=prometheus",
+            timeout=10,
+        ) as r:
+            text = r.read().decode()
+            assert r.headers.get_content_type() == "text/plain"
+        assert "pilottai_dash_obs_counter" in text
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{d.port}/trace.json?trace_id=dash-trace-1",
+            timeout=10,
+        ) as r:
+            doc = json.loads(r.read())
+        names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+        assert names == {"server.request", "engine.generate"}
+    finally:
+        d.stop()
+
+
+# ---------------------------------------------------------------------- #
+# Satellite: structured log correlation
+# ---------------------------------------------------------------------- #
+
+
+def test_log_records_carry_trace_id_from_active_span():
+    from pilottai_tpu.utils.logging import JsonFormatter
+
+    fmt = JsonFormatter()
+
+    def make_record():
+        return logging.LogRecord(
+            "pilottai_tpu.engine.handler", logging.INFO, __file__, 1,
+            "retrying request", (), None,
+        )
+
+    with global_tracer.span("server.request", trace_id="log-trace-9"):
+        line = json.loads(fmt.format(make_record()))
+    assert line["trace_id"] == "log-trace-9"
+    # Outside any span: no trace_id key, no crash.
+    line = json.loads(fmt.format(make_record()))
+    assert "trace_id" not in line
+
+
+# ---------------------------------------------------------------------- #
+# Black-box dumps
+# ---------------------------------------------------------------------- #
+
+
+def test_breaker_open_fires_blackbox_hook():
+    from pilottai_tpu.reliability import CircuitBreaker
+
+    opened = []
+    breaker = CircuitBreaker(failure_threshold=2, name="bb-test")
+    breaker.on_open = opened.append
+    breaker.record_failure()
+    assert opened == []
+    breaker.record_failure()
+    assert opened == ["bb-test"]
+    # The handler wires the hook to the black-box dumper by default.
+    handler = _mock_handler()
+    assert handler.breaker is not None and handler.breaker.on_open is not None
+
+
+@pytest.mark.asyncio
+async def test_blackbox_dump_on_injected_deadline_fault(tmp_path):
+    """Acceptance path: a request through APIServer under an injected
+    ``handler.timeout`` fault expires its deadline and leaves a journal
+    black-box dump — last engine steps + the request's trace id."""
+    from pilottai_tpu.checkpoint.journal import BlackBoxJournal
+
+    dump_path = tmp_path / "blackbox.jsonl"
+    global_blackbox.configure(str(dump_path))
+    server = await APIServer(_mock_handler()).start()
+    try:
+        # A healthy request first: populates the step ring, so the dump
+        # has engine history to replay.
+        status, _, _ = await _request(
+            server.port, "POST", "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "healthy one"}]},
+        )
+        assert status == 200
+        assert any(
+            r["kind"] == "handler.request" for r in global_steps.snapshot()
+        )
+
+        with inject("handler.timeout", exc=asyncio.TimeoutError, times=None):
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", server.port
+            )
+            body = json.dumps({
+                "messages": [{"role": "user", "content": "doomed"}],
+                "timeout": 0.25,
+            }).encode()
+            writer.write(
+                f"POST /v1/chat/completions HTTP/1.1\r\nHost: t\r\n"
+                f"x-request-id: doomed-req-1\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"Connection: close\r\n\r\n".encode() + body
+            )
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+            await writer.wait_closed()
+        status = int(raw.split(b" ", 2)[1])
+        assert status == 408  # deadline exceeded -> timeout_error
+
+        global_blackbox.flush()  # journal writes ride a background thread
+        records = BlackBoxJournal.read(dump_path)
+        dumps = [r for r in records if r["trace_id"] == "doomed-req-1"]
+        assert dumps, records
+        dump = dumps[0]
+        assert dump["reason"] == "deadline_expired"
+        assert dump["ev"] == "blackbox"
+        # Last engine steps captured (the healthy request's handler step
+        # at minimum) and the flight ledger closed as deadline.
+        assert any(s["kind"] == "handler.request" for s in dump["steps"])
+        assert dump["flight"]["status"] == "deadline"
+        # The dump's span list is the request's own tree.
+        assert all(s["trace_id"] == "doomed-req-1" for s in dump["spans"])
+
+        # Deduplication: the same (reason, trace) never dumps twice.
+        assert global_blackbox.dump(
+            "deadline_expired", trace_id="doomed-req-1"
+        ) is None
+    finally:
+        await server.stop()
+        global_blackbox.disable()
+
+
+# ---------------------------------------------------------------------- #
+# Native CPU engine: real TTFT/ITL marks, batcher span, expiry dump
+# ---------------------------------------------------------------------- #
+
+
+@pytest.mark.asyncio
+async def test_native_engine_span_tree_ring_and_expiry_dump(tmp_path):
+    """One CPU-engine boot covers the native-path story: server →
+    handler → batcher span nesting under one x-request-id, real
+    token-level flight marks, engine.chunk ring records, and a
+    mid-decode deadline expiry black-box dump from the batcher."""
+    from pilottai_tpu.engine.batcher import GenRequest
+
+    global_blackbox.configure(str(tmp_path / "native_blackbox.jsonl"))
+    handler = LLMHandler(LLMConfig(
+        model_name="llama-tiny", provider="cpu",
+        engine_slots=2, engine_max_seq=128, engine_chunk=4,
+    ))
+    server = await APIServer(handler).start()
+    try:
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", server.port
+        )
+        body = json.dumps({
+            "messages": [{"role": "user", "content": "trace me"}],
+            "max_tokens": 12, "temperature": 0,
+        }).encode()
+        writer.write(
+            f"POST /v1/chat/completions HTTP/1.1\r\nHost: t\r\n"
+            f"x-request-id: native-trace-1\r\n"
+            f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+            .encode() + body
+        )
+        await writer.drain()
+        raw = await reader.read()
+        writer.close()
+        await writer.wait_closed()
+        assert int(raw.split(b" ", 2)[1]) == 200
+
+        # Span tree: server.request -> engine.generate -> engine.batch_decode,
+        # the batcher's span emitted from its reader thread with an
+        # explicit parent.
+        spans = global_tracer.for_trace("native-trace-1")
+        root = next(s for s in spans if s.name == "server.request")
+        gen = next(s for s in spans if s.name == "engine.generate")
+        batch = next(s for s in spans if s.name == "engine.batch_decode")
+        assert gen.parent_id == root.span_id
+        assert batch.parent_id == gen.span_id
+        assert batch.attributes["tokens"] >= 1
+
+        # Perfetto export of the full tree stays loadable JSON.
+        doc = json.loads(json.dumps(perfetto_trace(
+            spans, steps=global_steps.snapshot()
+        )))
+        assert len([e for e in doc["traceEvents"] if e["ph"] == "X"]) == 3
+
+        # The ring saw real engine activity.
+        kinds = {r["kind"] for r in global_steps.snapshot()}
+        assert {"engine.admit", "engine.chunk"} <= kinds
+        chunk = next(
+            r for r in reversed(global_steps.snapshot())
+            if r["kind"] == "engine.chunk"
+        )
+        assert {"slots_active", "tokens", "queue_depth",
+                "pipeline_depth", "page_strip"} <= set(chunk)
+
+        # Real token-level phases (not envelope-synthesized): the flight
+        # recorded admission and per-token marks from the batcher.
+        flight = next(
+            r for r in reversed(global_flight.finished())
+            if r["trace_id"] == "native-trace-1"
+        )
+        assert flight["status"] == "ok"
+        assert flight["tokens"] >= 1
+        assert "queue_wait_s" in flight and "ttft_s" in flight
+        assert "admitted" in flight["marks"]
+
+        # Mid-decode expiry: submit straight to the batcher (bypassing
+        # the handler's own deadline watchdog) with a chunk dispatch
+        # slowed past the deadline — the device loop's sweep must
+        # force-release the slot, emit the span and write the dump.
+        batcher = handler.backend.batcher
+        req = GenRequest(
+            prompt_ids=list(range(2, 34)), max_new_tokens=64,
+            deadline=time.monotonic() + 0.25,
+            trace_id="native-expired-1",
+        )
+        with inject("engine.step", delay=0.6, times=1):
+            fut = batcher.submit(req)
+            with pytest.raises(DeadlineExceeded):
+                fut.result(timeout=30)
+        deadline = time.monotonic() + 10
+        dump = None
+        while dump is None and time.monotonic() < deadline:
+            dump = next(
+                (r for r in global_blackbox.recent()
+                 if r["trace_id"] == "native-expired-1"), None,
+            )
+            await asyncio.sleep(0.05)
+        assert dump is not None
+        assert dump["reason"] == "deadline_expired"
+        assert any(s["kind"] == "engine.chunk" for s in dump["steps"])
+    finally:
+        await server.stop()
+        await handler.stop()
+        global_blackbox.disable()
