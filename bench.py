@@ -110,6 +110,16 @@ async def bench_model(cfg, concurrency, steps, epochs, n_chips=1,
     for _ in range(2):
         await asyncio.gather(*[one_step() for _ in range(concurrency)])
 
+    # Chunk-utilization counters are cumulative — snapshot AFTER the
+    # warmup waves (the engine's lazy boot runs its bucket compile
+    # sweep inside the first one, and those 2-token probes would bias
+    # the section's useful/dispatched ratio far below steady state).
+    blocks0 = (
+        _gm.get("engine.blocks_dispatched"),
+        _gm.get("engine.blocks_useful"),
+        _gm.get("engine.chunk_folds"),
+    )
+
     async def epoch():
         latencies = []
         done = 0
@@ -187,6 +197,9 @@ async def bench_model(cfg, concurrency, steps, epochs, n_chips=1,
     from pilottai_tpu.obs import phase_summary
 
     phases = phase_summary()
+    blocks_disp = _gm.get("engine.blocks_dispatched") - blocks0[0]
+    blocks_used = _gm.get("engine.blocks_useful") - blocks0[1]
+    n_folds = _gm.get("engine.chunk_folds") - blocks0[2]
 
     await handler.stop()
     del handler
@@ -244,6 +257,17 @@ async def bench_model(cfg, concurrency, steps, epochs, n_chips=1,
         # Section-pure: the request-phase histograms were reset at this
         # section's start, so counts and percentiles cover only it.
         "phases": phases,
+        # Adaptive-chunk scheduling outcome for this section: useful
+        # decode blocks ÷ dispatched blocks, and the mean per-dispatch
+        # chunk size the policy actually picked.
+        "chunk_policy": cfg.engine_chunk_policy,
+        "chunk_utilization": (
+            round(blocks_used / blocks_disp, 4) if blocks_disp else None
+        ),
+        "chunk_blocks_dispatched": int(blocks_disp),
+        "chunk_blocks_mean": (
+            round(blocks_disp / n_folds, 2) if n_folds else None
+        ),
         **(device or {}),
     }
 

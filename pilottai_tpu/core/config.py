@@ -138,6 +138,29 @@ class LLMConfig(BaseModel):
     engine_admit_batch: int = Field(default=8, ge=1)
     engine_max_seq: Optional[int] = None             # KV length cap (default model max)
     engine_chunk: int = Field(default=16, ge=1)      # decode tokens per dispatch
+    # Chunk-length scheduling (engine/batcher.py:_pick_chunk_blocks):
+    # "adaptive" sizes each decode dispatch from the live slots'
+    # remaining-token budgets, deadline budgets and the speculation
+    # acceptance EMA, quantized to engine_chunk_buckets — finished slots
+    # fold (and release their pages) at the earliest useful boundary
+    # instead of riding out the straggler's full chunk. "fixed" restores
+    # the constant engine_chunk dispatch. Greedy output is byte-identical
+    # either way (tests/test_adaptive_chunk.py).
+    engine_chunk_policy: str = Field(default="adaptive")
+    # Adaptive dispatch sizes (blocks). None = a quartile ladder of
+    # engine_chunk ({4, 8, 12, 16} at the default 16). The ladder is the
+    # compile-cache bound: one decode executable per bucket per
+    # prefix-bound rung, all compiled at warmup.
+    engine_chunk_buckets: Optional[List[int]] = None
+
+    @field_validator("engine_chunk_policy")
+    @classmethod
+    def _valid_chunk_policy(cls, v: str) -> str:
+        if v not in ("fixed", "adaptive"):
+            raise ValueError(
+                "engine_chunk_policy must be 'fixed' or 'adaptive'"
+            )
+        return v
     # Decode dispatch pipeline depth: chunks in flight before the device
     # thread blocks on the reader. Each extra level hides one
     # host↔device round trip behind compute — the lever when the chip

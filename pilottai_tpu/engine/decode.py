@@ -847,7 +847,7 @@ def decode_chunk_spec(
     kernel streams each block's D queries against the slot's pages
     (``q_blocks``), or the XLA fallback materializes bounded dense
     panels once per chunk (pool contents are frozen during the scan)."""
-    from pilottai_tpu.engine.sampling import _apply_json_mask, _advance_json
+    from pilottai_tpu.engine.sampling import _advance_json, fused_verify_rows
 
     B = dstate.tokens.shape[0]
     D = draft_len
@@ -1004,20 +1004,14 @@ def decode_chunk_spec(
         # Rows 1..D-1: masked greedy with coords advanced along the DRAFT
         # path (rows only matter while drafts keep being accepted, and
         # then draft == emitted, so the draft-path coords are the right
-        # ones).
-        g_rows = [tok0]
-        coords = pre_row0
-        for j in range(1, D):
-            coords = _advance_json(
-                coords, blk[:, j], json_tables, schema_tables
-            )
-            row = _apply_json_mask(
-                logits[:, j], coords,
-                remaining=budget - j, token_tables=json_tables,
-                schema_tables=schema_tables,
-            )
-            g_rows.append(jnp.argmax(row, axis=-1).astype(jnp.int32))
-        emitted = jnp.stack(g_rows, axis=1)               # [B, D]
+        # ones). One fused mask+argmax across all verify rows — the
+        # per-row dispatch loop was the sampler small-op floor
+        # (sampling.fused_verify_rows; byte-identical per row).
+        verify = fused_verify_rows(
+            logits[:, 1:], blk[:, 1:], pre_row0, budget,
+            token_tables=json_tables, schema_tables=schema_tables,
+        )
+        emitted = jnp.concatenate([tok0[:, None], verify], axis=1)  # [B, D]
 
         # Leading-match acceptance (greedy slots only).
         match = emitted[:, : D - 1] == blk[:, 1:]         # [B, D-1]

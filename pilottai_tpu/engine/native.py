@@ -217,6 +217,11 @@ class NativeEngine(LLMBackend):
             max_seq_len=max_seq,
             cache_dtype=self.model_cfg.dtype,
             chunk_size=self.config.engine_chunk,
+            chunk_policy=self.config.engine_chunk_policy,
+            chunk_buckets=(
+                tuple(self.config.engine_chunk_buckets)
+                if self.config.engine_chunk_buckets else None
+            ),
             on_tpu=(self.platform != "cpu" and devices[0].platform == "tpu"),
             mesh=self.mesh,
             paged=paged,

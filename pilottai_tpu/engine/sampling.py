@@ -185,6 +185,57 @@ def _advance_json(
     )
 
 
+def fused_verify_rows(
+    logits: jax.Array,        # [B, D-1, V] verify rows 1..D-1 of a block
+    draft_tokens: jax.Array,  # [B, D-1] the draft path those rows follow
+    state: SamplingState,     # coords BEFORE the block's row-0 sample
+    budget: jax.Array,        # [B] remaining budget entering the block
+    token_tables: tuple[jax.Array, jax.Array] | None = None,
+    schema_tables: tuple[jax.Array, jax.Array, jax.Array] | None = None,
+) -> jax.Array:
+    """Masked-greedy verify rows for one speculative block as ONE
+    vectorized mask+argmax over all D-1 rows.
+
+    Byte-identical to the per-row loop it replaces (advance coords by
+    draft token j, mask row j with ``remaining = budget - j``, argmax):
+    the JSON-coordinate chain — a few [B] table lookups per row, cheap
+    and inherently sequential — still walks the draft path row by row,
+    but the expensive part (the [B, V] grammar/schema mask build and
+    the argmax, previously one dispatch per row) flattens the (slot,
+    row) pair into the batch axis and runs once per block. At D=6 that
+    cuts five mask+argmax dispatches per verify block to one — the
+    small-op sampler floor the r6 profile measured at ~2.3 ms/block.
+
+    Returns the greedy rows ``[B, D-1] int32``."""
+    B, Dm1, V = logits.shape
+    states, stacks, depths = [], [], []
+    coords = state
+    for j in range(Dm1):
+        coords = _advance_json(
+            coords, draft_tokens[:, j], token_tables, schema_tables
+        )
+        states.append(coords.json_state)
+        stacks.append(coords.json_stack)
+        depths.append(coords.json_depth)
+    # Flatten (b, j) row-major to match logits.reshape(B * Dm1, V).
+    flat = state._replace(
+        json_state=jnp.stack(states, axis=1).reshape(-1),
+        json_stack=jnp.stack(stacks, axis=1).reshape(-1),
+        json_depth=jnp.stack(depths, axis=1).reshape(-1),
+        json_enabled=jnp.repeat(state.json_enabled, Dm1),
+        json_schema_id=jnp.repeat(state.json_schema_id, Dm1),
+        eos_id=jnp.repeat(state.eos_id, Dm1),
+    )
+    remaining = (
+        budget[:, None] - (jnp.arange(Dm1, dtype=budget.dtype)[None, :] + 1)
+    ).reshape(-1)
+    masked = _apply_json_mask(
+        logits.reshape(B * Dm1, V), flat, remaining,
+        token_tables, schema_tables,
+    )
+    return jnp.argmax(masked, axis=-1).astype(jnp.int32).reshape(B, Dm1)
+
+
 def sample_core(
     logits: jax.Array,  # [B, V] fp32
     state: SamplingState,

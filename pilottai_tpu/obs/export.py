@@ -137,6 +137,7 @@ _ENGINE_PID = 2
 # Step-record fields exported as counter tracks.
 _STEP_COUNTERS = (
     "slots_active", "tokens", "queue_depth", "kv_pages_free",
+    "chunk_blocks", "utilization",
 )
 
 

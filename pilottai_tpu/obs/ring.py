@@ -10,8 +10,11 @@ context a postmortem needs and exactly what process logs lose.
 Record shape (by ``kind``):
 
 ``engine.chunk``   one fused decode chunk folded on the host — slot
-                   occupancy, tokens landed, queue depth, KV page-pool
-                   utilization, active strip width, pipeline depth.
+                   occupancy, tokens landed, dispatched block count
+                   (``chunk_blocks``, the adaptive scheduler's per-
+                   dispatch pick) and useful-block utilization, queue
+                   depth, KV page-pool utilization, active strip width,
+                   pipeline depth.
 ``engine.admit``   one admission wave — group size, queue depth.
 ``engine.shed``    an admission-control shed.
 ``handler.request`` one completed/failed LLMHandler request — status,

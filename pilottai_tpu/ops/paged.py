@@ -163,6 +163,11 @@ class PageAllocator:
         self.table[slot, : len(held)] = held
         return True
 
+    def holds(self, slot: int) -> bool:
+        """Whether the slot currently holds any pages (release is a
+        no-op otherwise — callers use this to count real releases)."""
+        return bool(self._held[slot])
+
     def release(self, slot: int) -> None:
         for p in self._held[slot]:
             self.refs[p] -= 1

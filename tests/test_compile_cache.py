@@ -74,6 +74,56 @@ def test_respawned_engine_reuses_cache(tmp_path):
     )
 
 
+def test_adaptive_chunk_buckets_bound_decode_executables():
+    """Compile-cache tripwire for adaptive chunk scheduling: the bucket
+    ladder is the ONLY degree of freedom the scheduler has, so a config
+    with one prefix-bound rung must compile at most len(chunk_buckets)
+    decode executables no matter how budgets vary — an unquantized pick
+    (or a bucket set that grows with traffic) would thrash the compile
+    cache with one executable per distinct length."""
+    import jax
+    import jax.numpy as jnp
+
+    from pilottai_tpu.engine import decode
+    from pilottai_tpu.engine.batcher import ContinuousBatcher, GenRequest
+    from pilottai_tpu.models.common import init_params
+    from pilottai_tpu.models.registry import get_model_config
+
+    cfg = get_model_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    # max_seq 64 keeps _decode_bucket on a single rung, so the only
+    # static-axis variation left is the chunk bucket itself.
+    batcher = ContinuousBatcher(
+        cfg, params, n_slots=2, max_seq_len=64, cache_dtype=jnp.float32,
+        chunk_size=8, chunk_policy="adaptive", chunk_buckets=(2, 4, 8),
+        prefix_cache=0, use_pallas=False,
+    )
+    decode.decode_chunk._clear_cache()
+    batcher.start()
+    try:
+        # Warmup's compile sweep covers every bucket...
+        batcher.warmup(prompt_lens=(8,))
+        after_warmup = decode.decode_chunk._cache_size()
+        # ...and varied serve-time budgets may only ever re-hit them.
+        for mnt in (2, 3, 5, 7, 9, 12, 17):
+            req = GenRequest(
+                prompt_ids=list(range(3, 3 + (mnt % 5) + 2)),
+                max_new_tokens=mnt,
+            )
+            batcher.submit(req).result(timeout=120)
+    finally:
+        batcher.stop()
+    n_exec = decode.decode_chunk._cache_size()
+    assert after_warmup == len(batcher.chunk_buckets), (
+        f"warmup compiled {after_warmup} decode executables, expected "
+        f"one per bucket {batcher.chunk_buckets}"
+    )
+    assert n_exec <= len(batcher.chunk_buckets), (
+        f"{n_exec} decode executables for bucket set "
+        f"{batcher.chunk_buckets}: adaptive chunking is leaking compiles"
+    )
+
+
 def test_enable_is_idempotent_and_off_disables(tmp_path):
     import jax
 
