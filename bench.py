@@ -94,6 +94,10 @@ async def bench_model(cfg, concurrency, steps, epochs, n_chips=1,
 
     _gm.reset_histograms("request.")
     _gm.reset_histograms("engine.prefill_latency")
+    # Host-gap histogram is section-pure too: each section's
+    # host_gap_p50_ms must describe ONLY its own dispatches, or a slow
+    # warmup section poisons every later section's number.
+    _gm.reset_histograms("engine.host_gap_ms")
     params = GenerationParams(max_new_tokens=MAX_NEW_TOKENS, temperature=0.0)
     uid = [0]
 
@@ -200,6 +204,13 @@ async def bench_model(cfg, concurrency, steps, epochs, n_chips=1,
     blocks_disp = _gm.get("engine.blocks_dispatched") - blocks0[0]
     blocks_used = _gm.get("engine.blocks_useful") - blocks0[1]
     n_folds = _gm.get("engine.chunk_folds") - blocks0[2]
+    # Host-gap percentiles for THIS section (histogram reset above):
+    # the device-idle bubble between fold-complete and next dispatch.
+    # p50 ≈ 0 means the overlapped pipeline kept the device fed; a
+    # regression here is attributable before device_busy_frac moves.
+    gap = _gm.snapshot()["histograms"].get("engine.host_gap_ms") or {}
+    host_gap_p50 = gap.get("p50")
+    host_gap_p90 = gap.get("p90")
 
     await handler.stop()
     del handler
@@ -268,6 +279,16 @@ async def bench_model(cfg, concurrency, steps, epochs, n_chips=1,
         "chunk_blocks_mean": (
             round(blocks_disp / n_folds, 2) if n_folds else None
         ),
+        # Device-feed health: host-side gap percentiles (ms) and the
+        # profiled busy fraction. device_busy_frac is None on CPU runs
+        # (no device profile); the device dict overrides it on accel.
+        "host_gap_p50_ms": (
+            round(host_gap_p50, 3) if host_gap_p50 is not None else None
+        ),
+        "host_gap_p90_ms": (
+            round(host_gap_p90, 3) if host_gap_p90 is not None else None
+        ),
+        "device_busy_frac": None,
         **(device or {}),
     }
 
@@ -572,6 +593,11 @@ async def run_bench():
         "device_ms_per_step_8b": (
             (sec_8b or {}).get("device_ms_per_step")
         ),
+        # Device-feed headline (BENCH_r05: 8b busy_frac 0.65 — ~30% of
+        # wall the device waited on the host; r6 target ≥ 0.80):
+        "device_busy_frac_8b": (sec_8b or {}).get("device_busy_frac"),
+        "device_busy_frac_1b": sec_1b.get("device_busy_frac"),
+        "host_gap_p50_ms_8b": (sec_8b or {}).get("host_gap_p50_ms"),
         **sec_pipeline,
         **(sec_swarm or {}),
         # Orchestrator-path phase percentiles: traffic since the last

@@ -166,8 +166,16 @@ class LLMConfig(BaseModel):
     # host↔device round trip behind compute — the lever when the chip
     # sits behind a high-latency tunnel; early-exit chunks keep
     # over-dispatched levels nearly free (a chunk whose slots are all
-    # done retires without running a weight pass).
+    # done retires without running a weight pass). Every level carries
+    # its own dispatch-time D2H copy, so any depth ≥ 1 pipelines.
     engine_pipeline: int = Field(default=2, ge=1)
+    # Overlapped admission (engine/batcher.py:_prep_loop): admission
+    # prep — slot selection, page allocation, prefix matching, staging-
+    # buffer packing — runs on a dedicated prep thread, and the device
+    # thread only enqueues the prebuilt prefill behind in-flight decode
+    # chunks. Greedy output is byte-identical on/off
+    # (tests/test_overlap_admission.py); False restores the inline path.
+    engine_overlap_admission: bool = True
     # Paged KV cache (ops/paged.py): None = auto (paged when the per-slot
     # capacity is ≥ 4096 — that is where dense slots × max_seq reservation
     # stops fitting HBM). Pool size in pages; None = the HBM a dense

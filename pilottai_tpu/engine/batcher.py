@@ -26,6 +26,22 @@ batcher multiplexes them onto fixed-shape device computations:
   variants stay bounded), KV written by one batched scatter, first token
   sampled on device with the slot's own sampling params (no host-side
   sampling duplicate — VERDICT.md Weak #9);
+* admission **prep is overlapped** (PERF_NOTES round 8): bucket/slot
+  selection, page allocation, prefix matching and staging-buffer
+  packing run on a dedicated prep thread (``_prep_loop``), so between
+  decode dispatches the device thread only *enqueues* the already-built
+  prefill behind the in-flight chunks — it never sits building host
+  arrays while the TPU drains (``overlap_admission=False`` restores the
+  inline path, byte-identical output either way);
+* the per-admission scalar metadata rides **one packed staging buffer**
+  per dtype (``decode.pack_admit_meta``) instead of ~10 tiny H2D
+  transfers, each of which paid a dispatch/transfer-setup floor;
+* folds are **non-blocking**: every dispatch starts its D2H copy
+  immediately (``_HostCopy``), and the reader materializes the
+  already-in-flight copy — chunk N−1 folds from its completed copy
+  while chunk N executes; ``jax.device_get`` never runs on the
+  dispatch/fold path (tests/test_no_blocking_hotpath.py trips on
+  reintroduction);
 * prefills compile per power-of-two length bucket; the decode chunk
   compiles once.
 
@@ -49,6 +65,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from pilottai_tpu.engine.decode import (
+    AI_BUDGET,
+    AI_EOS,
+    AI_JSON,
+    AI_LEN,
+    AI_SCHEMA,
+    AI_PLEN,
+    AI_SEED,
+    AI_SLOT,
+    AI_TOPK,
+    AF_TEMP,
+    AF_TOPP,
     DecodeState,
     admit_group,
     admit_group_prefix,
@@ -57,6 +84,7 @@ from pilottai_tpu.engine.decode import (
     decode_chunk_spec,
     export_prefix,
     extend_prompt_paged,
+    pack_admit_meta,
     release_decode,
 )
 from pilottai_tpu.engine.page_prefix import PagePrefixIndex
@@ -149,6 +177,66 @@ class _Slot:
     hi_pending: int = 0
 
 
+class _HostCopy:
+    """Handle for a device→host read whose transfer was STARTED at
+    dispatch time (``copy_to_host_async``) and is only awaited at fold
+    time — the reader materializes an already-in-flight copy instead of
+    issuing a fresh blocking round trip (``jax.device_get`` would).
+    This is the one sanctioned wait on the fold path; the AST tripwire
+    (tests/test_no_blocking_hotpath.py) allowlists exactly it."""
+
+    __slots__ = ("_arrays",)
+
+    def __init__(self, arrays) -> None:
+        self._arrays = tuple(arrays)
+        for a in self._arrays:
+            try:
+                a.copy_to_host_async()
+            except AttributeError:  # non-jax array types in tests
+                pass
+
+    def wait(self) -> List[np.ndarray]:
+        """Materialize as numpy — blocks only until the copy already in
+        flight lands, never starts a new device round trip."""
+        return [np.asarray(a) for a in self._arrays]
+
+
+@dataclass
+class _PreparedAdmission:
+    """One admission group with every host-side input prebuilt (numpy
+    staging buffers packed, slots reserved, pages allocated) — all that
+    remains for the device thread is the jnp upload + jitted dispatch.
+    ``epoch`` stamps the allocator generation the pages came from: a
+    device-state rebuild invalidates older preps (their block-table rows
+    mean nothing in the fresh allocator), which requeue instead of
+    dispatching garbage."""
+
+    kind: str                       # "full" | "prefix" | "prefix_paged"
+    group: List[Tuple[int, GenRequest]]
+    entry: Any
+    epoch: int
+    meta_i32: np.ndarray
+    meta_f32: np.ndarray
+    tokens: Optional[np.ndarray] = None       # full-prefill [A, T]
+    tail_tokens: Optional[np.ndarray] = None  # prefix paths [A, Tt]
+    full_tokens: Optional[np.ndarray] = None  # prefix paths [A, Tf]
+    pages_arr: Optional[np.ndarray] = None    # paged-prefix chain pages
+    page_rows: Optional[np.ndarray] = None    # [A, max_pages]
+    n_prefix_bucket: int = 1
+    has_json: bool = False
+    has_schema: bool = False
+
+
+@dataclass
+class _SegmentStart:
+    """Prep-queue marker: a chunked-prefill admission whose pages are
+    allocated; the device thread installs it as ``_segmenting`` and
+    advances one segment per loop cycle."""
+
+    seg: List[Any]                  # [slot_idx, request, tokens_done]
+    epoch: int
+
+
 class ContinuousBatcher:
     """Slot-based continuous batching over jitted prefill / fused-decode."""
 
@@ -181,6 +269,8 @@ class ContinuousBatcher:
         max_queue_depth: Optional[int] = None,  # admission control (shed)
         chunk_policy: str = "adaptive",  # "fixed" | "adaptive" chunk sizing
         chunk_buckets: Optional[Tuple[int, ...]] = None,  # adaptive sizes
+        overlap_admission: bool = True,  # prep admissions off the device
+                                         # thread's critical path
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -395,8 +485,11 @@ class ContinuousBatcher:
         )
         # In-flight segmented admission: [slot_idx, request, tokens_done]
         # (device thread only; the slot is excluded from free lists until
-        # the final segment installs it).
+        # the final segment installs it). _seg_epoch is the allocator
+        # epoch it was prepared against — _advance_segment re-admits from
+        # scratch if a rebuild swapped the pool out from under it.
         self._segmenting: Optional[List[Any]] = None
+        self._seg_epoch = 0
         # Automatic prefix caching. Dense cache: panel-copy store
         # (engine/prefix_cache.py). Paged cache: block-granular radix of
         # refcounted pages (engine/page_prefix.py) — shared prefixes are
@@ -424,6 +517,13 @@ class ContinuousBatcher:
                     # on a 16 GB chip.
                     max_len=min(max_seq_len or cfg.max_seq_len, 1024),
                 )
+        # Slot table / gen / release / first_reads / allocator are shared
+        # between the device thread, the reader thread (completion) and
+        # the admission-prep thread (selection) — the lock exists before
+        # the first _rebuild_device_state, which swaps the allocator and
+        # bumps the epoch under it.
+        self._lock = threading.Lock()
+        self._alloc_epoch = 0  # bumped by _rebuild_device_state
         self._rebuild_device_state()
         self._slots: List[Optional[_Slot]] = [None] * n_slots
         # Admission generation per slot: chunk results are stamped with the
@@ -437,17 +537,38 @@ class ContinuousBatcher:
         self._release: List[int] = []  # slots to force-stop at next admission
         # (group_slots, first_tokens device array) awaiting lazy host read
         self._first_reads: deque = deque()
-        # Slot table / gen / release / first_reads are shared between the
-        # device thread (admission) and the reader thread (completion).
-        self._lock = threading.Lock()
         self._drain_queued = False  # a drain sentinel is in _results
         # Dispatched chunks awaiting host read. Bounded so the device
-        # thread can't run unboundedly ahead of completions.
+        # thread can't run unboundedly ahead of completions. The depth is
+        # the one knob (engine_pipeline): each item carries its own
+        # _HostCopy, so any depth ≥ 1 pipelines — nothing about the
+        # read-back is structural anymore.
         self._results: "queue.Queue" = queue.Queue(maxsize=self.PIPELINE_DEPTH)
+        # Overlapped admission (PERF_NOTES r8): a prep thread runs group
+        # selection / page allocation / staging-buffer packing and hands
+        # _PreparedAdmission items over this queue, so the device thread
+        # only enqueues the prefill dispatch behind in-flight chunks.
+        # False = the seed's inline path (same code, same thread).
+        self.overlap_admission = bool(overlap_admission)
+        self._prepped: "queue.Queue" = queue.Queue()
+        self._prep_depth = 2            # prepared waves ahead, max
+        self._prep_reserved: set = set()  # slots picked but not installed
+        self._prepped_reqs = 0          # requests inside _prepped (approx)
+        self._seg_pending = False       # a segmentation owns admission
+        self._prep_gate = threading.Lock()  # quiesces prep for requeues
+        self._prep_wake = threading.Event()
+        # Host-gap telemetry: time from the last fold-complete (or
+        # prefill feed) to the next chunk dispatch while NOTHING was in
+        # flight — the host-side bubble the overlap work exists to
+        # close. 0 whenever the pipeline still held work.
+        self._inflight = 0
+        self._last_fold_done: Optional[float] = None
+        self._last_prefill_t: Optional[float] = None
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._reader: Optional[threading.Thread] = None
+        self._prep_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------ #
     # Lifecycle
@@ -465,10 +586,20 @@ class ContinuousBatcher:
         )
         self._thread.start()
         self._reader.start()
+        if self.overlap_admission:
+            self._prep_thread = threading.Thread(
+                target=self._prep_loop, name="pilottai-admit-prep",
+                daemon=True,
+            )
+            self._prep_thread.start()
 
     def stop(self) -> None:
         self._stop.set()
         self._wake.set()
+        self._prep_wake.set()
+        if self._prep_thread is not None:
+            self._prep_thread.join(timeout=60)
+            self._prep_thread = None
         if self._thread is not None:
             self._thread.join(timeout=60)
             self._thread = None
@@ -488,7 +619,30 @@ class ContinuousBatcher:
         self._backlog.clear()
         if self._segmenting is not None:  # mid-chunked-prefill request
             stranded.append(self._segmenting[1])
+            if self.alloc is not None:
+                self.alloc.release(self._segmenting[0])
             self._segmenting = None
+        self._seg_pending = False
+        while True:  # prepared-but-never-dispatched admissions
+            try:
+                item = self._prepped.get_nowait()
+            except queue.Empty:
+                break
+            # Release their page allocations too: a stranded prep's
+            # pages otherwise survive into the next start() and the
+            # first selection that reuses the slot trips allocate()'s
+            # held-pages invariant — admission wedges permanently.
+            if isinstance(item, _SegmentStart):
+                stranded.append(item.seg[1])
+                if self.alloc is not None:
+                    self.alloc.release(item.seg[0])
+            else:
+                stranded.extend(req for _, req in item.group)
+                if self.alloc is not None:
+                    for idx, _ in item.group:
+                        self.alloc.release(idx)
+        self._prepped_reqs = 0
+        self._prep_reserved.clear()
         while True:
             try:
                 stranded.append(self._pending.get_nowait())
@@ -497,9 +651,13 @@ class ContinuousBatcher:
         for req in stranded:
             if not req.future.done():
                 req.future.set_exception(RuntimeError("engine stopped"))
-        for slot in self._slots:
-            if slot and not slot.request.future.done():
+        for idx, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            if not slot.request.future.done():
                 slot.request.future.set_exception(RuntimeError("engine stopped"))
+            if self.alloc is not None:
+                self.alloc.release(idx)
         self._slots = [None] * self.n_slots
 
     def _max_safe_strip(self, want: int) -> int:
@@ -672,8 +830,11 @@ class ContinuousBatcher:
 
     def queue_depth(self) -> int:
         """Requests submitted but not yet admitted to a slot (any thread;
-        approximate — both containers move concurrently)."""
-        return self._pending.qsize() + len(self._backlog)
+        approximate — the containers move concurrently). Prepared-but-
+        not-yet-dispatched admissions still count: they hold no slot."""
+        return (
+            self._pending.qsize() + len(self._backlog) + self._prepped_reqs
+        )
 
     def saturated(self) -> bool:
         return (
@@ -723,6 +884,7 @@ class ContinuousBatcher:
             request.prompt_ids = request.prompt_ids[-keep:]
         self._pending.put(request)
         self._wake.set()
+        self._prep_wake.set()
         return request.future
 
     # ------------------------------------------------------------------ #
@@ -823,6 +985,8 @@ class ContinuousBatcher:
                         f"request deadline expired after "
                         f"{len(slot.generated)} generated token(s)"
                     ))
+        if expired:
+            self._prep_wake.set()  # freed pages/slots — prep can select
         # Observability OUTSIDE the lock: the black-box dump snapshots
         # the step ring and may write a journal line — file IO must not
         # stall the reader thread's folds.
@@ -850,13 +1014,27 @@ class ContinuousBatcher:
                 prompt_len=slot.prompt_len,
             )
 
+    def _drain_pending(self) -> None:
+        """Drain the thread-safe submission queue into the FIFO backlog
+        (page-gated admission needs to peek at the head without losing
+        submission order). Runs on the prep thread when overlapping,
+        the device thread inline — exactly one drainer per mode."""
+        while True:
+            try:
+                self._backlog.append(self._pending.get_nowait())
+            except queue.Empty:
+                break
+
     def _admit(self) -> None:
-        """Stop released slots, then prefill+install pending requests in
-        padded groups. Slot selection happens under the lock; the device
-        dispatches run outside it (a dispatch that blocks on a deep device
-        queue must not stall the reader thread's completions). Admits
-        until slots or pending run out — completions arrive in waves, and
-        refilling only one group per chunk would leave slots idle."""
+        """Stop released slots, then dispatch pending admissions. With
+        overlapped admission (the default) the groups arrive PREBUILT
+        from the prep thread and this thread only performs the device
+        dispatches — the prefill lands on the device stream behind the
+        in-flight decode chunks, with no host-side array building in
+        between. Inline mode prepares on this thread (the seed path;
+        byte-identical output either way). Admits until slots or
+        pending run out — completions arrive in waves, and refilling
+        only one group per chunk would leave slots idle."""
         with self._lock:
             released = list(self._release)
             self._release.clear()
@@ -875,15 +1053,12 @@ class ContinuousBatcher:
             rel_j = jnp.asarray(rel)
             self.dstate = release_decode(self.dstate, rel_j)
             self.cache = free_slots(self.cache, rel_j)
+            # The released slots are selectable the moment their device
+            # stop ops are enqueued — tell the prep thread.
+            self._prep_wake.set()
 
-        # Drain the thread-safe submission queue into the device thread's
-        # FIFO backlog (page-gated admission needs to peek at the head
-        # without losing order).
-        while True:
-            try:
-                self._backlog.append(self._pending.get_nowait())
-            except queue.Empty:
-                break
+        if not self.overlap_admission:
+            self._drain_pending()
 
         # A segmented admission in flight: advance it by ONE segment and
         # yield the cycle — the caller dispatches a decode chunk next, so
@@ -892,137 +1067,117 @@ class ContinuousBatcher:
             self._advance_segment()
             return
 
-        with self._lock:
-            # A slot completed AFTER the release snapshot above is not yet
-            # admissible: its release ops (decode stop, page free) run
-            # next cycle, and admitting into it now would let that stale
-            # release wipe the new occupant. One cycle of patience.
-            not_yet = set(self._release)
-            free = [i for i in self._free_slot_indices() if i not in not_yet]
-            groups: List[Tuple[Any, List[Tuple[int, GenRequest]]]] = []
-            blocked = False
-            while free and not blocked:
-                group: List[Tuple[int, GenRequest]] = []
-                group_key = None
-                while free and self._backlog and len(group) < self.admit_batch:
-                    req = self._backlog[0]
-                    if req.cancelled or req.future.cancelled():
-                        self._backlog.popleft()
-                        continue
-                    # Expired while queued: admitting would spend a
-                    # prefill on work whose caller already gave up.
-                    if (
-                        req.deadline is not None
-                        and time.monotonic() >= req.deadline
-                    ):
-                        self._backlog.popleft()
-                        global_metrics.inc("engine.expired")
-                        if not req.future.done():
-                            req.future.set_exception(DeadlineExceeded(
-                                "request deadline expired before admission"
-                            ))
-                        continue
-                    # Prefix-cache match keys the group: one shared
-                    # cached prefix per admission dispatch.
-                    key = self._prefix_hit(req)
-                    # Long un-cached tail → chunked-prefill admission
-                    # (own slot, one segment per cycle), never a
-                    # monolithic group prefill.
-                    long_req = False
-                    if self.prefill_chunk:
-                        chain = (
-                            len(key.path_pages)
-                            if self.page_index is not None
-                            and key is not None else 0
-                        )
-                        tail_len = (
-                            len(req.prompt_ids) - chain * self.page_size
-                        )
-                        long_req = tail_len > 2 * self.prefill_chunk
-                    if group and (key is not group_key or long_req):
-                        break  # next group (or segmentation) picks it up
-                    group_key = key
-                    prefix_pages: Tuple[int, ...] = ()
-                    if self.page_index is not None and key is not None:
-                        prefix_pages = key.path_pages
-                    if self.alloc is not None:
-                        # Clamp to slot capacity: decode stops at
-                        # ctx-full anyway, so the cache never holds more
-                        # (an unclamped huge max_new_tokens would make
-                        # can_allocate permanently false and deadlock
-                        # the FIFO head).
-                        need = min(
-                            len(req.prompt_ids) + req.max_new_tokens,
-                            self.max_seq_len,
-                        )
-                        if not self.alloc.can_allocate(
-                            need, len(prefix_pages)
-                        ):
-                            # Reclaim cached prefix pages before declaring
-                            # the head blocked — caching must never starve
-                            # admission. The hit's own chain is protected
-                            # (evicting it would free pages we are about
-                            # to map).
-                            short = (
-                                self.alloc.pages_needed(need)
-                                - len(prefix_pages)
-                                - self.alloc.free_pages
-                            )
-                            if not (
-                                self.page_index is not None
-                                and short > 0
-                                and self.page_index.evict(
-                                    short, self.alloc,
-                                    protect=frozenset(prefix_pages),
-                                ) > 0
-                                and self.alloc.can_allocate(
-                                    need, len(prefix_pages)
-                                )
-                            ):
-                                # Head-of-line waits for pages (FIFO
-                                # fairness); completions will free them.
-                                blocked = True
-                                break
-                    self._backlog.popleft()
-                    idx = free.pop(0)
-                    if self.alloc is not None:
-                        ok = self.alloc.allocate(
-                            idx, need, prefix_pages=prefix_pages
-                        )
-                        assert ok, "can_allocate/allocate disagree"
-                    if long_req:
-                        # Pages are allocated; segments run one per
-                        # device-loop cycle starting below. No further
-                        # groups this cycle — admission order holds.
-                        self._segmenting = [
-                            idx, req, len(prefix_pages) * self.page_size,
-                        ]
-                        blocked = True
-                        break
-                    group.append((idx, req))
-                if not group:
+        preps: List[Any] = []
+        if self.overlap_admission:
+            while True:
+                try:
+                    item = self._prepped.get_nowait()
+                except queue.Empty:
                     break
-                groups.append((group_key, group))
-            # Only this thread allocates slots, so the picks stay valid
-            # after the lock drops; occupied entries land in _prefill_group.
-
-        for gi, (entry, group) in enumerate(groups):
-            try:
-                self._prefill_group(group, entry)
-            except Exception as exc:  # noqa: BLE001 — fail these requests only
-                self._log.error("prefill failed: %s", exc, exc_info=True)
                 with self._lock:
-                    for idx, req in group:
-                        self._slots[idx] = None
-                        if not req.future.done():
-                            req.future.set_exception(exc)
-                        # Reclaim the group's KV pages (under the lock —
-                        # the reader thread releases pages too now) —
-                        # leaking them here permanently shrinks the pool
-                        # AND trips allocate()'s held-pages invariant
-                        # when the slot is reused.
-                        if self.alloc is not None:
-                            self.alloc.release(idx)
+                    n = (
+                        1 if isinstance(item, _SegmentStart)
+                        else len(item.group)
+                    )
+                    self._prepped_reqs = max(0, self._prepped_reqs - n)
+                preps.append(item)
+            if preps:
+                self._prep_wake.set()  # look-ahead slots freed up
+        else:
+            groups, seg, epoch = self._select_groups()
+            for entry, group in groups:
+                try:
+                    preps.append(
+                        self._prepare_prefill(group, entry, epoch=epoch)
+                    )
+                except Exception as exc:  # noqa: BLE001 — host-side prep
+                    # Array building touches no device state: fail these
+                    # requests only, the engine stays serviceable.
+                    self._log.error(
+                        "admission prep failed: %s", exc, exc_info=True
+                    )
+                    self._fail_group(group, exc)
+            if seg is not None:
+                preps.append(_SegmentStart(seg, epoch))
+        self._dispatch_admissions(preps)
+
+        # A segmentation picked up in THIS call starts immediately (the
+        # early-return gate above owns advancing it on later cycles).
+        if self._segmenting is not None:
+            self._advance_segment()
+
+    def _dispatch_admissions(self, preps: List[Any]) -> None:
+        """Dispatch prepared admissions in order (device thread only),
+        with the per-group failure semantics of the inline path: a
+        failed dispatch fails only its group; a failure that consumed
+        the donated device state rebuilds it and REQUEUES everything not
+        yet dispatched (their page allocations died with the old
+        allocator — prefilling against the fresh one's sentinel rows
+        silently produced garbage completions, test_engine_mesh.py)."""
+        # Stale preps requeue in ONE batch after the loop: per-item
+        # _requeue_prepared calls would each appendleft in front of the
+        # previous call's requests, reversing FIFO admission order (and
+        # under page pressure FIFO is what stops head-of-line reqs from
+        # starving). Stale items precede fresh ones in `preps`, and the
+        # batch requeue runs after any preps[gi+1:] requeue below, so
+        # the earlier-submitted stale requests land at the very head.
+        stale_preps: List[Any] = []
+        for gi, prep in enumerate(preps):
+            if prep.epoch != self._alloc_epoch:
+                stale_preps.append(prep)
+                continue
+            if isinstance(prep, _SegmentStart):
+                if stale_preps:
+                    # FIFO: the stale preps carry EARLIER-submitted
+                    # requests — installing this fresh segmentation
+                    # would run its multi-cycle prefill ahead of them
+                    # (prep stays parked on _seg_pending meanwhile).
+                    # Requeue everything in submission order instead
+                    # and let selection re-form the wave.
+                    self._requeue_prepared(
+                        stale_preps + [prep] + preps[gi + 1:]
+                    )
+                    stale_preps = []
+                    break
+                self._segmenting = prep.seg
+                self._seg_epoch = prep.epoch
+                # Group formation stopped at the segmentation (FIFO
+                # order), so nothing can legitimately follow it.
+                self._requeue_prepared(preps[gi + 1:])
+                break
+            # Deadline re-check at dispatch time: a prep can wait in
+            # _prepped across a whole chunked-prefill segmentation
+            # (admission early-returns for its duration — seconds for an
+            # 8K prompt through the tunnel), long past the selection-time
+            # sweep. A group whose every member expired or was cancelled
+            # meanwhile would spend a full fused prefill on 100% dead
+            # work; drop it instead. Mixed groups still dispatch — the
+            # live members need the prefill anyway, and the next
+            # _expire_deadlines cycle reaps the rest (releasing their
+            # pages mid-dispatch here would race the in-flight page
+            # writes against a concurrent re-allocation).
+            now = time.monotonic()
+            if all(
+                req.cancelled or req.future.cancelled()
+                or (req.deadline is not None and now >= req.deadline)
+                for _, req in prep.group
+            ):
+                n_expired = sum(
+                    1 for _, req in prep.group
+                    if req.deadline is not None and now >= req.deadline
+                    and not req.future.done()
+                )
+                if n_expired:
+                    global_metrics.inc("engine.expired", n_expired)
+                self._fail_group(prep.group, DeadlineExceeded(
+                    "request deadline expired before admission dispatch"
+                ))
+                continue
+            try:
+                self._dispatch_prefill(prep)
+            except Exception as exc:  # noqa: BLE001 — fail this group only
+                self._log.error("prefill failed: %s", exc, exc_info=True)
+                self._fail_group(prep.group, exc)
                 # admit_group donates cache/dstate/sampling: a dispatch
                 # that failed mid-flight may have consumed them. If so the
                 # engine state is gone with it — fail in-flight work loudly
@@ -1032,20 +1187,320 @@ class ContinuousBatcher:
                 if self.cache.lengths.is_deleted():
                     self._fail_occupied_slots(exc)
                     self._rebuild_device_state()
-                    # Later groups in this wave were page-allocated in the
-                    # OLD allocator; their table rows mean nothing in the
-                    # fresh one (prefill would scatter every prompt to the
-                    # scratch page and "complete" with garbage). Requeue
-                    # them at the backlog head, in order, so they re-admit
-                    # with fresh allocations next cycle.
-                    for _, later in reversed(groups[gi + 1:]):
-                        for _, later_req in reversed(later):
-                            self._backlog.appendleft(later_req)
+                    self._requeue_prepared(preps[gi + 1:])
                     break
-        # A segmentation picked up in THIS call starts immediately (the
-        # early-return gate above owns advancing it on later cycles).
-        if self._segmenting is not None:
-            self._advance_segment()
+        if stale_preps:
+            self._requeue_prepared(stale_preps)
+
+    def _fail_group(self, group: List[Tuple[int, GenRequest]],
+                    exc: Exception) -> None:
+        """Fail one admission group's requests and return their
+        resources (either thread)."""
+        with self._lock:
+            for idx, req in group:
+                self._slots[idx] = None
+                self._prep_reserved.discard(idx)
+                if not req.future.done():
+                    req.future.set_exception(exc)
+                # Reclaim the group's KV pages (under the lock — the
+                # reader thread releases pages too) — leaking them here
+                # permanently shrinks the pool AND trips allocate()'s
+                # held-pages invariant when the slot is reused.
+                if self.alloc is not None:
+                    self.alloc.release(idx)
+
+    def _requeue_prepared(self, items: List[Any]) -> None:
+        """Return prepared-but-undispatchable admissions to the backlog
+        HEAD, in order (device thread only). Their page allocations are
+        dropped (release is idempotent, and a no-op on a freshly rebuilt
+        allocator) and their slots unreserved; the next selection
+        re-admits them against live state. Anything the prep thread had
+        queued BEHIND them drains too — under the prep gate, so no
+        concurrent prep round can land an item after the drain."""
+        with self._prep_gate:
+            drained: List[Any] = []
+            while True:
+                try:
+                    drained.append(self._prepped.get_nowait())
+                except queue.Empty:
+                    break
+            with self._lock:
+                self._prepped_reqs = 0
+                reqs: List[GenRequest] = []
+                for item in list(items) + drained:
+                    if isinstance(item, _SegmentStart):
+                        idx, req = item.seg[0], item.seg[1]
+                        self._seg_pending = False
+                        pairs = [(idx, req)]
+                    else:
+                        pairs = item.group
+                    for idx, req in pairs:
+                        self._prep_reserved.discard(idx)
+                        if self.alloc is not None:
+                            self.alloc.release(idx)
+                        reqs.append(req)
+                for req in reversed(reqs):
+                    self._backlog.appendleft(req)
+        self._prep_wake.set()
+        self._wake.set()
+
+    def _prep_loop(self) -> None:
+        """Admission-prep thread: everything host-side an admission
+        needs — backlog draining, deadline/cancel sweeps at the head,
+        slot selection, page allocation, prefix matching and
+        staging-buffer packing — runs HERE, off the device thread's
+        dispatch path. The slot-lock + allocator-under-lock discipline
+        (PR 4's early-release work) is what makes this safe: selection
+        and allocation serialize against the reader's fold-time releases
+        exactly as they did on the device thread. Look-ahead is bounded
+        (``_prep_depth`` waves) so prep can never run unboundedly ahead
+        of installs."""
+        while not self._stop.is_set():
+            self._drain_pending()
+            if (
+                self._segmenting is not None
+                or self._seg_pending
+                or self._prepped.qsize() >= self._prep_depth
+                or (not self._backlog and not self._pending.qsize())
+            ):
+                self._prep_wake.wait(timeout=0.02)
+                self._prep_wake.clear()
+                continue
+            made = False
+            sel_failed = False
+            with self._prep_gate:
+                if self._stop.is_set():
+                    break
+                try:
+                    groups, seg, epoch = self._select_groups()
+                except Exception as exc:  # noqa: BLE001 — keep prep alive
+                    # A dead prep thread wedges every future admission
+                    # (requests queue forever, the breaker opens on the
+                    # timeouts). Best effort: log loudly and keep the
+                    # thread alive — later selections can still serve
+                    # the rest of the backlog. The backoff wait happens
+                    # OUTSIDE the gate: sleeping with it held would
+                    # block the device thread's _requeue_prepared (the
+                    # rebuild/segmentation recovery paths) for 100 ms a
+                    # pop — a host-side stall of the very dispatch loop
+                    # this pipeline exists to keep fed.
+                    self._log.error(
+                        "admission selection failed: %s", exc,
+                        exc_info=True,
+                    )
+                    sel_failed = True
+                    groups, seg = [], None
+                for entry, group in groups:
+                    try:
+                        prep = self._prepare_prefill(
+                            group, entry, epoch=epoch
+                        )
+                    except Exception as exc:  # noqa: BLE001 — prep only
+                        self._log.error(
+                            "admission prep failed: %s", exc, exc_info=True
+                        )
+                        self._fail_group(group, exc)
+                        continue
+                    with self._lock:
+                        self._prepped_reqs += len(group)
+                    self._prepped.put(prep)
+                    made = True
+                if seg is not None:
+                    self._seg_pending = True
+                    with self._lock:
+                        self._prepped_reqs += 1
+                    self._prepped.put(_SegmentStart(seg, epoch))
+                    made = True
+            if sel_failed:
+                self._prep_wake.wait(timeout=0.1)
+                self._prep_wake.clear()
+                continue
+            if made:
+                self._wake.set()
+            else:
+                self._prep_wake.wait(timeout=0.02)
+                self._prep_wake.clear()
+        self._log.info("admission prep stopped")
+
+    def _select_groups(self):
+        """Form admission groups from the backlog head (prep thread when
+        overlapping, device thread inline; slot lock held inside).
+        Returns ``(groups, seg, epoch)``: groups as ``[(prefix_entry,
+        [(slot, request), ...])]``, ``seg`` a started chunked-prefill
+        admission ``[slot, request, tokens_done]`` with pages already
+        allocated (or None), and the allocator epoch the allocations
+        were made under. Chosen slots are reserved until install or
+        failure so overlapping selections can't double-book them."""
+        seg = None
+        with self._lock:
+            # A slot completed but not yet device-released is not yet
+            # admissible: its release ops (decode stop, page free) run
+            # next device cycle, and admitting into it now would let
+            # that stale release wipe the new occupant. One cycle of
+            # patience. Slots a previous selection reserved (prepared
+            # admission not yet installed) are off the table too.
+            epoch = self._alloc_epoch
+            not_yet = set(self._release)
+            free = [
+                i for i in self._free_slot_indices()
+                if i not in not_yet and i not in self._prep_reserved
+            ]
+            groups: List[Tuple[Any, List[Tuple[int, GenRequest]]]] = []
+            # The in-progress group lives outside the try so the unwind
+            # below sees it even when the failure lands mid-formation.
+            group: List[Tuple[int, GenRequest]] = []
+            blocked = False
+            try:
+                while free and not blocked:
+                    group = []
+                    group_key = None
+                    while (
+                        free and self._backlog
+                        and len(group) < self.admit_batch
+                    ):
+                        req = self._backlog[0]
+                        if req.cancelled or req.future.cancelled():
+                            self._backlog.popleft()
+                            continue
+                        # Expired while queued: admitting would spend a
+                        # prefill on work whose caller already gave up.
+                        if (
+                            req.deadline is not None
+                            and time.monotonic() >= req.deadline
+                        ):
+                            self._backlog.popleft()
+                            global_metrics.inc("engine.expired")
+                            if not req.future.done():
+                                req.future.set_exception(DeadlineExceeded(
+                                    "request deadline expired before admission"
+                                ))
+                            continue
+                        # Prefix-cache match keys the group: one shared
+                        # cached prefix per admission dispatch.
+                        key = self._prefix_hit(req)
+                        # Long un-cached tail → chunked-prefill admission
+                        # (own slot, one segment per cycle), never a
+                        # monolithic group prefill.
+                        long_req = False
+                        if self.prefill_chunk:
+                            chain = (
+                                len(key.path_pages)
+                                if self.page_index is not None
+                                and key is not None else 0
+                            )
+                            tail_len = (
+                                len(req.prompt_ids) - chain * self.page_size
+                            )
+                            long_req = tail_len > 2 * self.prefill_chunk
+                        if group and (key is not group_key or long_req):
+                            break  # next group (or segmentation) takes it
+                        group_key = key
+                        prefix_pages: Tuple[int, ...] = ()
+                        if self.page_index is not None and key is not None:
+                            prefix_pages = key.path_pages
+                        if self.alloc is not None:
+                            # Clamp to slot capacity: decode stops at
+                            # ctx-full anyway, so the cache never holds
+                            # more (an unclamped huge max_new_tokens
+                            # would make can_allocate permanently false
+                            # and deadlock the FIFO head).
+                            need = min(
+                                len(req.prompt_ids) + req.max_new_tokens,
+                                self.max_seq_len,
+                            )
+                            if not self.alloc.can_allocate(
+                                need, len(prefix_pages)
+                            ):
+                                # Reclaim cached prefix pages before
+                                # declaring the head blocked — caching
+                                # must never starve admission. The hit's
+                                # own chain is protected (evicting it
+                                # would free pages we are about to map).
+                                short = (
+                                    self.alloc.pages_needed(need)
+                                    - len(prefix_pages)
+                                    - self.alloc.free_pages
+                                )
+                                if not (
+                                    self.page_index is not None
+                                    and short > 0
+                                    and self.page_index.evict(
+                                        short, self.alloc,
+                                        protect=frozenset(prefix_pages),
+                                    ) > 0
+                                    and self.alloc.can_allocate(
+                                        need, len(prefix_pages)
+                                    )
+                                ):
+                                    # Head-of-line waits for pages (FIFO
+                                    # fairness); completions free them.
+                                    blocked = True
+                                    break
+                        self._backlog.popleft()
+                        idx = free.pop(0)
+                        self._prep_reserved.add(idx)
+                        if self.alloc is not None:
+                            try:
+                                ok = self.alloc.allocate(
+                                    idx, need, prefix_pages=prefix_pages
+                                )
+                                assert ok, "can_allocate/allocate disagree"
+                            except Exception:
+                                # Undo the pop + reservation for THIS
+                                # request before the outer unwind (which
+                                # only knows committed members) runs:
+                                # its appendleft lands behind the
+                                # committed requests the unwind restores
+                                # in front, so FIFO order holds.
+                                self._prep_reserved.discard(idx)
+                                self._backlog.appendleft(req)
+                                raise
+                        if long_req:
+                            # Pages are allocated; segments run one per
+                            # device-loop cycle once the device thread
+                            # installs it. No further groups this wave —
+                            # admission order holds.
+                            self._prep_reserved.discard(idx)
+                            seg = [
+                                idx, req,
+                                len(prefix_pages) * self.page_size,
+                            ]
+                            blocked = True
+                            break
+                        group.append((idx, req))
+                    if not group:
+                        break
+                    groups.append((group_key, group))
+            except Exception:
+                # A failure mid-selection (prefix match, eviction, the
+                # allocate assert) must not leak what this call already
+                # committed: without this unwind, every earlier member —
+                # the in-progress group AND fully formed groups — kept
+                # its _prep_reserved entry and page allocation forever
+                # while its request vanished from every queue (future
+                # never resolves, slot pool permanently shrinks; the
+                # prep loop's keep-alive catch only logs). Roll back all
+                # of them and restore backlog FIFO order before
+                # re-raising.
+                pairs = [p for _, g in groups for p in g] + group
+                for idx, _req in pairs:
+                    self._prep_reserved.discard(idx)
+                    if self.alloc is not None:
+                        self.alloc.release(idx)
+                for _idx, r in reversed(pairs):
+                    self._backlog.appendleft(r)
+                raise
+            # Reserved slots stay None until install, so the picks stay
+            # valid after the lock drops even with selection and install
+            # on different threads.
+        return groups, seg, epoch
+
+    def _end_segmentation(self) -> None:
+        """Segmentation over — installed, cancelled, expired or failed:
+        group formation may resume (device thread only)."""
+        self._segmenting = None
+        self._seg_pending = False
+        self._prep_wake.set()
 
     def _advance_segment(self) -> None:
         """Dispatch one chunked-prefill segment (device thread only).
@@ -1053,14 +1508,32 @@ class ContinuousBatcher:
         only); the final segment admits through the normal prefix-paged
         path, which samples the first token and installs the slot."""
         idx, req, done = self._segmenting
+        if self._seg_epoch != self._alloc_epoch:
+            # Device state was rebuilt mid-segmentation (a concurrent
+            # dispatch failure consumed the buffers): the KV written so
+            # far died with the old pool and alloc.table[idx] now reads
+            # the fresh allocator's sentinel rows — continuing would
+            # silently produce a garbage completion. Re-admit from the
+            # backlog head instead (release is a no-op on the new pool).
+            with self._lock:
+                if self.alloc is not None:
+                    self.alloc.release(idx)
+                self._backlog.appendleft(req)
+            self._end_segmentation()
+            self._wake.set()
+            return
         expired_now = (
             req.deadline is not None and time.monotonic() >= req.deadline
         )
         if req.cancelled or req.future.cancelled() or expired_now:
-            self._segmenting = None
+            # Release BEFORE ending segmentation: _end_segmentation wakes
+            # the prep thread, and a slot that is empty but still holds
+            # pages trips allocate()'s held-pages invariant if selection
+            # wins the race to the lock.
             if self.alloc is not None:
                 with self._lock:
                     self.alloc.release(idx)
+            self._end_segmentation()
             if expired_now:
                 global_metrics.inc("engine.expired")
                 if not req.future.done():
@@ -1096,74 +1569,73 @@ class ContinuousBatcher:
             # own page chain — admit exactly like a block-prefix hit, at
             # n_rows=1 (admit_batch padding rows against an 8K chain
             # made the prefix-score tensor 8x bigger for nothing — a
-            # measured compile OOM).
-            self._segmenting = None
+            # measured compile OOM). Re-reserve the slot across the
+            # handoff: segmentation ends here but the slot is not
+            # installed until _dispatch_prefill, and the prep thread
+            # (woken by _end_segmentation) must not select an empty slot
+            # that still holds this request's pages. Install (or the
+            # failure path below) clears the reservation.
+            with self._lock:
+                self._prep_reserved.add(idx)
+            self._end_segmentation()
             k = done // self.page_size
             entry = SimpleNamespace(
                 depth=k,
                 path_pages=tuple(int(p) for p in self.alloc.table[idx, :k]),
                 segmented=True,  # own chain, not a cache hit (metrics)
             )
-            self._prefill_group([(idx, req)], entry, n_rows=1)
+            self._dispatch_prefill(
+                self._prepare_prefill([(idx, req)], entry, n_rows=1)
+            )
         except Exception as exc:  # noqa: BLE001 — fail this request only
             self._log.error("chunked prefill failed: %s", exc, exc_info=True)
-            self._segmenting = None
+            # Cleanup before _end_segmentation for the same reason as the
+            # cancel branch: once prep wakes, the slot must either hold
+            # no pages or stay reserved — never "empty with pages".
             with self._lock:
                 if not req.future.done():
                     req.future.set_exception(exc)
                 self._slots[idx] = None
+                self._prep_reserved.discard(idx)
                 if self.alloc is not None:
                     self.alloc.release(idx)
+            self._end_segmentation()
             if self.cache.lengths.is_deleted():
                 self._fail_occupied_slots(exc)
                 self._rebuild_device_state()
 
-    def _prefill_group(
+    def _prepare_prefill(
         self,
         group: List[Tuple[int, GenRequest]],
         entry: Optional[Any] = None,
         n_rows: Optional[int] = None,
-    ) -> None:
-        # Chaos point: a slow (delay=) or failed (exc=) admission prefill.
-        # Raises land in _admit's per-group failure handling — exactly the
-        # production path a device fault would take.
-        global_injector.fire("engine.prefill", n_requests=len(group))
+        epoch: Optional[int] = None,
+    ) -> _PreparedAdmission:
+        """Build every host-side input of one admission dispatch (either
+        thread). The per-row scalars pack into ONE int32 + ONE float32
+        staging buffer (``decode.pack_admit_meta`` layout): the ~10 tiny
+        per-field ``jnp.asarray`` uploads this replaces each paid a
+        transfer-setup/dispatch floor through the tunnel. No device work
+        happens here — that is the point."""
         A = n_rows if n_rows is not None else self.admit_batch
-        slots = np.full((A,), self.n_slots, np.int32)  # OOB = padding row
-        temps = np.zeros((A,), np.float32)
-        topks = np.zeros((A,), np.int32)
-        topps = np.ones((A,), np.float32)
-        seeds = np.zeros((A,), np.int32)
-        eos = np.full((A,), -1, np.int32)
-        budgets = np.zeros((A,), np.int32)
-        jsonm = np.zeros((A,), bool)
-        schema_rows = np.full((A,), -1, np.int32)
+        mi, mf = pack_admit_meta(A, pad_slot=self.n_slots)
         for row, (idx, req) in enumerate(group):
-            slots[row] = idx
-            temps[row] = req.temperature
-            topks[row] = req.top_k
-            topps[row] = req.top_p
-            seeds[row] = req.seed
-            eos[row] = req.eos_id
-            jsonm[row] = req.json_mode
-            schema_rows[row] = req.json_schema_id
-            budgets[row] = req.max_new_tokens - 1
-        # Bake the token tables into this dispatch only when the group
-        # actually constrains: with a 128k-vocab the B x V x L automaton
-        # simulation is pure waste for non-JSON traffic. Two jit variants
-        # total (with/without), both cached after first use.
-        group_json = (
-            self.json_tables
-            if any(req.json_mode for _, req in group) else None
+            mi[AI_SLOT, row] = idx
+            mi[AI_TOPK, row] = req.top_k
+            mi[AI_SEED, row] = req.seed
+            mi[AI_EOS, row] = req.eos_id
+            mi[AI_BUDGET, row] = req.max_new_tokens - 1
+            mi[AI_JSON, row] = int(req.json_mode)
+            mi[AI_SCHEMA, row] = req.json_schema_id
+            mf[AF_TEMP, row] = req.temperature
+            mf[AF_TOPP, row] = req.top_p
+        prep = _PreparedAdmission(
+            kind="full", group=list(group), entry=entry,
+            epoch=self._alloc_epoch if epoch is None else epoch,
+            meta_i32=mi, meta_f32=mf,
+            has_json=any(req.json_mode for _, req in group),
+            has_schema=bool((mi[AI_SCHEMA] >= 0).any()),
         )
-        # Schema tables/ids ride only when the group has a schema slot
-        # (same two-variant discipline as the token tables).
-        if (schema_rows >= 0).any():
-            group_schema = self._schema_tables()
-            group_sids = jnp.asarray(schema_rows)
-        else:
-            group_schema = None
-            group_sids = None
 
         if entry is not None and self.paged:
             # Paged block-granular hit (or a chunked-prefill final
@@ -1183,43 +1655,28 @@ class ContinuousBatcher:
             )
             Tf = self._bucket(max(len(r.prompt_ids) for _, r in group))
             tail_tokens = np.zeros((A, Tt), np.int32)
-            tail_lens = np.zeros((A,), np.int32)
             full_tokens = np.zeros((A, Tf), np.int32)
             for row, (idx, req) in enumerate(group):
                 tail = req.prompt_ids[plen:]
                 tail_tokens[row, : len(tail)] = tail
-                tail_lens[row] = len(tail)
+                mi[AI_LEN, row] = len(tail)
                 full_tokens[row, : len(req.prompt_ids)] = req.prompt_ids
-            pr = np.full(
-                (A, self.max_pages_per_slot), self.alloc.sentinel, np.int32
-            )
-            for row, (idx, _) in enumerate(group):
-                pr[row] = self.alloc.table[idx]
-            with global_metrics.timer("engine.prefill_latency"):
-                (
-                    self.cache, self.dstate, self.sampling, first,
-                    self.history,
-                ) = admit_group_prefix_paged(
-                    self.params, self.cfg, self.cache, self.dstate,
-                    self.sampling, jnp.asarray(pages_arr),
-                    jnp.int32(plen), jnp.asarray(tail_tokens),
-                    jnp.asarray(tail_lens), jnp.asarray(full_tokens),
-                    jnp.asarray(slots), jnp.asarray(pr),
-                    jnp.asarray(temps), jnp.asarray(topks),
-                    jnp.asarray(topps), jnp.asarray(seeds),
-                    jnp.asarray(eos), jnp.asarray(jsonm),
-                    jnp.asarray(budgets), n_prefix_bucket=kb,
-                    json_tables=group_json, history=self.history,
-                    schema_ids=group_sids, schema_tables=group_schema,
+            mi[AI_PLEN] = plen
+            # Block-table rows under the lock: the reader thread mutates
+            # rows at early page release.
+            with self._lock:
+                pr = np.full(
+                    (A, self.max_pages_per_slot), self.alloc.sentinel,
+                    np.int32,
                 )
-            if not getattr(entry, "segmented", False):
-                # A chunked-prefill final reads its OWN chain — counting
-                # it as a cache hit would report near-100% hit rates on
-                # deployments with the prefix cache disabled.
-                global_metrics.inc("engine.prefix_hits", len(group))
-            # Blocks past the shared chain that the prompt fully covers
-            # are immutable too — register them as chain extensions.
-            self._maybe_register(group)
+                for row, (idx, _) in enumerate(group):
+                    pr[row] = self.alloc.table[idx]
+            prep.kind = "prefix_paged"
+            prep.pages_arr = pages_arr
+            prep.tail_tokens = tail_tokens
+            prep.full_tokens = full_tokens
+            prep.page_rows = pr
+            prep.n_prefix_bucket = kb
         elif entry is not None:
             # Cached-prefix admission: copy the stored panels, prefill
             # only the tails (an exact repeat is a one-token tail). Tail
@@ -1233,13 +1690,83 @@ class ContinuousBatcher:
             assert plen + Tt <= self.max_seq_len  # _prefix_hit guarantees
             Tf = self._bucket(max(len(r.prompt_ids) for _, r in group))
             tail_tokens = np.zeros((A, Tt), np.int32)
-            tail_lens = np.zeros((A,), np.int32)
             full_tokens = np.zeros((A, Tf), np.int32)
             for row, (idx, req) in enumerate(group):
                 tail = req.prompt_ids[plen:]
                 tail_tokens[row, : len(tail)] = tail
-                tail_lens[row] = len(tail)
+                mi[AI_LEN, row] = len(tail)
                 full_tokens[row, : len(req.prompt_ids)] = req.prompt_ids
+            mi[AI_PLEN] = plen
+            prep.kind = "prefix"
+            prep.tail_tokens = tail_tokens
+            prep.full_tokens = full_tokens
+        else:
+            T = self._bucket(max(len(r.prompt_ids) for _, r in group))
+            tokens = np.zeros((A, T), np.int32)
+            for row, (idx, req) in enumerate(group):
+                ids = req.prompt_ids
+                tokens[row, : len(ids)] = ids
+                mi[AI_LEN, row] = len(ids)
+            prep.tokens = tokens
+            if self.alloc is not None:
+                with self._lock:
+                    pr = np.full(
+                        (A, self.max_pages_per_slot), self.alloc.sentinel,
+                        np.int32,
+                    )
+                    for row, (idx, _) in enumerate(group):
+                        pr[row] = self.alloc.table[idx]
+                prep.page_rows = pr
+        return prep
+
+    def _dispatch_prefill(self, prep: _PreparedAdmission) -> None:
+        """Upload the prepared staging buffers and run the fused
+        admission dispatch, then install the slots (device thread only).
+        This is ALL the admission work left on the dispatch path: the
+        prefill is enqueued on the device stream BEHIND whatever decode
+        chunks are already in flight — chunked-prefill segments and
+        decode interleave with no host-side bubble between them."""
+        group = prep.group
+        entry = prep.entry
+        # Chaos point: a slow (delay=) or failed (exc=) admission prefill.
+        # Raises land in _dispatch_admissions' per-group failure handling
+        # — exactly the production path a device fault would take.
+        global_injector.fire("engine.prefill", n_requests=len(group))
+        # Bake the token tables into this dispatch only when the group
+        # actually constrains: with a 128k-vocab the B x V x L automaton
+        # simulation is pure waste for non-JSON traffic. Two jit variants
+        # total (with/without), both cached after first use. Schema
+        # tables follow the same two-variant discipline (their ids ride
+        # the packed meta buffer either way).
+        group_json = self.json_tables if prep.has_json else None
+        group_schema = self._schema_tables() if prep.has_schema else None
+        meta_i32 = jnp.asarray(prep.meta_i32)
+        meta_f32 = jnp.asarray(prep.meta_f32)
+
+        if prep.kind == "prefix_paged":
+            with global_metrics.timer("engine.prefill_latency"):
+                (
+                    self.cache, self.dstate, self.sampling, first,
+                    self.history,
+                ) = admit_group_prefix_paged(
+                    self.params, self.cfg, self.cache, self.dstate,
+                    self.sampling, jnp.asarray(prep.pages_arr),
+                    jnp.asarray(prep.tail_tokens),
+                    jnp.asarray(prep.full_tokens),
+                    jnp.asarray(prep.page_rows), meta_i32, meta_f32,
+                    n_prefix_bucket=prep.n_prefix_bucket,
+                    json_tables=group_json, history=self.history,
+                    schema_tables=group_schema,
+                )
+            if not getattr(entry, "segmented", False):
+                # A chunked-prefill final reads its OWN chain — counting
+                # it as a cache hit would report near-100% hit rates on
+                # deployments with the prefix cache disabled.
+                global_metrics.inc("engine.prefix_hits", len(group))
+            # Blocks past the shared chain that the prompt fully covers
+            # are immutable too — register them as chain extensions.
+            self._maybe_register(group)
+        elif prep.kind == "prefix":
             with global_metrics.timer("engine.prefill_latency"):
                 (
                     self.cache, self.dstate, self.sampling, first,
@@ -1247,36 +1774,13 @@ class ContinuousBatcher:
                 ) = admit_group_prefix(
                     self.params, self.cfg, self.cache, self.dstate,
                     self.sampling, entry.ks, entry.vs,
-                    jnp.int32(plen), jnp.asarray(tail_tokens),
-                    jnp.asarray(tail_lens), jnp.asarray(full_tokens),
-                    jnp.asarray(slots), jnp.asarray(temps),
-                    jnp.asarray(topks), jnp.asarray(topps),
-                    jnp.asarray(seeds), jnp.asarray(eos),
-                    jnp.asarray(jsonm), jnp.asarray(budgets),
+                    jnp.asarray(prep.tail_tokens),
+                    jnp.asarray(prep.full_tokens), meta_i32, meta_f32,
                     json_tables=group_json, history=self.history,
-                    schema_ids=group_sids, schema_tables=group_schema,
+                    schema_tables=group_schema,
                 )
             global_metrics.inc("engine.prefix_hits", len(group))
         else:
-            T = self._bucket(max(len(r.prompt_ids) for _, r in group))
-            tokens = np.zeros((A, T), np.int32)
-            lens = np.zeros((A,), np.int32)
-            for row, (idx, req) in enumerate(group):
-                ids = req.prompt_ids
-                tokens[row, : len(ids)] = ids
-                lens[row] = len(ids)
-            positions = np.broadcast_to(
-                np.arange(T, dtype=np.int32)[None], (A, T)
-            )
-            page_rows = None
-            if self.alloc is not None:
-                pr = np.full(
-                    (A, self.max_pages_per_slot), self.alloc.sentinel,
-                    np.int32,
-                )
-                for row, (idx, _) in enumerate(group):
-                    pr[row] = self.alloc.table[idx]
-                page_rows = jnp.asarray(pr)
             with global_metrics.timer("engine.prefill_latency"):
                 # One fused dispatch for the whole admission (prefill +
                 # cache write + sampler + first token + decode install +
@@ -1286,23 +1790,24 @@ class ContinuousBatcher:
                     self.history,
                 ) = admit_group(
                     self.params, self.cfg, self.cache, self.dstate,
-                    self.sampling, jnp.asarray(tokens), jnp.asarray(positions),
-                    jnp.asarray(lens), jnp.asarray(slots), jnp.asarray(temps),
-                    jnp.asarray(topks), jnp.asarray(topps), jnp.asarray(seeds),
-                    jnp.asarray(eos), jnp.asarray(jsonm), jnp.asarray(budgets),
-                    use_flash=self.on_tpu, flash_mesh=self.flash_mesh,
-                    page_rows=page_rows, json_tables=group_json,
-                    history=self.history,
-                    schema_ids=group_sids, schema_tables=group_schema,
+                    self.sampling, jnp.asarray(prep.tokens), meta_i32,
+                    meta_f32, use_flash=self.on_tpu,
+                    flash_mesh=self.flash_mesh,
+                    page_rows=(
+                        jnp.asarray(prep.page_rows)
+                        if prep.page_rows is not None else None
+                    ),
+                    json_tables=group_json, history=self.history,
+                    schema_tables=group_schema,
                 )
             if self.paged:
                 self._maybe_register(group)
             else:
                 self._maybe_export(group)
-        try:
-            first.copy_to_host_async()
-        except AttributeError:
-            pass
+        # The first tokens' D2H copy starts NOW; the reader materializes
+        # the in-flight copy at fold time (no fresh round trip).
+        first_copy = _HostCopy((first,))
+        self._last_prefill_t = time.perf_counter()
         admit_at = time.perf_counter()
         with self._lock:
             for idx, req in group:
@@ -1310,13 +1815,14 @@ class ContinuousBatcher:
                     request=req, prompt_len=len(req.prompt_ids)
                 )
                 self._gen[idx] += 1
+                self._prep_reserved.discard(idx)
                 # Fresh occupant: optimistic n-gram first (its lookups
                 # are free); the per-slot EMA demotes to model drafting
                 # only if this request's output proves unpredictable.
                 self._slot_rate[idx] = float(max(self.speculate, 1))
                 self._draft_on[idx] = False
             self._first_reads.append(
-                ([(idx, self._gen[idx]) for idx, _ in group], first)
+                ([(idx, self._gen[idx]) for idx, _ in group], first_copy)
             )
             slots_active = sum(s is not None for s in self._slots)
         for _, req in group:
@@ -1392,7 +1898,9 @@ class ContinuousBatcher:
             ids = tuple(req.prompt_ids[:-1])[: store.max_len]
             if len(ids) < store.min_len:
                 continue
-            if ids in seen or store.has(ids):
+            with self._lock:
+                known = ids in seen or store.has(ids)
+            if known:
                 continue
             seen.add(ids)
             try:
@@ -1408,10 +1916,17 @@ class ContinuousBatcher:
                 ks, vs = export_prefix(
                     self.cache, idx, p_bucket=pb, dtype=export_dtype
                 )
-                store.store(ids, ks, vs, pb)
-                for p in store.lcp_candidates(ids):
+                # Store bookkeeping under the slot lock: the admission
+                # prep thread runs match() against this store.
+                with self._lock:
+                    store.store(ids, ks, vs, pb)
+                    lcps = store.lcp_candidates(ids)
+                for p in lcps:
                     pb2 = self._bucket(p)
-                    store.store(ids[:p], ks[:, :, :pb2], vs[:, :, :pb2], pb2)
+                    with self._lock:
+                        store.store(
+                            ids[:p], ks[:, :, :pb2], vs[:, :, :pb2], pb2
+                        )
             except Exception as exc:  # noqa: BLE001 — cache is optional
                 self._log.warning("prefix export failed: %s", exc)
                 return
@@ -1457,7 +1972,9 @@ class ContinuousBatcher:
             self._first_reads.clear()
         if not groups:
             return
-        hosts = jax.device_get([f for _, f in groups])
+        # Each entry's copy started at admission dispatch; materializing
+        # here is not a fresh device round trip.
+        hosts = [copy.wait()[0] for _, copy in groups]
         with self._lock:
             emits = self._fold_first_tokens(groups, hosts)
         self._fire_stream(emits)
@@ -1489,6 +2006,7 @@ class ContinuousBatcher:
         # pipeline cycle earlier than the wave boundary.
         self._release_pages_locked(idx)
         self._wake.set()
+        self._prep_wake.set()
         if out and (out[-1] == req.eos_id or out[-1] in req.stop_ids):
             out = out[:-1]
         now = time.perf_counter()
@@ -1594,7 +2112,7 @@ class ContinuousBatcher:
         if not needs:
             return self.chunk_buckets[0]
         target = sum(needs) / len(needs)
-        if self._backlog or self._pending.qsize():
+        if self._backlog or self._pending.qsize() or self._prepped_reqs:
             target = min(target, float(min(needs)))
         for b in self.chunk_buckets:
             if b >= target:
@@ -1609,6 +2127,25 @@ class ContinuousBatcher:
         # device loop boundary → _fail_occupied_slots fails the occupants
         # with this exception while queued requests survive to re-admit.
         global_injector.fire("engine.step")
+        # Host-gap telemetry: how long the device sat with NOTHING in
+        # flight between the last fold/feed and this dispatch — the
+        # host-side bubble overlapped admission + non-blocking folds
+        # exist to close. 0 whenever the pipeline still held work (the
+        # device was fed). Host-side approximation: enqueue times stand
+        # in for device occupancy, which co-locates with it at chunk
+        # granularity.
+        t_dispatch = time.perf_counter()
+        with self._lock:
+            idle = self._inflight == 0
+            marks = [
+                t for t in (self._last_fold_done, self._last_prefill_t)
+                if t is not None
+            ]
+        gap_ms = (
+            max(0.0, (t_dispatch - max(marks)) * 1e3)
+            if idle and marks else 0.0
+        )
+        global_metrics.observe("engine.host_gap_ms", gap_ms)
         # Block table from the caller's under-lock snapshot (the reader
         # thread mutates rows at early release); absent when dense.
         table = jnp.asarray(table_np) if table_np is not None else None
@@ -1628,7 +2165,7 @@ class ContinuousBatcher:
             )
             use_pallas_now = gather_bytes > self._gather_budget
         # Token-mask tables ride along only while a live slot constrains
-        # (see _prefill_group). Lock-free read is safe: slots are INSTALLED
+        # (see _dispatch_prefill). Lock-free read is safe: slots are INSTALLED
         # on this thread (so a constraining slot is always seen), and the
         # reader only clears them (worst case: tables ride one extra
         # chunk).
@@ -1674,37 +2211,38 @@ class ContinuousBatcher:
                         page_strip=self.page_strip,
                     )
                 )
-        # Start the D2H transfer as soon as the chunk finishes computing,
-        # so the blocking read one pipeline-cycle later is a cache hit, not
-        # a full round trip (the tunnel RTT is ~100 ms).
-        try:
-            toks.copy_to_host_async()
-            valid.copy_to_host_async()
-        except AttributeError:  # non-jax array types in tests
-            pass
+        # Start the D2H transfer the moment the chunk is enqueued: the
+        # reader folds from this already-in-flight copy one pipeline
+        # cycle later (a wait on a landed transfer, not a fresh ~100 ms
+        # tunnel round trip — and never a jax.device_get).
+        copies = _HostCopy((toks, valid))
+        with self._lock:
+            self._inflight += 1
         # engine.decode_steps is counted at fold time (_process_chunk)
         # from folded validity — executed block-steps, not the
         # dispatched chunk length, which overcounted whenever early
         # exit / done slots ran fewer blocks than dispatched. The
         # dispatch stamp feeds the per-block wall-time EMA.
         return (
-            toks, valid, tuple(self._gen), est, hi, n_blocks,
-            time.perf_counter(),
+            copies, tuple(self._gen), est, hi, n_blocks,
+            time.perf_counter(), gap_ms,
         )
 
     def _process_chunk(
-        self, toks, valid, gen_stamp, est, hi, n_blocks, t_dispatch,
+        self, copies, gen_stamp, est, hi, n_blocks, t_dispatch, gap_ms,
     ) -> None:
-        """Host-read one finished chunk and fold its tokens into slots
-        (reader thread). Pending first-token arrays ride the same read."""
+        """Fold one finished chunk's tokens into slots (reader thread).
+        The chunk's D2H copy started at dispatch time (``_HostCopy``);
+        this wait materializes it — while chunk N+1 executes on device —
+        rather than opening a fresh blocking round trip. Pending
+        first-token copies (started at their admission dispatch) fold on
+        the same pass."""
         with self._lock:
             groups = list(self._first_reads)
             self._first_reads.clear()
-        firsts = [f for _, f in groups]
         with global_metrics.timer("engine.chunk_read_latency"):
-            fetched = jax.device_get([toks, valid] + firsts)
-        toks_h = np.asarray(fetched[0])
-        valid_h = np.asarray(fetched[1])
+            toks_h, valid_h = copies.wait()
+            first_hosts = [copy.wait()[0] for _, copy in groups]
         n, B = toks_h.shape
         # One block-validity view serves the draft EMA, the utilization
         # counters and the acceptance EMA below.
@@ -1719,7 +2257,7 @@ class ContinuousBatcher:
             # First tokens were sampled before this chunk ran — fold them
             # first so token order inside each slot is right.
             if groups:
-                emits = self._fold_first_tokens(groups, fetched[2:])
+                emits = self._fold_first_tokens(groups, first_hosts)
             for b in range(B):
                 slot = self._slots[b]
                 if slot is None or gen_stamp[b] != self._gen[b]:
@@ -1816,6 +2354,7 @@ class ContinuousBatcher:
             chunk_blocks=n_blocks,
             blocks_useful=useful_blocks,
             utilization=round(useful_blocks / max(n_blocks, 1), 3),
+            host_gap_ms=round(gap_ms, 3),
             slots_active=slots_active,
             queue_depth=self.queue_depth(),
             page_strip=self.page_strip,
@@ -1838,6 +2377,11 @@ class ContinuousBatcher:
                 obs = min(max(obs, 0.5), float(D))
                 self._spec_rate = 0.5 * self._spec_rate + 0.5 * obs
         global_metrics.inc("engine.generated_tokens_device", accepted)
+        # Host-gap bookkeeping: this chunk has left the pipeline; the
+        # next dispatch measures its bubble from here.
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+            self._last_fold_done = time.perf_counter()
 
     def _fire_stream(self, emits: List) -> None:
         """Fire streaming callbacks OUTSIDE the slot lock (reader thread).
@@ -1873,6 +2417,14 @@ class ContinuousBatcher:
                 # the affected requests forever and leak their slots.
                 self._log.error("reader error: %s", exc, exc_info=True)
                 self._fail_occupied_slots(exc)
+                # The failed chunk left the pipeline without reaching
+                # _process_chunk's bookkeeping tail. Sentinel failures
+                # (first-token drains) never entered the pipeline, so
+                # decrementing for them would mark a still-executing
+                # chunk's window as idle and fake a host-gap sample.
+                if item is not None:
+                    with self._lock:
+                        self._inflight = max(0, self._inflight - 1)
             self._wake.set()
         self._log.info("reader stopped")
 
@@ -1880,29 +2432,37 @@ class ContinuousBatcher:
         """(Re)create cache/sampling/decode state — at construction, and
         after a failed donated dispatch consumed the previous buffers
         (device thread only; failure callers must fail the occupants
-        first)."""
+        first). The allocator swap and epoch bump happen under the slot
+        lock, so a concurrent admission prep can never allocate half in
+        the old pool and half in the new: a prep stamped with the old
+        epoch requeues at dispatch time instead of prefilling against
+        the fresh allocator's sentinel rows."""
         if self.paged:
-            self.cache = PagedKVCache.create(
+            cache = PagedKVCache.create(
                 self.cfg.n_layers, self.n_slots, self.num_pages,
                 self.page_size, self.cfg.n_kv_heads, self.cfg.head_dim,
                 dtype=self.cache_dtype, quantized=self.kv_quantize,
             )
-            self.alloc = PageAllocator(
+            alloc = PageAllocator(
                 self.num_pages, self.page_size, self.n_slots,
                 self.max_pages_per_slot,
             )
-            # A fresh pool invalidates every cached page — reset the
-            # index's bookkeeping (the allocator above is new, so no
-            # unpinning against the old one).
-            if getattr(self, "page_index", None) is not None:
-                self.page_index.clear()
         else:
-            self.cache = KVCache.create(
+            cache = KVCache.create(
                 self.cfg.n_layers, self.n_slots, self.max_seq_len,
                 self.cfg.n_kv_heads, self.cfg.head_dim,
                 dtype=self.cache_dtype, quantized=self.kv_quantize,
             )
-            self.alloc = None
+            alloc = None
+        with self._lock:
+            self.cache = cache
+            self.alloc = alloc
+            self._alloc_epoch += 1
+            # A fresh pool invalidates every cached page — reset the
+            # index's bookkeeping (the allocator above is new, so no
+            # unpinning against the old one).
+            if self.paged and getattr(self, "page_index", None) is not None:
+                self.page_index.clear()
         self.sampling = SamplingState.create(self.n_slots)
         self.dstate = DecodeState.create(self.n_slots)
         # Per-slot token-id history by position (speculative drafting).
@@ -1924,6 +2484,7 @@ class ContinuousBatcher:
                     self._release.append(i)
                     self._release_pages_locked(i)
             self._first_reads.clear()
+        self._prep_wake.set()
 
     def _run(self) -> None:
         self._log.info(
@@ -2008,7 +2569,10 @@ class ContinuousBatcher:
         return {
             "slots_total": self.n_slots,
             "slots_active": sum(s is not None for s in self._slots),
-            "pending": self._pending.qsize() + len(self._backlog),
+            # queue_depth(), not pending+backlog: prepared-but-not-yet-
+            # dispatched admissions count toward shedding, so they must
+            # be visible here too or shed storms look causeless.
+            "pending": self.queue_depth(),
             **(
                 {"kv_pages_free": self.alloc.free_pages,
                  "kv_pages_total": self.num_pages - 1,
@@ -2026,6 +2590,8 @@ class ContinuousBatcher:
                 if self.page_index is not None else {}
             ),
             "decode_steps": global_metrics.get("engine.decode_steps"),
+            "overlap_admission": self.overlap_admission,
+            "pipeline_depth": self.PIPELINE_DEPTH,
             "chunk_policy": self.chunk_policy,
             "chunk_buckets": list(self.chunk_buckets),
             "chunk_utilization": round(

@@ -234,6 +234,7 @@ class NativeEngine(LLMBackend):
             kv_quantize=self.config.engine_kv_quantize == "int8",
             draft_layers=self.config.engine_draft_layers,
             pipeline_depth=self.config.engine_pipeline,
+            overlap_admission=self.config.engine_overlap_admission,
             schema_bank=self.schema_bank,
             prefill_chunk=self.config.engine_prefill_chunk,
             max_queue_depth=self.config.reliability.max_queue_depth,

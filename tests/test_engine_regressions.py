@@ -44,7 +44,7 @@ def test_prefill_failure_fails_future_not_thread():
     def boom(*a, **k):
         raise RuntimeError("prefill exploded")
 
-    batcher._prefill_group = boom  # type: ignore[assignment]
+    batcher._dispatch_prefill = boom  # type: ignore[assignment]
     batcher.start()
     try:
         req = GenRequest(prompt_ids=[1, 2, 3], max_new_tokens=4)

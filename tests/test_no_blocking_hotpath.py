@@ -1,0 +1,141 @@
+"""Blocking-call tripwire for the device-feed pipeline (PERF_NOTES r8).
+
+The asynchronous device-feed work rests on one invariant: the
+dispatch/fold hot path of ``engine/batcher.py`` never issues a blocking
+device read. D2H copies start at dispatch time (``_HostCopy``) and
+folds materialize the already-in-flight copy; a reintroduced
+``jax.device_get`` / ``block_until_ready`` / ``np.asarray(<device
+array>)`` would silently re-serialize host and device and the only
+symptom would be a slow bench three rounds later. This test walks the
+hot-path methods' ASTs and fails on any such call outside the explicit
+allowlist — the invariant can't rot unnoticed.
+
+Deliberately NOT in the hot set: ``warmup`` / ``_autotune_page_strip``
+(one-shot, device idle by construction), ``stop`` (shutdown quiesce),
+``_rebuild_device_state`` (error recovery). Those are the allowed
+blocking sites.
+"""
+
+import ast
+import inspect
+import textwrap
+
+import pilottai_tpu.engine.batcher as batcher_mod
+from pilottai_tpu.engine.batcher import ContinuousBatcher, _HostCopy
+
+# Every method that runs per dispatch or per fold at steady state, on
+# the device thread, the admission-prep thread or the reader thread.
+HOT_PATH = {
+    # device thread
+    "_run", "_admit", "_dispatch_admissions", "_dispatch_prefill",
+    "_dispatch_chunk", "_advance_segment", "_requeue_prepared",
+    "_expire_deadlines", "_schema_tables", "_maybe_register",
+    "_maybe_export", "_pick_chunk_blocks", "_chunk_useful",
+    # admission-prep thread
+    "_prep_loop", "_select_groups", "_prepare_prefill", "_drain_pending",
+    # reader thread
+    "_read_loop", "_process_chunk", "_drain_first_reads",
+    "_fold_first_tokens", "_check_finished", "_fire_stream",
+    "_fail_group", "_fail_occupied_slots", "_release_pages_locked",
+}
+
+# Attribute calls that block the calling thread on the device, in any
+# spelling (``jax.device_get(x)`` and ``x.block_until_ready()`` are both
+# Attribute calls).
+BANNED_ATTRS = {"device_get", "block_until_ready"}
+
+# ``np.asarray`` is legal ONLY on host-resident data. Allowlist by
+# (function name, unparsed first argument): these are numpy arrays the
+# fold already holds (produced by ``_HostCopy.wait``, the sanctioned
+# wait on an async copy started at dispatch).
+ASARRAY_ALLOWED = {
+    ("_fold_first_tokens", "host"),
+}
+
+
+def _violations_in(tree: ast.AST, func_name: str):
+    """Banned blocking calls inside one function's AST."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in BANNED_ATTRS:
+                out.append((func_name, node.lineno, ast.unparse(fn)))
+            elif fn.attr == "asarray" and isinstance(fn.value, ast.Name) \
+                    and fn.value.id in ("np", "numpy"):
+                arg = ast.unparse(node.args[0]) if node.args else ""
+                if (func_name, arg) not in ASARRAY_ALLOWED:
+                    out.append((
+                        func_name, node.lineno, f"np.asarray({arg})"
+                    ))
+        elif isinstance(fn, ast.Name) and fn.id in BANNED_ATTRS:
+            out.append((func_name, node.lineno, fn.id))
+    return out
+
+
+def _hot_path_functions():
+    """(name, ast) for every hot-path method actually present — with a
+    guard that the set tracks reality: a renamed/deleted hot function
+    must update this test, not silently fall out of coverage."""
+    found = {}
+    src = inspect.getsource(batcher_mod)
+    tree = ast.parse(src)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in HOT_PATH:
+                found[node.name] = node
+    missing = HOT_PATH - set(found)
+    assert not missing, (
+        f"hot-path functions missing from engine/batcher.py: {missing} — "
+        "renamed or removed? Update HOT_PATH to keep the tripwire honest."
+    )
+    return found
+
+
+def test_no_blocking_calls_on_dispatch_or_fold_path():
+    violations = []
+    for name, node in _hot_path_functions().items():
+        violations.extend(_violations_in(node, name))
+    assert not violations, (
+        "blocking device reads reintroduced on the device-feed hot path "
+        f"(use _HostCopy started at dispatch time instead): {violations}"
+    )
+
+
+def test_tripwire_detects_reintroduced_device_get():
+    """The checker itself must trip on the exact regressions it guards
+    against — otherwise a refactor could neuter it silently."""
+    poisoned = textwrap.dedent("""
+        def _process_chunk(self, item):
+            fetched = jax.device_get([item.toks, item.valid])
+            jax.block_until_ready(fetched)
+            host = np.asarray(item.toks)
+            return fetched
+    """)
+    node = ast.parse(poisoned).body[0]
+    found = _violations_in(node, "_process_chunk")
+    kinds = {v[2] for v in found}
+    assert "jax.device_get" in kinds
+    assert "jax.block_until_ready" in kinds
+    assert "np.asarray(item.toks)" in kinds
+
+
+def test_host_copy_is_the_sanctioned_wait():
+    """_HostCopy must start its copies at construction (dispatch time)
+    and expose only a wait() that materializes them — the structure the
+    allowlist above assumes."""
+    src = inspect.getsource(_HostCopy)
+    tree = ast.parse(textwrap.dedent(src))
+    init_src = ""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "__init__":
+            init_src = ast.unparse(node)
+    assert "copy_to_host_async" in init_src, (
+        "_HostCopy.__init__ no longer starts the async copy — folds "
+        "would pay a full blocking round trip again"
+    )
+    # The batcher's fold path must actually route through it.
+    batcher_src = inspect.getsource(ContinuousBatcher._process_chunk)
+    assert ".wait()" in batcher_src

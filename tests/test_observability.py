@@ -284,19 +284,20 @@ async def test_ttft_tpot_percentiles_from_mock_engine_run():
     yields TTFT/TPOT percentile surfaces from MetricsRegistry."""
     from pilottai_tpu.utils.metrics import global_metrics
 
-    before = global_metrics.snapshot()["histograms"]
+    # Isolate the shared global registry: drop the request-phase
+    # histograms up front so each count below is EXACT for this test's
+    # 4 flights, independent of suite order. (The earlier fix compared
+    # per-histogram growth — TPOT only records for >1-token flights, so
+    # a 1-token ok flight anywhere in the process legitimately left
+    # TPOT's count below TTFT's; a clean window removes the baseline
+    # arithmetic entirely.)
+    global_metrics.reset_histograms("request.")
     handler = _mock_handler(latency=0.002)
     for i in range(4):
         await handler.apredict(f"measure ttft {i}")
     hists = global_metrics.snapshot()["histograms"]
     for name in ("request.ttft_s", "request.tpot_s", "request.e2e_s"):
-        # Baseline per histogram: TPOT only records for flights with
-        # >1 token, so earlier in-process traffic (a 1-token ok flight
-        # anywhere in the suite) legitimately leaves TPOT's count below
-        # TTFT's — comparing each metric's own growth is what this test
-        # actually means.
-        n_before = (before.get(name) or {}).get("count", 0)
-        assert hists[name]["count"] >= n_before + 4, name
+        assert hists[name]["count"] == 4, name
         assert hists[name]["p50"] is not None
         assert hists[name]["p99"] is not None
     assert phase_summary()["ttft"]["p50_ms"] is not None

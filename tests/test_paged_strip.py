@@ -237,6 +237,7 @@ def test_fused_decode_chunk_matches_xla_fallback(monkeypatch):
         DecodeState,
         admit_group,
         decode_chunk,
+        pack_admit_meta,
     )
     from pilottai_tpu.engine.sampling import SamplingState
     from pilottai_tpu.models.common import init_params
@@ -251,17 +252,11 @@ def test_fused_decode_chunk_matches_xla_fallback(monkeypatch):
     tokens = np.zeros((A, T), np.int32)
     for i in range(2):
         tokens[i, : lens[i]] = rng.integers(2, cfg.vocab_size, lens[i])
-    slots = jnp.asarray([0, 2, Bs, Bs], jnp.int32)
-    positions = jnp.broadcast_to(
-        jnp.arange(T, dtype=jnp.int32)[None], (A, T)
+    mi, mf = pack_admit_meta(
+        A, slots=[0, 2, Bs, Bs], seeds=range(10, 10 + A),
+        budgets=[12, 12, 0, 0], lens=lens, pad_slot=Bs,
     )
-    base_args = (
-        jnp.asarray(tokens), positions, jnp.asarray(lens), slots,
-        jnp.zeros((A,), jnp.float32), jnp.zeros(A, jnp.int32),
-        jnp.ones(A), jnp.arange(10, 10 + A, dtype=jnp.int32),
-        jnp.full((A,), -1, jnp.int32), jnp.zeros((A,), bool),
-        jnp.asarray([12, 12, 0, 0], jnp.int32),
-    )
+    base_args = (jnp.asarray(tokens), jnp.asarray(mi), jnp.asarray(mf))
 
     def admit():
         alloc = PageAllocator(4 * Bs + 1, P, Bs, max_pages_per_slot=S // P)

@@ -20,6 +20,7 @@ from pilottai_tpu.engine.decode import (
     admit_group,
     decode_chunk,
     decode_chunk_spec,
+    pack_admit_meta,
 )
 from pilottai_tpu.engine.sampling import SamplingState
 from pilottai_tpu.models.common import init_params
@@ -38,7 +39,6 @@ def _admit(cfg, params, prompts, budgets, temps=None, jsonm=None,
     for i, p in enumerate(prompts):
         tokens[i, : len(p)] = p
         lens[i] = len(p)
-    positions = np.broadcast_to(np.arange(T, dtype=np.int32)[None], (A, T))
     cache = KVCache.create(
         cfg.n_layers, n_slots, max_seq, cfg.n_kv_heads, cfg.head_dim,
         dtype=jnp.float32,
@@ -46,17 +46,15 @@ def _admit(cfg, params, prompts, budgets, temps=None, jsonm=None,
     history = jnp.zeros((n_slots, max_seq), jnp.int32)
     temps = temps or [0.0] * A
     jsonm = jsonm or [False] * A
+    mi, mf = pack_admit_meta(
+        A, slots=range(A), temps=temps, seeds=range(A), eos=[eos] * A,
+        jsonm=[int(j) for j in jsonm],
+        budgets=[b - 1 for b in budgets], lens=lens, pad_slot=n_slots,
+    )
     cache, dstate, sampling, first, history = admit_group(
         params, cfg, cache, DecodeState.create(n_slots),
         SamplingState.create(n_slots),
-        jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(lens),
-        jnp.asarray(list(range(A)), jnp.int32),
-        jnp.asarray(temps, jnp.float32),
-        jnp.zeros((A,), jnp.int32), jnp.ones((A,), jnp.float32),
-        jnp.arange(A, dtype=jnp.int32),
-        jnp.full((A,), eos, jnp.int32),
-        jnp.asarray(jsonm),
-        jnp.asarray([b - 1 for b in budgets], jnp.int32),
+        jnp.asarray(tokens), jnp.asarray(mi), jnp.asarray(mf),
         use_flash=False, history=history,
     )
     return cache, dstate, sampling, history, np.asarray(first)[:A]
