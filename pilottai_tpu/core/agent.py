@@ -29,7 +29,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from pilottai_tpu.core.config import AgentConfig, LLMConfig
 from pilottai_tpu.core.status import AgentStatus
-from pilottai_tpu.core.task import Task, TaskResult, TaskStatus
+from pilottai_tpu.core.task import Task, TaskPriority, TaskResult, TaskStatus
 from pilottai_tpu.prompts.manager import PromptManager
 from pilottai_tpu.prompts.schemas import schema_for
 from pilottai_tpu.tools.tool import Tool, ToolRegistry
@@ -502,11 +502,22 @@ class BaseAgent:
             backstory=self.config.backstory or "none",
         )
 
+    @staticmethod
+    def _slo_class_for(task: Optional[Task]) -> str:
+        """Map the task kind onto an SLO service class (obs/slo.py):
+        LOW-priority work is fan-out/backlog traffic nobody is watching
+        stream — batch objectives; everything else (NORMAL and up, and
+        taskless control calls) serves a caller who is waiting."""
+        if task is not None and task.priority <= TaskPriority.LOW:
+            return "batch"
+        return "interactive"
+
     async def _ask(
         self,
         prompt: str,
         tools: Optional[List[Dict[str, Any]]] = None,
         schema: Optional[Dict[str, Any]] = None,
+        task: Optional[Task] = None,
     ) -> Dict[str, Any]:
         # Every rules.yaml prompt demands strict JSON: constrained decoding
         # makes the reply well-formed by construction on in-tree engines —
@@ -520,6 +531,7 @@ class BaseAgent:
             tools=tools,
             json_mode=True,
             json_schema=schema,
+            slo_class=self._slo_class_for(task),
         )
         self.conversation_history.append(
             {"prompt_tail": prompt[-200:], "response": response.content[:500]}
@@ -535,7 +547,9 @@ class BaseAgent:
 
     async def _analyze_task(self, task: Task) -> Dict[str, Any]:
         prompt = self.prompts.format_prompt("task_analysis", task=task.to_prompt())
-        return await self._ask(prompt, schema=schema_for("agent", "task_analysis"))
+        return await self._ask(
+            prompt, schema=schema_for("agent", "task_analysis"), task=task
+        )
 
     async def _select_tools(self, task: Task) -> List[Tool]:
         candidates = (
@@ -551,7 +565,7 @@ class BaseAgent:
         )
         data = await self._ask(
             prompt, tools=[t.to_spec() for t in candidates],
-            schema=schema_for("agent", "tool_selection"),
+            schema=schema_for("agent", "tool_selection"), task=task,
         )
         names = data.get("selected_tools", [])
         if not names and data.get("action"):
@@ -587,7 +601,7 @@ class BaseAgent:
                 ) or "none yet"),
             )
             plan = await self._ask(
-                prompt, tools=[t.to_spec() for t in tools] or None
+                prompt, tools=[t.to_spec() for t in tools] or None, task=task
             )
             action = plan.get("action", "respond")
             complete = coerce_bool(plan.get("task_complete", False))
@@ -627,7 +641,7 @@ class BaseAgent:
             "result_evaluation", task=task.to_prompt(), result=str(output)[:2000]
         )
         return await self._ask(
-            prompt, schema=schema_for("agent", "result_evaluation")
+            prompt, schema=schema_for("agent", "result_evaluation"), task=task
         )
 
     # ------------------------------------------------------------------ #

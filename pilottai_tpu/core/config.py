@@ -406,6 +406,10 @@ class ScalingConfig(BaseModel):
     max_agents: int = Field(default=10, ge=1)
     cooldown: float = Field(default=300.0, ge=0)
     trend_window: int = Field(default=5, ge=1)
+    # Normalizer for the engine admission-queue signal when the engine
+    # runs without a shed limit (engine.max_queue_depth gauge absent):
+    # this many queued-not-admitted requests read as 100% queue pressure.
+    queue_depth_ref: int = Field(default=64, ge=1)
 
 
 class FaultToleranceConfig(BaseModel):

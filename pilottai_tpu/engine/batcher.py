@@ -94,7 +94,12 @@ from pilottai_tpu.models.common import ModelConfig
 from pilottai_tpu.ops.kvcache import KVCache, free_slots
 from pilottai_tpu.ops.paged import PageAllocator, PagedKVCache
 from pilottai_tpu.ops.pallas.decode_attention import decode_shapes_ok
-from pilottai_tpu.obs import global_blackbox, global_flight, global_steps
+from pilottai_tpu.obs import (
+    global_attribution,
+    global_blackbox,
+    global_flight,
+    global_steps,
+)
 from pilottai_tpu.reliability import (
     DeadlineExceeded,
     EngineOverloaded,
@@ -564,6 +569,31 @@ class ContinuousBatcher:
         self._inflight = 0
         self._last_fold_done: Optional[float] = None
         self._last_prefill_t: Optional[float] = None
+        # Device-time attribution (obs/attribution.py): decode time is
+        # estimated as the fold-to-fold interval minus the measured idle
+        # gap and the prefill enqueue walls that landed inside it
+        # (accumulated here between folds, under the lock).
+        self._last_attr_mark: Optional[float] = None
+        self._prefill_since_fold = 0.0
+        # Live MFU/attribution gauges: the model's FLOPs formula, the
+        # platform peak and the mesh shape — the same
+        # ModelConfig.flops_per_token() bench.py uses, so live and bench
+        # MFU reconcile by construction.
+        global_attribution.configure(
+            flops_per_token=cfg.flops_per_token(),
+            platform="tpu" if self.on_tpu else "cpu",
+            n_chips=int(mesh.devices.size) if mesh is not None else 1,
+            mesh_axes=(
+                tuple(str(a) for a in mesh.axis_names)
+                if mesh is not None else ()
+            ),
+        )
+        # (engine.queue_depth is declared at obs import — the exported
+        # surface exists from process boot; the batcher only sets it.)
+        if self.max_queue_depth is not None:
+            global_metrics.set_gauge(
+                "engine.max_queue_depth", float(self.max_queue_depth)
+            )
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -849,6 +879,9 @@ class ContinuousBatcher:
         # into a structured 429 before any engine state exists for it.
         if self.saturated():
             global_metrics.inc("engine.shed")
+            global_metrics.set_gauge(
+                "engine.queue_depth", float(self.queue_depth())
+            )
             global_steps.record(
                 "engine.shed",
                 queue_depth=self.queue_depth(),
@@ -883,6 +916,13 @@ class ContinuousBatcher:
         if len(request.prompt_ids) > keep:
             request.prompt_ids = request.prompt_ids[-keep:]
         self._pending.put(request)
+        # Gauge on EVERY enqueue, not just admit/fold/shed: a backlog
+        # building while the device thread is pinned (e.g. segmenting
+        # one long prefill) must be visible to the autoscaler's
+        # engine_queue_frac signal as it grows, not after it drains.
+        global_metrics.set_gauge(
+            "engine.queue_depth", float(self.queue_depth())
+        )
         self._wake.set()
         self._prep_wake.set()
         return request.future
@@ -1553,6 +1593,7 @@ class ContinuousBatcher:
                 pages_arr[:k] = self.alloc.table[idx, :k]
                 seg_tokens = np.zeros((1, seg), np.int32)
                 seg_tokens[0] = req.prompt_ids[done: done + seg]
+                t_seg = time.perf_counter()
                 with global_metrics.timer("engine.prefill_latency"):
                     self.cache = extend_prompt_paged(
                         self.params, self.cfg, self.cache,
@@ -1562,6 +1603,13 @@ class ContinuousBatcher:
                         jnp.asarray(self.alloc.table[idx][None]),
                     )
                 global_metrics.inc("engine.prefill_segments")
+                if not self._warming:
+                    seg_dur = time.perf_counter() - t_seg
+                    global_attribution.record(
+                        "prefill", seg_dur, tokens=seg
+                    )
+                    with self._lock:
+                        self._prefill_since_fold += seg_dur
                 self._segmenting[2] = done + seg
                 self._wake.set()  # next cycle advances without the idle wait
                 return
@@ -1742,6 +1790,7 @@ class ContinuousBatcher:
         group_schema = self._schema_tables() if prep.has_schema else None
         meta_i32 = jnp.asarray(prep.meta_i32)
         meta_f32 = jnp.asarray(prep.meta_f32)
+        t_pf = time.perf_counter()
 
         if prep.kind == "prefix_paged":
             with global_metrics.timer("engine.prefill_latency"):
@@ -1809,6 +1858,36 @@ class ContinuousBatcher:
         first_copy = _HostCopy((first,))
         self._last_prefill_t = time.perf_counter()
         admit_at = time.perf_counter()
+        if not self._warming:
+            # Attribution: tokens actually prefilled this dispatch (the
+            # AI_LEN rows carry tail lengths on prefix paths — prefix-hit
+            # pages were NOT recomputed and must not count as achieved
+            # FLOPs). The enqueue wall doubles as the prefill-time
+            # estimate.
+            pf_dur = admit_at - t_pf
+            pf_tokens = int(prep.meta_i32[AI_LEN].sum())
+            global_attribution.record("prefill", pf_dur, tokens=pf_tokens)
+            idle_s = 0.0
+            with self._lock:
+                if self._inflight == 0:
+                    # Device was DRAINED when this admission arrived: the
+                    # span from the last fold to here was genuine idle —
+                    # the decode-dispatch gap telemetry can't see it
+                    # (its marks get masked by _last_prefill_t) — and
+                    # the next fold's decode interval must restart at
+                    # this prefill's END. Without both, idle-then-burst
+                    # traffic books the whole idle span as decode time
+                    # and busy_frac reads ~1.0 on an idle engine.
+                    if self._last_attr_mark is not None:
+                        idle_s = max(t_pf - self._last_attr_mark, 0.0)
+                    self._last_attr_mark = admit_at
+                else:
+                    # Decode chunks in flight: the enclosing fold-to-fold
+                    # interval spans this prefill; remember the wall so
+                    # the fold doesn't count it twice.
+                    self._prefill_since_fold += pf_dur
+            if idle_s > 0.0:
+                global_attribution.record_gap(idle_s, at=t_pf)
         with self._lock:
             for idx, req in group:
                 self._slots[idx] = _Slot(
@@ -1832,11 +1911,13 @@ class ContinuousBatcher:
             # different start point would disagree at the tails).
             if req.flight_key is not None:
                 global_flight.mark(req.flight_key, "admitted", at=admit_at)
+        depth = self.queue_depth()
+        global_metrics.set_gauge("engine.queue_depth", float(depth))
         global_steps.record(
             "engine.admit",
             n=len(group),
             slots_active=slots_active,
-            queue_depth=self.queue_depth(),
+            queue_depth=depth,
         )
         global_metrics.inc("engine.admitted", len(group))
 
@@ -2146,6 +2227,10 @@ class ContinuousBatcher:
             if idle and marks else 0.0
         )
         global_metrics.observe("engine.host_gap_ms", gap_ms)
+        if gap_ms > 0.0 and not self._warming:
+            # Measured device-idle bubble: the live busy-frac gauge is
+            # the complement of these over its window.
+            global_attribution.record_gap(gap_ms / 1e3, at=t_dispatch)
         # Block table from the caller's under-lock snapshot (the reader
         # thread mutates rows at early release); absent when dense.
         table = jnp.asarray(table_np) if table_np is not None else None
@@ -2348,6 +2433,8 @@ class ContinuousBatcher:
             )
         # Engine step telemetry: one bounded ring record per folded chunk
         # — what the black-box dump replays when a request dies.
+        depth = self.queue_depth()
+        global_metrics.set_gauge("engine.queue_depth", float(depth))
         global_steps.record(
             "engine.chunk",
             tokens=accepted,
@@ -2356,7 +2443,7 @@ class ContinuousBatcher:
             utilization=round(useful_blocks / max(n_blocks, 1), 3),
             host_gap_ms=round(gap_ms, 3),
             slots_active=slots_active,
-            queue_depth=self.queue_depth(),
+            queue_depth=depth,
             page_strip=self.page_strip,
             pipeline_depth=self.PIPELINE_DEPTH,
             **(
@@ -2379,9 +2466,27 @@ class ContinuousBatcher:
         global_metrics.inc("engine.generated_tokens_device", accepted)
         # Host-gap bookkeeping: this chunk has left the pipeline; the
         # next dispatch measures its bubble from here.
+        t_fold = time.perf_counter()
         with self._lock:
             self._inflight = max(0, self._inflight - 1)
-            self._last_fold_done = time.perf_counter()
+            self._last_fold_done = t_fold
+            prev_mark = self._last_attr_mark
+            self._last_attr_mark = t_fold
+            pf_since = self._prefill_since_fold
+            self._prefill_since_fold = 0.0
+        if not self._warming:
+            # Decode device-time estimate: the fold-to-fold interval
+            # minus the measured idle gap and any prefill enqueue walls
+            # inside it (already attributed above). Pipelined chunks make
+            # per-dispatch walls overlap; fold-to-fold sums to occupancy
+            # instead of double-counting. Achieved FLOPs count ACCEPTED
+            # tokens only (folded validity) — rejected speculative rows
+            # ran the weights but did no useful work.
+            if prev_mark is not None:
+                dur = max(t_fold - prev_mark - gap_ms / 1e3 - pf_since, 0.0)
+            else:
+                dur = max(t_fold - t_dispatch, 0.0)
+            global_attribution.record("decode", dur, tokens=accepted)
 
     def _fire_stream(self, emits: List) -> None:
         """Fire streaming callbacks OUTSIDE the slot lock (reader thread).
@@ -2600,6 +2705,15 @@ class ContinuousBatcher:
                 4,
             ),
             "completed": global_metrics.get("engine.completed"),
+            # Live attribution gauges (obs/attribution.py): rolling-
+            # window MFU and the measured-idle complement.
+            "mfu": round(global_metrics.get("engine.mfu"), 4),
+            "device_busy_frac": round(
+                global_metrics.get("engine.device_busy_frac"), 4
+            ),
+            "collective_frac": round(
+                global_metrics.get("engine.collective_frac"), 4
+            ),
             **(
                 {"max_queue_depth": self.max_queue_depth,
                  "shed": global_metrics.get("engine.shed")}

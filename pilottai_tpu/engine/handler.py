@@ -155,6 +155,7 @@ class LLMHandler:
         params: Optional[GenerationParams],
         json_mode: Optional[bool],
         json_schema: Optional[Dict[str, Any]] = None,
+        slo_class: Optional[str] = None,
     ):
         """One request-normalization path for the streaming AND
         non-streaming calls — the two must never drift in default-params
@@ -180,6 +181,11 @@ class LLMHandler:
             params = params.model_copy(
                 update={"json_schema": json_schema, "json_mode": True}
             )
+        if slo_class is not None and params.slo_class is None:
+            # Caller-level default (the agent's task kind): fills in only
+            # when params carry no class, so an explicit per-request
+            # class (the HTTP edge's) always survives.
+            params = params.model_copy(update={"slo_class": slo_class})
         return msgs, specs, params
 
     def _ensure_trace(self, params: GenerationParams) -> GenerationParams:
@@ -243,20 +249,24 @@ class LLMHandler:
         params: Optional[GenerationParams] = None,
         json_mode: Optional[bool] = None,
         json_schema: Optional[Dict[str, Any]] = None,
+        slo_class: Optional[str] = None,
     ) -> LLMResponse:
         """Chat completion with retry/backoff (reference ``llm.py:38-66``).
 
         ``json_mode`` overrides the config/params flag — protocol call
         sites (rules.yaml prompts demand strict JSON) set it True to get
         grammar-constrained decoding on byte-tokenizer engines.
+        ``slo_class`` fills the request's SLO class when params carry
+        none (the orchestrator passes its task-derived class here).
         """
         msgs, specs, params = self._normalize(
-            messages, tools, params, json_mode, json_schema
+            messages, tools, params, json_mode, json_schema, slo_class
         )
         params = self._ensure_trace(params)
         trace_id, flight_id = params.trace_id, params.flight_id
         global_flight.start(
-            flight_id, trace_id=trace_id, model=self.config.model_name
+            flight_id, trace_id=trace_id, model=self.config.model_name,
+            slo_class=params.slo_class,
         )
 
         deadline = params.deadline
@@ -452,6 +462,7 @@ class LLMHandler:
         params: Optional[GenerationParams] = None,
         json_mode: Optional[bool] = None,
         json_schema: Optional[Dict[str, Any]] = None,
+        slo_class: Optional[str] = None,
         info: Optional[Dict[str, Any]] = None,
     ):
         """Streaming chat completion: an async generator of text deltas
@@ -468,13 +479,14 @@ class LLMHandler:
         if isinstance(messages, str):
             messages = [messages]
         msgs, specs, params = self._normalize(
-            messages, tools, params, json_mode, json_schema
+            messages, tools, params, json_mode, json_schema, slo_class
         )
         params = self._ensure_trace(params)
         trace_id, flight_id = params.trace_id, params.flight_id
         global_flight.start(
             flight_id, trace_id=trace_id,
             model=self.config.model_name, stream=True,
+            slo_class=params.slo_class,
         )
 
         deadline = params.deadline

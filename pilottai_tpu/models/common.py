@@ -83,6 +83,30 @@ class ModelConfig:
         head = 0 if self.tie_embeddings else E * V
         return V * E + L * per_layer + E + head
 
+    def active_param_count(self) -> int:
+        """Parameters touched per token on the forward pass: equals
+        ``param_count`` for dense models; for MoE, only the router plus
+        the top-k routed experts' MLPs count — the inactive experts'
+        weights never stream from HBM for that token."""
+        if self.n_experts <= 0:
+            return self.param_count()
+        E, F = self.hidden_size, self.intermediate_size
+        mlp_one = 2 * E * F + F * E
+        inactive = max(self.n_experts - self.n_active_experts, 0)
+        return self.param_count() - self.n_layers * inactive * mlp_one
+
+    def flops_per_token(self) -> float:
+        """Model FLOPs per generated/prefilled token: 2 (multiply +
+        accumulate) per active parameter — the standard weight-bound
+        approximation (attention-score FLOPs are context-dependent and
+        a few percent at serving context lengths; MFU derived from this
+        is therefore a slight *under*-estimate, consistently so).
+
+        This is THE formula for every MFU the repo reports: the live
+        ``engine.mfu`` gauge (obs/attribution.py) and the bench sections
+        both call it, so the numbers reconcile by construction."""
+        return 2.0 * self.active_param_count()
+
 
 def init_params(
     cfg: ModelConfig, key: jax.Array, dtype: Optional[Any] = None,

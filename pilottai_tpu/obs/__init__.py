@@ -8,18 +8,30 @@ Correlates the three previously disconnected pieces — ``utils/metrics``
   TPOT phase ledger keyed by trace id, exported as histograms).
 * ``ring``     — bounded engine step telemetry ring (slot occupancy,
   tokens/step, KV page utilization, strip width, pipeline depth).
+* ``slo``      — per-class (interactive/batch) SLO attainment, error-
+  budget burn rate, ``/slo.json`` snapshot; fed by every finished
+  flight via the finish-listener hook below.
+* ``attribution`` — continuous device-time/phase attribution and the
+  live ``engine.mfu`` / ``engine.device_busy_frac`` /
+  ``engine.collective_frac`` gauges; fed per dispatch by the batcher.
 * ``blackbox`` — dump coordinator: last N steps + the affected request's
   span tree, journaled on deadline expiry / breaker open / errors.
 * ``export``   — Prometheus text exposition, Chrome/Perfetto
-  ``trace_event`` JSON, the shared ``metrics_snapshot`` and the bench's
-  ``phase_summary``.
+  ``trace_event`` JSON, the shared ``metrics_snapshot``, the bench's
+  ``phase_summary`` and the ``export_completeness`` wiring check.
 
 Import cost: stdlib + utils + checkpoint.journal only — no jax, safe for
 control-plane processes (the same constraint as ``reliability``).
 """
 
+from pilottai_tpu.obs.attribution import (
+    DeviceTimeAttributor,
+    global_attribution,
+    peak_flops_per_chip,
+)
 from pilottai_tpu.obs.blackbox import BlackBox, global_blackbox
 from pilottai_tpu.obs.export import (
+    export_completeness,
     metrics_snapshot,
     perfetto_trace,
     phase_summary,
@@ -27,16 +39,43 @@ from pilottai_tpu.obs.export import (
 )
 from pilottai_tpu.obs.flight import FlightRecorder, RequestFlight, global_flight
 from pilottai_tpu.obs.ring import StepRing, global_steps
+from pilottai_tpu.obs.slo import (
+    DEFAULT_CLASS,
+    SLOClass,
+    SLOTracker,
+    global_slo,
+)
+
+# Every finished flight feeds the SLO tracker — the wiring that makes
+# "SLO attainment" a property of ALL traffic (HTTP, orchestrator, bare
+# SDK callers) rather than something each caller opts into.
+global_flight.add_finish_listener(global_slo.observe_flight)
+
+# Engine admission-queue depth: maintained by the batcher (admit / fold /
+# shed paths) but declared HERE so the exported surface — and the
+# autoscaler signal built on it (orchestration/scaling.py) — exists from
+# process boot, before (or without) an engine. 0 = empty queue.
+from pilottai_tpu.utils.metrics import global_metrics as _gm
+
+_gm.declare("engine.queue_depth", "gauge")
 
 __all__ = [
     "BlackBox",
+    "DEFAULT_CLASS",
+    "DeviceTimeAttributor",
     "FlightRecorder",
     "RequestFlight",
+    "SLOClass",
+    "SLOTracker",
     "StepRing",
+    "export_completeness",
+    "global_attribution",
     "global_blackbox",
     "global_flight",
+    "global_slo",
     "global_steps",
     "metrics_snapshot",
+    "peak_flops_per_chip",
     "perfetto_trace",
     "phase_summary",
     "prometheus_text",

@@ -127,6 +127,61 @@ def _flatten(tree: Dict[str, Any], path: str, out: Dict[str, Any]) -> None:
             out[sub] = value
 
 
+def export_completeness(
+    registry: MetricsRegistry = global_metrics, prefix: str = "pilottai"
+) -> List[str]:
+    """Walk the registry's DECLARED series and verify each reaches both
+    export surfaces: the ``metrics_snapshot`` dict and the Prometheus
+    text exposition. Returns the list of problems (empty = fully wired).
+
+    This is the ship-gate for new metrics (tests/test_slo.py): a series
+    a subsystem registers via ``MetricsRegistry.declare`` but that never
+    surfaces in ``/metrics`` — because an exporter filters it, renames
+    it into a collision, or the declaration kind mismatches the writer —
+    fails CI instead of shipping half-wired."""
+    problems: List[str] = []
+    snap = metrics_snapshot(registry=registry)
+    text = prometheus_text(snap, prefix=prefix)
+    section = {"counter": "counters", "gauge": "gauges",
+               "histogram": "histograms"}
+    # How each declared kind renders in the exposition (histograms are
+    # emitted as Prometheus summaries).
+    prom_kind = {"counter": "counter", "gauge": "gauge",
+                 "histogram": "summary"}
+    exposed: Dict[str, set] = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _, _, mname, mkind = line.split()
+            exposed.setdefault(mname, set()).add(mkind)
+    for name, kind in sorted(registry.declared().items()):
+        if name not in snap.get(section[kind], {}):
+            problems.append(f"{name} ({kind}): missing from metrics_snapshot")
+            continue
+        # Declared one kind, written as another: the declaration's
+        # zero-fill makes the declared section look populated while the
+        # real data lives in a sibling section under the same name.
+        others = [
+            k for k, sec in section.items()
+            if k != kind and name in snap.get(sec, {})
+        ]
+        if others:
+            problems.append(
+                f"{name}: declared {kind} but also written as "
+                f"{'/'.join(others)}"
+            )
+        kinds = exposed.get(_metric_name(prefix, name))
+        if not kinds:
+            problems.append(
+                f"{name} ({kind}): missing from Prometheus exposition"
+            )
+        elif prom_kind[kind] not in kinds:
+            problems.append(
+                f"{name} ({kind}): exposed as {'/'.join(sorted(kinds))}, "
+                f"expected {prom_kind[kind]}"
+            )
+    return problems
+
+
 # ---------------------------------------------------------------------- #
 # Perfetto / Chrome trace_event
 # ---------------------------------------------------------------------- #

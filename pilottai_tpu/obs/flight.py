@@ -116,6 +116,16 @@ class FlightRecorder:
         self._finished: Deque[RequestFlight] = deque(maxlen=max_finished)
         self._lock = threading.Lock()
         self._registry = registry
+        # Finish listeners: called with the closed RequestFlight after
+        # its metrics are observed (obs/__init__ wires the SLO tracker
+        # here). Outside the lock; exceptions are swallowed — derived
+        # telemetry must never fail the request path.
+        self._listeners: List[Any] = []
+
+    def add_finish_listener(self, fn: Any) -> None:
+        """Register ``fn(flight: RequestFlight)`` to run on every
+        ``finish`` (any status)."""
+        self._listeners.append(fn)
 
     # ------------------------------------------------------------------ #
     # Lifecycle (handler / HTTP edge)
@@ -166,6 +176,11 @@ class FlightRecorder:
         else:
             self._registry.inc("request.failed")
         self._registry.inc(f"request.finished.{status}")
+        for listener in self._listeners:
+            try:
+                listener(flight)
+            except Exception:  # noqa: BLE001 — telemetry must not raise
+                pass
         return flight.to_dict()
 
     # ------------------------------------------------------------------ #

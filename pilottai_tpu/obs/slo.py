@@ -1,0 +1,329 @@
+"""Per-class SLO attainment tracking: the serving objective as a metric.
+
+LLM-Pilot (arxiv 2410.02425) characterizes inference services *under an
+SLO* — "p99 TTFT below X" is the unit of capacity, not raw throughput —
+and the agent-systems co-design line (PAPERS.md "Towards Efficient
+Agents") splits traffic into service classes with different objectives:
+a human watching tokens stream (interactive) tolerates far less latency
+than a fan-out batch branch nobody reads until the join. This module
+makes both first-class:
+
+* ``SLOClass`` — a named class (``interactive``, ``batch`` by default)
+  with TTFT/TPOT/e2e targets and an attainment objective (e.g. 0.99 =
+  "99% of requests meet every target").
+* ``SLOTracker`` — consumes finished request flights (wired as a
+  ``FlightRecorder`` finish listener in ``obs/__init__``), classifies
+  them by the ``slo_class`` the HTTP edge / orchestrator threaded
+  through ``GenerationParams``, and maintains per class:
+
+  ===================================  ================================
+  ``slo.<class>.requests``             counter, all finished flights
+  ``slo.<class>.missed``               counter, flights that missed ANY
+                                       target (failures count: a shed or
+                                       deadline-expired request consumed
+                                       error budget even with no timing)
+  ``slo.<class>.attainment``           gauge, rolling-window fraction met
+  ``slo.<class>.burn_rate``            gauge, error-budget burn rate
+  ``slo.<class>.ttft_s`` / ``tpot_s``
+  / ``e2e_s``                          histograms (ok flights), the
+                                       per-class p99 surface
+  ===================================  ================================
+
+Burn rate is the standard SRE multiple: observed miss rate over the burn
+window divided by the budgeted miss rate (1 − attainment objective).
+1.0 = burning budget exactly as provisioned; 2.0 = at this pace the
+period's budget lasts half the period; the autoscaler
+(``orchestration/scaling.py``) treats sustained burn > 1 as scale-up
+pressure.
+
+All series are ``declare``d on the registry, so they surface in
+``metrics_snapshot``/Prometheus from boot and the export-completeness
+check (``obs.export_completeness``) covers them.
+
+Import cost: stdlib + utils only — no jax (``obs`` package constraint).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Deque, Dict, Iterable, Optional
+
+from collections import deque
+
+from pilottai_tpu.utils.metrics import MetricsRegistry, global_metrics
+
+#: Class assigned when a request carried none (bare SDK callers, warmup).
+DEFAULT_CLASS = "interactive"
+
+
+@dataclass(frozen=True)
+class SLOClass:
+    """One service class: latency targets + attainment objective.
+
+    A target of ``None`` means that dimension is unconstrained for the
+    class. ``attainment_target`` is the objective the error budget is
+    provisioned against: budgeted miss rate = ``1 - attainment_target``.
+    """
+
+    name: str
+    ttft_s: Optional[float] = None
+    tpot_s: Optional[float] = None
+    e2e_s: Optional[float] = None
+    attainment_target: float = 0.99
+
+    def met(
+        self,
+        ttft_s: Optional[float],
+        tpot_s: Optional[float],
+        e2e_s: Optional[float],
+    ) -> bool:
+        """True when every *constrained, observed* dimension is within
+        target. Unobserved dimensions don't fail a request — a 1-token
+        reply has no TPOT; failure statuses are handled by the caller."""
+        for target, value in (
+            (self.ttft_s, ttft_s),
+            (self.tpot_s, tpot_s),
+            (self.e2e_s, e2e_s),
+        ):
+            if target is not None and value is not None and value > target:
+                return False
+        return True
+
+
+#: Default classes. Targets are deliberately serving-shaped, not
+#: benchmark-shaped: interactive is a human watching tokens stream
+#: (sub-2s first token, smooth ~4 tok/s floor); batch is fan-out /
+#: pipeline traffic where only completion matters. Deployments override
+#: via SLOTracker(classes=...).
+DEFAULT_CLASSES = (
+    SLOClass(
+        name="interactive",
+        ttft_s=2.0, tpot_s=0.25, e2e_s=30.0, attainment_target=0.99,
+    ),
+    SLOClass(
+        name="batch",
+        ttft_s=30.0, tpot_s=1.0, e2e_s=600.0, attainment_target=0.95,
+    ),
+)
+
+
+class SLOTracker:
+    """Rolling per-class attainment + burn rate over finished flights.
+
+    Thread-safe: finish listeners fire from whatever thread closes the
+    flight (event loop, batcher reader thread).
+    """
+
+    def __init__(
+        self,
+        classes: Optional[Iterable[SLOClass]] = None,
+        registry: MetricsRegistry = global_metrics,
+        window: int = 1024,
+        burn_window_s: float = 300.0,
+    ) -> None:
+        self.classes: Dict[str, SLOClass] = {
+            c.name: c for c in (classes or DEFAULT_CLASSES)
+        }
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._window = window
+        self._burn_window_s = burn_window_s
+        # Per class, two windows with O(1) incremental aggregates (this
+        # runs under the lock on EVERY flight finish, from the event
+        # loop and the batcher reader thread — full-ledger scans would
+        # grow per-request cost linearly with offered load):
+        # * ``_attn``/``_attn_met``: count-bounded met booleans (last
+        #   ``window`` flights) behind the attainment gauge;
+        # * ``_burn``/``_burn_miss``: time-bounded (ts, met) ledger (last
+        #   ``burn_window_s`` seconds) behind the burn-rate gauge — a
+        #   single maxlen deque serving both silently shrank the burn
+        #   window to ~window/rate seconds at high request rates.
+        self._attn: Dict[str, Deque] = {
+            name: deque(maxlen=window) for name in self.classes
+        }
+        self._attn_met: Dict[str, int] = {name: 0 for name in self.classes}
+        self._burn: Dict[str, Deque] = {
+            name: deque() for name in self.classes
+        }
+        self._burn_miss: Dict[str, int] = {name: 0 for name in self.classes}
+        for name in self.classes:
+            registry.declare(f"slo.{name}.requests", "counter")
+            registry.declare(f"slo.{name}.missed", "counter")
+            registry.declare(f"slo.{name}.attainment", "gauge")
+            registry.declare(f"slo.{name}.burn_rate", "gauge")
+            for dim in ("ttft_s", "tpot_s", "e2e_s"):
+                registry.declare(f"slo.{name}.{dim}", "histogram")
+            # No traffic = no misses: attainment boots at 1.0, not an
+            # alarming declared-default 0.0.
+            registry.set_gauge(f"slo.{name}.attainment", 1.0)
+
+    # ------------------------------------------------------------------ #
+
+    def classify(self, slo_class: Optional[str]) -> str:
+        """Known class name, or the default for None/unknown — the
+        tracker never drops a flight over a typo'd class (it would
+        silently exempt that traffic from its SLO)."""
+        if slo_class in self.classes:
+            return slo_class  # type: ignore[return-value]
+        return DEFAULT_CLASS if DEFAULT_CLASS in self.classes else (
+            next(iter(self.classes))
+        )
+
+    def record(
+        self,
+        slo_class: Optional[str],
+        *,
+        ttft_s: Optional[float] = None,
+        tpot_s: Optional[float] = None,
+        e2e_s: Optional[float] = None,
+        ok: bool = True,
+        at: Optional[float] = None,
+    ) -> bool:
+        """Record one finished request; returns whether it met its SLO.
+        Failures (``ok=False``: shed, deadline, error) are always misses
+        — the client did not get served within objective, whatever the
+        clock said."""
+        name = self.classify(slo_class)
+        cls = self.classes[name]
+        met = ok and cls.met(ttft_s, tpot_s, e2e_s)
+        now = at if at is not None else time.monotonic()
+        with self._lock:
+            attn = self._attn[name]
+            if len(attn) == self._window and attn[0]:
+                self._attn_met[name] -= 1  # about to be evicted by append
+            attn.append(met)
+            if met:
+                self._attn_met[name] += 1
+            self._burn[name].append((now, met))
+            if not met:
+                self._burn_miss[name] += 1
+            attainment, burn = self._rates_locked(name, now)
+        reg = self._registry
+        reg.inc(f"slo.{name}.requests")
+        if not met:
+            reg.inc(f"slo.{name}.missed")
+        if ok:
+            for dim, value in (
+                ("ttft_s", ttft_s), ("tpot_s", tpot_s), ("e2e_s", e2e_s),
+            ):
+                if value is not None:
+                    reg.observe(f"slo.{name}.{dim}", value)
+        reg.set_gauge(f"slo.{name}.attainment", attainment)
+        reg.set_gauge(f"slo.{name}.burn_rate", burn)
+        return met
+
+    def _rates_locked(self, name: str, now: float) -> tuple:
+        """(rolling attainment, burn rate) for ``name`` (lock held).
+        Attainment is over the last ``window`` entries; burn over the
+        trailing ``burn_window_s`` seconds (pruned here, amortized O(1)
+        — timestamps arrive monotonically)."""
+        burn_led = self._burn[name]
+        cutoff = now - self._burn_window_s
+        while burn_led and burn_led[0][0] < cutoff:
+            _, m = burn_led.popleft()
+            if not m:
+                self._burn_miss[name] -= 1
+        attn = self._attn[name]
+        attainment = self._attn_met[name] / len(attn) if attn else 1.0
+        if not burn_led:
+            return attainment, 0.0
+        miss_rate = self._burn_miss[name] / len(burn_led)
+        budget = max(1.0 - self.classes[name].attainment_target, 1e-9)
+        return attainment, miss_rate / budget
+
+    def refresh_gauges(self, at: Optional[float] = None) -> None:
+        """Recompute the attainment/burn gauges against the clock's NOW.
+        ``record`` only writes gauges when a flight finishes, so after
+        traffic stops the last written burn rate would otherwise freeze
+        at its final (possibly alarming) value forever; time-based
+        consumers — the autoscaler reads the gauges, not ``snapshot()``
+        — call this before reading so an empty burn window decays to
+        burn 0 instead of pinning scale-up pressure on an idle system."""
+        now = at if at is not None else time.monotonic()
+        with self._lock:
+            rates = {
+                name: self._rates_locked(name, now) for name in self.classes
+            }
+        for name, (attainment, burn) in rates.items():
+            self._registry.set_gauge(f"slo.{name}.attainment", attainment)
+            self._registry.set_gauge(f"slo.{name}.burn_rate", burn)
+
+    # ------------------------------------------------------------------ #
+    # FlightRecorder integration
+    # ------------------------------------------------------------------ #
+
+    def observe_flight(self, flight: Any) -> None:
+        """Finish listener (obs/__init__ wires it onto
+        ``global_flight``): classify by the flight's ``slo_class``
+        attribute and record its derived phase metrics. Never raises —
+        an SLO bookkeeping bug must not fail the request path."""
+        try:
+            derived = flight.derived()
+            self.record(
+                flight.attributes.get("slo_class"),
+                ttft_s=derived.get("ttft_s"),
+                tpot_s=derived.get("tpot_s"),
+                e2e_s=derived.get("e2e_s"),
+                ok=(flight.status == "ok"),
+            )
+        except Exception:  # noqa: BLE001 — telemetry must not raise
+            pass
+
+    # ------------------------------------------------------------------ #
+    # Inspection / exposition
+    # ------------------------------------------------------------------ #
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``/slo.json`` shape: per class targets, counts, rolling
+        attainment/burn and the latency percentile surface."""
+        hists = self._registry.snapshot()["histograms"]
+        now = time.monotonic()
+        out: Dict[str, Any] = {}
+        with self._lock:
+            per_class = {
+                name: self._rates_locked(name, now) for name in self.classes
+            }
+            sizes = {
+                name: len(self._attn[name]) for name in self.classes
+            }
+        for name, cls in self.classes.items():
+            attainment, burn = per_class[name]
+            entry: Dict[str, Any] = {
+                "targets": {
+                    "ttft_s": cls.ttft_s,
+                    "tpot_s": cls.tpot_s,
+                    "e2e_s": cls.e2e_s,
+                    "attainment": cls.attainment_target,
+                },
+                "requests": self._registry.get(f"slo.{name}.requests"),
+                "missed": self._registry.get(f"slo.{name}.missed"),
+                "window": sizes[name],
+                "attainment": round(attainment, 4),
+                "burn_rate": round(burn, 4),
+            }
+            for dim in ("ttft_s", "tpot_s", "e2e_s"):
+                summary = hists.get(f"slo.{name}.{dim}") or {}
+                entry[f"{dim.replace('_s', '')}_p50_s"] = summary.get("p50")
+                entry[f"{dim.replace('_s', '')}_p99_s"] = summary.get("p99")
+            out[name] = entry
+        return out
+
+    def reset(self) -> None:
+        """Drop the rolling windows and the per-class histograms —
+        section-scoped measurement (the bench's SLO harness) must not
+        inherit the previous section's misses."""
+        with self._lock:
+            for name in self.classes:
+                self._attn[name].clear()
+                self._attn_met[name] = 0
+                self._burn[name].clear()
+                self._burn_miss[name] = 0
+        for name in self.classes:
+            self._registry.reset_histograms(f"slo.{name}.")
+            self._registry.set_gauge(f"slo.{name}.attainment", 1.0)
+            self._registry.set_gauge(f"slo.{name}.burn_rate", 0.0)
+
+
+global_slo = SLOTracker()

@@ -1,13 +1,25 @@
-"""DynamicScaling: agent-pool autoscaler.
+"""DynamicScaling: agent-pool autoscaler driven by the obs metrics layer.
 
-Reference parity: ``pilott/orchestration/orchestration.py`` (the exported
-copy; its dead duplicate in ``scaling.py:425-666`` has no counterpart
-here, §2.12-d) — 60s loop (``:73-83``), system load = weighted queue
-utilization + queue size (``:129-134``), recency-weighted trend over the
-last 5 samples (``:157-167``), scale-up via ``orchestrator.create_agent``
-(``:169-191``), scale-down drains the lowest-success-rate idle agent
-(wait → stop → remove, ``:193-231``), cooldown gate (``:233-240``),
-metrics (``:265-281``).
+Reference parity for the *mechanics* (``pilott/orchestration/
+orchestration.py``): 60s loop (``:73-83``), recency-weighted trend over
+the last 5 samples (``:157-167``), scale-up via
+``orchestrator.create_agent`` (``:169-191``), scale-down drains the
+lowest-success-rate idle agent (wait → stop → remove, ``:193-231``),
+cooldown gate (``:233-240``).
+
+The *signals* are no longer ad-hoc reads of orchestrator internals (the
+reference blended psutil CPU% into the decision): every input now flows
+through the ``obs`` metrics registry — the same snapshot ``/metrics``
+exports — so the autoscaler's view and the operator's dashboard can
+never disagree. Orchestrator-side pressure is published as
+``orchestrator.*`` gauges each cycle, engine-side pressure arrives as
+the gauges the batcher/attribution layer already maintains
+(``engine.queue_depth``, ``engine.device_busy_frac``) and SLO pressure
+as the per-class ``slo.<class>.burn_rate`` gauges (obs/slo.py). The
+decision itself is exported back as ``scaling.recommendation`` (+1 grow
+/ −1 shrink / 0 hold) — the observability half of ROADMAP item 5's
+autoscaling loop, consumable by an external operator (k8s HPA adapter,
+capacity dashboards) even when the in-process actuator is disabled.
 
 TPU grounding: "scaling" here resizes the *admission* side — more agents
 means more concurrent reasoning loops feeding the shared engine batcher —
@@ -24,21 +36,40 @@ from typing import Any, Dict, Optional
 from pilottai_tpu.core.config import ScalingConfig
 from pilottai_tpu.core.status import AgentStatus
 from pilottai_tpu.utils.logging import get_logger
-from pilottai_tpu.utils.metrics import global_metrics
+from pilottai_tpu.utils.metrics import MetricsRegistry, global_metrics
 
 
 class DynamicScaling:
-    """Grows/drains the orchestrator's agent pool on load trend."""
+    """Grows/drains the orchestrator's agent pool on observed load trend.
+
+    ``registry`` defaults to the process-global metrics bus; tests (and
+    multi-tenant deployments wanting isolated autoscalers) inject their
+    own.
+    """
 
     def __init__(
         self,
         orchestrator: Any,  # Serve
         config: Optional[ScalingConfig] = None,
         agent_type: str = "worker",
+        registry: MetricsRegistry = global_metrics,
+        slo_tracker: Optional[Any] = None,
     ) -> None:
+        from pilottai_tpu.obs import global_slo
+
         self.orchestrator = orchestrator
         self.config = config or ScalingConfig()
         self.agent_type = agent_type
+        self._registry = registry
+        # The burn-rate gauges are only WRITTEN when a flight finishes;
+        # reading them raw after traffic stops would pin the last
+        # (possibly alarming) value forever. When the scaler shares the
+        # tracker's registry, it refreshes the gauges against the clock
+        # before each read. Tests that inject an isolated registry (and
+        # set gauges directly) get no tracker unless they pass one.
+        self._slo = slo_tracker if slo_tracker is not None else (
+            global_slo if registry is global_metrics else None
+        )
         self._samples: deque = deque(maxlen=self.config.trend_window)
         # None = never acted; 0.0 would wrongly apply the cooldown to the
         # first action when time.monotonic() (system uptime) < cooldown.
@@ -47,6 +78,11 @@ class DynamicScaling:
         self._log = get_logger("orchestration.scaling")
         self.scale_ups = 0
         self.scale_downs = 0
+        for name in (
+            "scaling.system_load", "scaling.recommendation",
+            "scaling.target_agents",
+        ):
+            registry.declare(name, "gauge")
 
     # ------------------------------------------------------------------ #
 
@@ -72,14 +108,19 @@ class DynamicScaling:
                 self._log.error("scaling cycle failed: %s", exc, exc_info=True)
 
     # ------------------------------------------------------------------ #
+    # Signals
+    # ------------------------------------------------------------------ #
 
-    def system_load(self) -> float:
-        """0.45 mean agent queue-util + 0.30 orchestrator queue fill +
-        0.25 running-task saturation (reference weights ``:129-134``,
-        psutil terms replaced with engine-side signals)."""
+    def publish_orchestrator_gauges(self) -> None:
+        """Publish the orchestrator's own pressure as ``orchestrator.*``
+        gauges. The load computation reads them BACK from the registry
+        snapshot — one surface for the decision, the dashboard and the
+        Prometheus scrape, so "why did it scale?" is always answerable
+        from exported data."""
         agents = self.orchestrator.agent_list()
         mean_queue = (
-            sum(a.queue_utilization for a in agents) / len(agents) if agents else 1.0
+            sum(a.queue_utilization for a in agents) / len(agents)
+            if agents else 1.0
         )
         backlog = len(self.orchestrator.task_queue) / max(
             self.orchestrator.config.max_queue_size, 1
@@ -87,10 +128,74 @@ class DynamicScaling:
         running = len(self.orchestrator.running_tasks) / max(
             self.orchestrator.config.max_concurrent_tasks, 1
         )
-        weighted = 0.45 * mean_queue + 0.30 * backlog + 0.25 * min(running, 1.0)
-        # Floor by mean queue utilization: saturated agent queues alone must
-        # cross the scale-up threshold even with an empty orchestrator queue.
-        return min(1.0, max(mean_queue, weighted))
+        reg = self._registry
+        reg.set_gauge("orchestrator.agent_queue_util", mean_queue)
+        reg.set_gauge("orchestrator.queue_frac", min(backlog, 1.0))
+        reg.set_gauge("orchestrator.running_frac", min(running, 1.0))
+        reg.set_gauge("orchestrator.agents", float(len(agents)))
+
+    def signals(self) -> Dict[str, float]:
+        """The obs-snapshot inputs of one scaling decision."""
+        if self._slo is not None:
+            self._slo.refresh_gauges()  # decay burn on an idle system
+        snap = self._registry.snapshot()
+        gauges = snap["gauges"]
+        burn = max(
+            (
+                v for k, v in gauges.items()
+                if k.startswith("slo.") and k.endswith(".burn_rate")
+            ),
+            default=0.0,
+        )
+        depth = gauges.get("engine.queue_depth", 0.0)
+        ref = gauges.get("engine.max_queue_depth") or float(
+            self.config.queue_depth_ref
+        )
+        return {
+            "agent_queue_util": gauges.get(
+                "orchestrator.agent_queue_util", 0.0
+            ),
+            "orch_queue_frac": gauges.get("orchestrator.queue_frac", 0.0),
+            "running_frac": gauges.get("orchestrator.running_frac", 0.0),
+            "engine_queue_depth": depth,
+            "engine_queue_frac": min(depth / max(ref, 1.0), 1.0),
+            "device_busy_frac": gauges.get("engine.device_busy_frac", 0.0),
+            "slo_burn_rate": burn,
+            "shed_rate": self._registry.rate("engine.shed", window=60.0),
+        }
+
+    def system_load(
+        self, signals: Optional[Dict[str, float]] = None
+    ) -> float:
+        """0..1 load from the published signal surface. Weighted blend of
+        orchestrator pressure (agent queues, backlog, running tasks),
+        engine pressure (admission queue, device busy fraction) and SLO
+        pressure (error-budget burn), with two floors:
+
+        * saturated agent queues alone must cross the scale-up threshold
+          even when every other signal is calm (the pre-obs behavior);
+        * burn rate ≥ 2x budget reads as full load — an SLO burning its
+          budget twice as fast as provisioned is a capacity incident
+          whatever the queues look like, and burn ≈ 1 floors the load
+          mid-range so the scaler won't shrink while budget is burning.
+
+        ``signals`` short-circuits the publish-and-snapshot walk when
+        the caller (``metrics``) already has a fresh reading.
+        """
+        if signals is None:
+            self.publish_orchestrator_gauges()
+            signals = self.signals()
+        s = signals
+        weighted = (
+            0.30 * s["agent_queue_util"]
+            + 0.20 * s["orch_queue_frac"]
+            + 0.15 * s["running_frac"]
+            + 0.15 * s["engine_queue_frac"]
+            + 0.10 * s["device_busy_frac"]
+            + 0.10 * min(s["slo_burn_rate"] / 2.0, 1.0)
+        )
+        burn_floor = min(s["slo_burn_rate"] / 2.0, 1.0)
+        return min(1.0, max(s["agent_queue_util"], burn_floor, weighted))
 
     def trend(self) -> float:
         """Recency-weighted slope (reference ``:157-167``)."""
@@ -108,35 +213,43 @@ class DynamicScaling:
             return True
         return time.monotonic() - self._last_action >= self.config.cooldown
 
+    # ------------------------------------------------------------------ #
+
     async def scale_once(self) -> Optional[str]:
-        """One scaling decision; returns "up"/"down"/None."""
+        """One scaling decision; returns "up"/"down"/None. The decision
+        (acted on or not) is exported as ``scaling.recommendation``."""
         load = self.system_load()
         self._samples.append(load)
         n_agents = len(self.orchestrator.agents)
-        global_metrics.set_gauge("scaling.system_load", load)
+        reg = self._registry
+        reg.set_gauge("scaling.system_load", load)
 
-        if (
-            load > self.config.scale_up_threshold
-            and n_agents < self.config.max_agents
-            and self._cooled_down()
-        ):
-            await self._scale_up()
-            return "up"
-        if (
-            load < self.config.scale_down_threshold
-            and self.trend() <= 0
-            and n_agents > self.config.min_agents
-            and self._cooled_down()
-        ):
-            if await self._scale_down():
-                return "down"
-        return None
+        decision: Optional[str] = None
+        recommendation = 0.0
+        target = float(n_agents)
+        if load > self.config.scale_up_threshold:
+            recommendation = 1.0
+            target = float(min(n_agents + 1, self.config.max_agents))
+            if n_agents < self.config.max_agents and self._cooled_down():
+                await self._scale_up()
+                decision = "up"
+        elif load < self.config.scale_down_threshold and self.trend() <= 0:
+            recommendation = -1.0
+            target = float(max(n_agents - 1, self.config.min_agents))
+            if n_agents > self.config.min_agents and self._cooled_down():
+                if await self._scale_down():
+                    decision = "down"
+                else:
+                    recommendation = 0.0  # nothing drainable right now
+        reg.set_gauge("scaling.recommendation", recommendation)
+        reg.set_gauge("scaling.target_agents", target)
+        return decision
 
     async def _scale_up(self) -> None:
         agent = await self.orchestrator.create_agent(self.agent_type)
         self._last_action = time.monotonic()
         self.scale_ups += 1
-        global_metrics.inc("scaling.scale_ups")
+        self._registry.inc("scaling.scale_ups")
         self._log.info("scaled up: new agent %s (pool=%d)",
                        agent.id[:8], len(self.orchestrator.agents))
 
@@ -154,7 +267,7 @@ class DynamicScaling:
         await self.orchestrator.remove_agent(victim.id)
         self._last_action = time.monotonic()
         self.scale_downs += 1
-        global_metrics.inc("scaling.scale_downs")
+        self._registry.inc("scaling.scale_downs")
         self._log.info("scaled down: removed agent %s (pool=%d)",
                        victim.id[:8], len(self.orchestrator.agents))
         return True
@@ -162,9 +275,15 @@ class DynamicScaling:
     # ------------------------------------------------------------------ #
 
     def get_metrics(self) -> Dict[str, Any]:
+        # One publish + one snapshot walk feeds both the load and the
+        # reported signal surface (system_load would otherwise redo it).
+        self.publish_orchestrator_gauges()
+        signals = self.signals()
         return {
-            "system_load": self.system_load(),
+            "system_load": self.system_load(signals=signals),
             "trend": self.trend(),
+            "signals": signals,
+            "recommendation": self._registry.get("scaling.recommendation"),
             "agents": len(self.orchestrator.agents),
             "scale_ups": self.scale_ups,
             "scale_downs": self.scale_downs,

@@ -25,11 +25,15 @@ Routes
 * ``GET /healthz`` — liveness; ``GET /metrics`` — the unified metrics
   snapshot (JSON; same shape as the dashboard's ``/metrics.json``), or
   Prometheus text exposition with ``?format=prometheus``.
+* ``GET /slo.json`` — per-class SLO attainment, burn rate and latency
+  percentiles (obs/slo.py).
 
 Every request accepts (and every completion/task response echoes) an
 ``x-request-id`` header: the flight-recorder trace id correlating spans,
 structured logs, phase metrics and black-box dumps across the server →
-handler → batcher boundary (docs/OBSERVABILITY.md).
+handler → batcher boundary (docs/OBSERVABILITY.md). A ``slo_class``
+body field (or ``x-slo-class`` header) assigns the request to an SLO
+service class ("interactive"/"batch"); unknown classes are a 400.
 
 Implementation is stdlib-asyncio only (``asyncio.start_server`` + a
 minimal HTTP/1.1 parser): SSE needs the event loop the engine's futures
@@ -397,6 +401,13 @@ class APIServer:
                         },
                     },
                 )
+        elif path == "/slo.json" and method == "GET":
+            # Per-class SLO attainment / burn rate (obs/slo.py) — the
+            # page an operator (or the autoscaler's dashboard) watches
+            # during an incident.
+            from pilottai_tpu.obs import global_slo
+
+            await self._send(writer, 200, global_slo.snapshot())
         elif path == "/v1/models" and method == "GET":
             await self._send(writer, 200, self._models())
         elif path == "/v1/chat/completions":
@@ -559,6 +570,28 @@ class APIServer:
         return time.monotonic() + t
 
     @staticmethod
+    def _slo_class(
+        req: Dict[str, Any], headers: Optional[Dict[str, str]]
+    ) -> Optional[str]:
+        """The request's SLO service class: body ``slo_class`` beats the
+        ``x-slo-class`` header. Unknown classes are a 400 — a typo'd
+        class would otherwise silently fall into the default class and
+        exempt that traffic from the objective the client asked for."""
+        raw = req.get("slo_class")
+        if raw is None:
+            raw = (headers or {}).get("x-slo-class")
+        if raw is None:
+            return None
+        from pilottai_tpu.obs import global_slo
+
+        if not isinstance(raw, str) or raw not in global_slo.classes:
+            raise _HttpError(
+                400, f"unknown slo_class {raw!r}; available: "
+                f"{sorted(global_slo.classes)}"
+            )
+        return raw
+
+    @staticmethod
     def _trace_id(headers: Optional[Dict[str, str]]) -> str:
         """The request's flight-recorder id: accept the client's
         ``x-request-id`` (sanitized) or mint one. Echoed back as a
@@ -598,6 +631,9 @@ class APIServer:
         params = params.model_copy(update={"trace_id": trace_id})
         if deadline is not None:
             params = params.model_copy(update={"deadline": deadline})
+        slo_class = self._slo_class(req, headers)
+        if slo_class is not None:
+            params = params.model_copy(update={"slo_class": slo_class})
         model = req.get("model") or getattr(
             getattr(handler, "config", None), "model_name", "default"
         )

@@ -12,6 +12,8 @@ Stdlib-only (http.server on a daemon thread), three routes:
 * ``/trace.json`` — Chrome/Perfetto ``trace_event`` JSON of finished
   span trees plus engine step-ring counters (``?trace_id=`` narrows to
   one request); load it at https://ui.perfetto.dev.
+* ``/slo.json`` — per-class SLO attainment/burn-rate snapshot
+  (``obs.global_slo``), same shape as the API server's route.
 * ``/`` — a self-refreshing HTML table over the same JSON.
 
 Read-only and unauthenticated by design: bind to localhost (the default)
@@ -27,6 +29,7 @@ from typing import Any, Optional
 from urllib.parse import parse_qs
 
 from pilottai_tpu.obs import (
+    global_slo,
     global_steps,
     metrics_snapshot,
     perfetto_trace,
@@ -116,6 +119,11 @@ class MetricsDashboard:
                             dashboard.snapshot(), default=str
                         ).encode()
                         ctype = "application/json"
+                elif path == "/slo.json":
+                    body = json.dumps(
+                        global_slo.snapshot(), default=str
+                    ).encode()
+                    ctype = "application/json"
                 elif path == "/trace.json":
                     trace_id = (params.get("trace_id") or [None])[0]
                     spans = (

@@ -83,6 +83,13 @@ class MetricsRegistry:
         self._events: Dict[str, Deque[Tuple[float, float]]] = {}
         self._gauges: Dict[str, float] = {}
         self._histograms: Dict[str, _Histogram] = {}
+        # Declared series: name -> kind ("counter" | "gauge" | "histogram").
+        # A declaration is a CONTRACT: the series appears in snapshot()
+        # (zero-valued until first observation) and therefore in every
+        # exporter built on it. obs.export_completeness walks this table
+        # so a subsystem can't register a series and ship it half-wired
+        # (present in code, absent from /metrics).
+        self._declared: Dict[str, str] = {}
         self._started = time.time()
 
     def inc(self, name: str, value: float = 1.0) -> None:
@@ -155,13 +162,39 @@ class MetricsRegistry:
                 return self._counters[name]
             return self._gauges.get(name, 0.0)
 
+    def declare(self, name: str, kind: str = "gauge") -> None:
+        """Declare a series the deployment is expected to export.
+        ``kind`` is "counter", "gauge" or "histogram". Declared-but-not-
+        yet-observed series surface in ``snapshot()`` with a zero value
+        (empty summary for histograms) so scrapers see the full surface
+        from boot and the export-completeness check can verify every
+        registration reaches the exposition."""
+        if kind not in ("counter", "gauge", "histogram"):
+            raise ValueError(f"unknown series kind {kind!r}")
+        with self._lock:
+            self._declared[name] = kind
+
+    def declared(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._declared)
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {k: h.summary() for k, h in self._histograms.items()}
+            for name, kind in self._declared.items():
+                if kind == "counter":
+                    counters.setdefault(name, 0.0)
+                elif kind == "gauge":
+                    gauges.setdefault(name, 0.0)
+                elif name not in hists:
+                    hists[name] = _Histogram().summary()
             return {
                 "uptime_s": time.time() - self._started,
-                "counters": dict(self._counters),
-                "gauges": dict(self._gauges),
-                "histograms": {k: h.summary() for k, h in self._histograms.items()},
+                "counters": counters,
+                "gauges": gauges,
+                "histograms": hists,
             }
 
     def reset_histograms(self, prefix: str = "") -> None:

@@ -44,6 +44,22 @@ def test_dashboard_serves_metrics_json_and_html():
         d.stop()
 
 
+def test_dashboard_serves_slo_snapshot():
+    """/slo.json mirrors the API server's route: per-class targets +
+    attainment/burn surface from obs.global_slo."""
+    d = MetricsDashboard(source=_FakeServe(), port=0).start()
+    try:
+        status, ctype, body = _get(f"http://127.0.0.1:{d.port}/slo.json")
+        assert status == 200 and ctype == "application/json"
+        snap = json.loads(body)
+        assert "interactive" in snap and "batch" in snap
+        for entry in snap.values():
+            assert "attainment" in entry and "burn_rate" in entry
+            assert "targets" in entry
+    finally:
+        d.stop()
+
+
 def test_dashboard_source_errors_do_not_break_endpoint():
     class Bad:
         def get_metrics(self):

@@ -82,6 +82,12 @@ async def test_balancer_noop_when_balanced():
 
 
 # ----------------------------- scaling --------------------------------- #
+# The scaler consumes the obs metrics snapshot; every test injects an
+# ISOLATED registry so gauges from other tests in the process (engine
+# runs set engine.*/slo.* on the global bus) can't tilt the decision.
+
+from pilottai_tpu.utils.metrics import MetricsRegistry
+
 
 @pytest.mark.asyncio
 async def test_scaling_up_on_high_load():
@@ -91,7 +97,8 @@ async def test_scaling_up_on_high_load():
         await busy.add_task(Task(description=f"q{i}"))
     serve = make_serve([busy])
     scaler = DynamicScaling(
-        serve, ScalingConfig(min_agents=1, max_agents=3, cooldown=0.0)
+        serve, ScalingConfig(min_agents=1, max_agents=3, cooldown=0.0),
+        registry=MetricsRegistry(),
     )
     decision = await scaler.scale_once()
     assert decision == "up"
@@ -108,7 +115,8 @@ async def test_scaling_down_drains_idle_lowest_success():
     serve = make_serve([a, b, c])
     scaler = DynamicScaling(
         serve, ScalingConfig(min_agents=1, max_agents=5, cooldown=0.0,
-                             scale_down_threshold=0.5)
+                             scale_down_threshold=0.5),
+        registry=MetricsRegistry(),
     )
     decision = await scaler.scale_once()
     assert decision == "down"
@@ -126,6 +134,7 @@ async def test_scaling_cooldown_blocks_consecutive_actions():
         serve,
         ScalingConfig(min_agents=1, max_agents=5, cooldown=300.0,
                       scale_up_threshold=0.3),
+        registry=MetricsRegistry(),
     )
     assert await scaler.scale_once() == "up"
     assert await scaler.scale_once() is None  # cooling down
@@ -138,9 +147,104 @@ async def test_scaling_respects_max_agents():
     await busy.add_task(Task(description="q"))
     serve = make_serve([busy])
     scaler = DynamicScaling(
-        serve, ScalingConfig(min_agents=1, max_agents=1, cooldown=0.0)
+        serve, ScalingConfig(min_agents=1, max_agents=1, cooldown=0.0),
+        registry=MetricsRegistry(),
     )
     assert await scaler.scale_once() is None
+
+
+@pytest.mark.asyncio
+async def test_scaling_up_on_slo_burn_rate_alone():
+    """A burning SLO error budget (slo.*.burn_rate gauge >= 2x) must
+    read as full load and scale up even with every queue empty — the
+    obs-driven half of the autoscaling loop."""
+    idle_agent = worker()
+    await idle_agent.start()
+    serve = make_serve([idle_agent])
+    registry = MetricsRegistry()
+    registry.set_gauge("slo.interactive.burn_rate", 3.0)
+    scaler = DynamicScaling(
+        serve, ScalingConfig(min_agents=1, max_agents=3, cooldown=0.0),
+        registry=registry,
+    )
+    decision = await scaler.scale_once()
+    assert decision == "up"
+    assert registry.get("scaling.recommendation") == 1.0
+    assert registry.get("scaling.system_load") >= 0.8
+
+
+@pytest.mark.asyncio
+async def test_scaling_engine_queue_signal_and_recommendation_gauge():
+    """Engine admission-queue pressure flows through the snapshot, and
+    the decision is exported as a gauge even when the actuator can't act
+    (max_agents cap): recommendation says "grow", action stays None."""
+    busy = worker(max_queue_size=2)
+    await busy.start()
+    for i in range(2):
+        await busy.add_task(Task(description=f"q{i}"))
+    serve = make_serve([busy])
+    registry = MetricsRegistry()
+    registry.set_gauge("engine.queue_depth", 40.0)
+    registry.set_gauge("engine.max_queue_depth", 40.0)
+    scaler = DynamicScaling(
+        serve, ScalingConfig(min_agents=1, max_agents=1, cooldown=0.0),
+        registry=registry,
+    )
+    assert scaler.signals()["engine_queue_frac"] == 1.0
+    assert await scaler.scale_once() is None  # capped
+    assert registry.get("scaling.recommendation") == 1.0
+    assert registry.get("scaling.target_agents") == 1.0
+    # The orchestrator-side pressure was published as gauges too — one
+    # surface for decision, dashboard and scraper.
+    gauges = registry.snapshot()["gauges"]
+    assert gauges["orchestrator.agent_queue_util"] == 1.0
+
+
+@pytest.mark.asyncio
+async def test_scaling_burn_pressure_decays_on_idle_system():
+    """Review regression: burn gauges are written at flight-finish only,
+    so after an outage-then-silence the scaler would read the final
+    (alarming) burn forever and hold max capacity on an idle system.
+    With a tracker wired in, signals() refreshes against the clock: an
+    empty burn window decays to 0 and the idle pool can shrink."""
+    import time as _time
+
+    from pilottai_tpu.obs.slo import SLOTracker
+
+    a, b = worker(), worker()
+    await a.start(); await b.start()
+    serve = make_serve([a, b])
+    registry = MetricsRegistry()
+    tracker = SLOTracker(registry=registry)
+    old = _time.monotonic() - 400.0  # misses now outside the burn window
+    for _ in range(20):
+        tracker.record("interactive", ok=False, at=old)
+    assert registry.snapshot()["gauges"]["slo.interactive.burn_rate"] > 1.0
+    scaler = DynamicScaling(
+        serve, ScalingConfig(min_agents=1, max_agents=5, cooldown=0.0,
+                             scale_down_threshold=0.4),
+        registry=registry, slo_tracker=tracker,
+    )
+    assert scaler.signals()["slo_burn_rate"] == 0.0
+    assert await scaler.scale_once() == "down"
+
+
+@pytest.mark.asyncio
+async def test_scaling_holds_while_budget_burns():
+    """Burn ~1x floors the load mid-range: the scaler must not drain
+    agents while the error budget is burning at provisioned rate."""
+    a, b = worker(), worker()
+    await a.start(); await b.start()
+    serve = make_serve([a, b])
+    registry = MetricsRegistry()
+    registry.set_gauge("slo.batch.burn_rate", 1.0)
+    scaler = DynamicScaling(
+        serve, ScalingConfig(min_agents=1, max_agents=5, cooldown=0.0,
+                             scale_down_threshold=0.4),
+        registry=registry,
+    )
+    assert await scaler.scale_once() is None
+    assert len(serve.agents) == 2
 
 
 # ----------------------------- fault tolerance -------------------------- #
