@@ -508,6 +508,7 @@ async def bench_pipeline(provider: str, rounds: int = 4):
     # max_iterations=20 cap instead of the orchestrator).
     for a in serve.agents.values():
         a.config.max_iterations = 2
+    _reset_task_attribution()
     await serve.start()
     try:
         waves = []
@@ -520,6 +521,10 @@ async def bench_pipeline(provider: str, rounds: int = 4):
             t0 = time.perf_counter()
             results = await serve.execute(list(tasks))
             wall = time.perf_counter() - t0
+            if r == 0:
+                # Warmup-pure attribution: round 0's compile-inflated
+                # task times must not land in the section fractions.
+                _reset_task_attribution()
             if r > 0:
                 waves.append(wall)
                 ok += sum(1 for res in results if res.success)
@@ -528,6 +533,9 @@ async def bench_pipeline(provider: str, rounds: int = 4):
                     res.execution_time for res in results
                     if res.execution_time
                 ]
+        # Capture while the agents are still registered — stop()
+        # retires each role from the occupancy tracker.
+        attribution = _task_attribution("pipeline")
     finally:
         await serve.stop()
     gc.collect()
@@ -541,6 +549,10 @@ async def bench_pipeline(provider: str, rounds: int = 4):
         "stages_per_round": len(tasks),
         "pipeline_model": "protocol-s" if provider != "mock" else "mock",
         "pipeline_trained_checkpoint": has_checkpoint(),
+        # Orchestrator-cost curve (obs/dag.py): how much of summed task
+        # e2e the orchestration layer itself ate, and how busy each
+        # specialist actually was — tracked alongside steps/s and MFU.
+        **attribution,
     }
 
 
@@ -602,6 +614,10 @@ async def bench_swarm(model: str, provider: str, n_agents: int = 32,
         await asyncio.gather(*[
             serve.execute_task(f"warm task {i}") for i in range(n_agents)
         ])
+        # Task attribution is section-pure AND warmup-pure: the compile
+        # wave's inflated task times must not land in the overhead or
+        # busy_frac fractions.
+        _reset_task_attribution()
         c0 = global_metrics.get("engine.completed")
         t0 = time.perf_counter()
         results = await asyncio.gather(*[
@@ -612,6 +628,7 @@ async def bench_swarm(model: str, provider: str, n_agents: int = 32,
         llm_steps = global_metrics.get("engine.completed") - c0
         lat = [r.execution_time for r in results if r.execution_time]
         ok = sum(1 for r in results if r.success)
+        attribution = _task_attribution("swarm")  # before stop() retires roles
     finally:
         await serve.stop()
     gc.collect()
@@ -623,6 +640,7 @@ async def bench_swarm(model: str, provider: str, n_agents: int = 32,
         "agents": n_agents,
         "swarm_model": model,
         "swarm_trained_checkpoint": has_ckpt,
+        **attribution,
     }
 
 
@@ -630,6 +648,62 @@ def _note(tag, payload):
     """Section progress to stderr — a crash in a later section must not
     lose the numbers already measured."""
     print(f"[bench] {tag}: {json.dumps(payload)}", file=sys.stderr, flush=True)
+
+
+def _reset_task_attribution():
+    """Section-pure task-DAG attribution: drop the previous section's
+    ``task.*`` histograms and the occupancy windows so this section's
+    overhead/critical-path fractions and busy_frac describe ONLY its own
+    tasks (same discipline as the ``request.`` resets above)."""
+    from pilottai_tpu.obs import global_occupancy
+    from pilottai_tpu.utils.metrics import global_metrics as _gm
+
+    _gm.reset_histograms("task.")
+    global_occupancy.reset()
+
+
+def _task_attribution(prefix):
+    """Orchestrator-cost fields for a Serve-driven section (obs/dag.py):
+    orchestration overhead and critical-path time as fractions of
+    summed task e2e, plus per-agent-role busy fractions. Histogram
+    count×mean = sum because the section reset the ``task.`` histograms
+    at its start."""
+    from pilottai_tpu.obs import global_occupancy
+    from pilottai_tpu.utils.metrics import global_metrics as _gm
+
+    hists = _gm.snapshot()["histograms"]
+
+    def total(name):
+        h = hists.get(name) or {}
+        return (h.get("count") or 0) * (h.get("mean") or 0.0)
+
+    e2e = total("task.e2e_s")
+    fracs = global_occupancy.refresh()
+    out = {
+        f"{prefix}_orchestration_overhead_frac": (
+            round(total("task.orchestrator_overhead_s") / e2e, 4)
+            if e2e else None
+        ),
+        f"{prefix}_critical_path_frac": (
+            round(total("task.critical_path_s") / e2e, 4) if e2e else None
+        ),
+        f"{prefix}_straggler_frac": (
+            round(total("task.straggler_s") / e2e, 4) if e2e else None
+        ),
+        f"{prefix}_agent_busy_frac_mean": (
+            round(statistics.mean(fracs.values()), 4) if fracs else None
+        ),
+        f"{prefix}_agent_busy_frac_max": (
+            round(max(fracs.values()), 4) if fracs else None
+        ),
+    }
+    # Full per-role map only when small (pipeline's 4 specialists, not
+    # the swarm's 32 workers — the driver tail-captures the JSON).
+    if fracs and len(fracs) <= 8:
+        out[f"{prefix}_agent_busy_frac"] = {
+            role: round(frac, 4) for role, frac in sorted(fracs.items())
+        }
+    return out
 
 
 async def run_bench():

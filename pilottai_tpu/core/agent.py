@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, List, Optional
 from pilottai_tpu.core.config import AgentConfig, LLMConfig
 from pilottai_tpu.core.status import AgentStatus
 from pilottai_tpu.core.task import Task, TaskPriority, TaskResult, TaskStatus
+from pilottai_tpu.obs.dag import global_dag, global_occupancy
 from pilottai_tpu.prompts.manager import PromptManager
 from pilottai_tpu.prompts.schemas import schema_for
 from pilottai_tpu.tools.tool import Tool, ToolRegistry
@@ -291,6 +292,10 @@ class BaseAgent:
     # ------------------------------------------------------------------ #
 
     async def start(self) -> None:
+        # Role occupancy gauges (agent.<role>.busy_frac / .queue_depth)
+        # exist from the first start — registration is idempotent and
+        # restart-safe (fault-tolerance recovery stop→start cycles).
+        global_occupancy.register(self.role, self.id)
         if self.status.is_available:
             return
         self.status = AgentStatus.STARTING
@@ -306,6 +311,10 @@ class BaseAgent:
             self._worker_task.cancel()
             self._worker_task = None
         self.status = AgentStatus.STOPPED
+        # Leave the role's occupancy denominator (start() re-registers
+        # on a recovery restart) — a retired agent counted forever would
+        # bias agent.<role>.busy_frac low after every replacement.
+        global_occupancy.unregister(self.role, self.id)
         self._log.info("agent stopped")
 
     async def reset(self) -> None:
@@ -338,6 +347,11 @@ class BaseAgent:
     # Queue surface (used by router / balancer / scaler)
     # ------------------------------------------------------------------ #
 
+    def _report_queue_depth(self) -> None:
+        global_occupancy.set_queue_depth(
+            self.role, self.task_queue.qsize() + len(self.current_tasks)
+        )
+
     async def add_task(self, task: Task) -> None:
         """Non-blocking enqueue: raises asyncio.QueueFull when at capacity
         (callers — router, balancer, fault tolerance — must handle refusal,
@@ -347,6 +361,7 @@ class BaseAgent:
         self.task_queue.put_nowait(task)
         task.mark_queued()
         task.agent_id = self.id
+        self._report_queue_depth()
 
     def remove_task(self, task_id: str) -> Optional[Task]:
         """Detach a queued (not yet running) task — used for rebalancing.
@@ -356,6 +371,7 @@ class BaseAgent:
             return None
         task.status = TaskStatus.PENDING
         task.agent_id = None
+        self._report_queue_depth()
         return task
 
     def queued_tasks(self) -> List[Task]:
@@ -387,8 +403,22 @@ class BaseAgent:
             self.status = AgentStatus.BUSY
             self.current_tasks[task.id] = task
             task.mark_started(agent_id=self.id)
+            global_occupancy.step_started(self.role, (self.id, task.id))
+            self._report_queue_depth()
             try:
-                with global_tracer.span("agent.execute_task", task_id=task.id):
+                # trace_id: the orchestrator stamps the task's trace in
+                # metadata, so retry attempts and fault-recovery re-runs
+                # land in the SAME tree even when no ambient span is
+                # live; the dag node nests tools/memory/engine flights
+                # under this agent execution.
+                with global_tracer.span(
+                    "agent.execute_task", task_id=task.id,
+                    trace_id=task.metadata.get("trace_id"),
+                    attempt=task.retry_count,
+                ), global_dag.span(
+                    task.id, "agent", self.role, trace=False,
+                    agent_id=self.id[:8], attempt=task.retry_count,
+                ):
                     result = await asyncio.wait_for(
                         self._execute_task_internal(task),
                         timeout=min(task.timeout, self.config.task_timeout),
@@ -412,6 +442,8 @@ class BaseAgent:
                 self._execution_locks.pop(task.id, None)
                 if not self.current_tasks:
                     self.status = AgentStatus.IDLE
+                global_occupancy.step_finished(self.role, (self.id, task.id))
+                self._report_queue_depth()
                 self.send_heartbeat()
 
         result.execution_time = time.perf_counter() - start

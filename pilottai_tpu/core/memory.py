@@ -22,6 +22,8 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set
 
+from pilottai_tpu.obs.dag import global_dag
+
 
 @dataclass
 class MemoryEntry:
@@ -69,6 +71,18 @@ class Memory:
         timestamp: Optional[float] = None,
     ) -> int:
         """Store a record; returns its stable entry id."""
+        # Memory-op node in the ambient task's DAG (no-op outside one):
+        # store/lookup latency becomes task.memory_s.
+        with global_dag.recorded("memory", "store"):
+            return await self._store_inner(data, tags, priority, timestamp)
+
+    async def _store_inner(
+        self,
+        data: Any,
+        tags: Optional[Set[str]] = None,
+        priority: int = 0,
+        timestamp: Optional[float] = None,
+    ) -> int:
         async with self._lock:
             entry = MemoryEntry(
                 data=data,
@@ -107,6 +121,18 @@ class Memory:
         predicate: Optional[Any] = None,
     ) -> List[MemoryEntry]:
         """Filter-match retrieval, newest first (reference ``:53-76``)."""
+        with global_dag.recorded("memory", "retrieve"):
+            return await self._retrieve_inner(
+                tags, min_priority, limit, predicate
+            )
+
+    async def _retrieve_inner(
+        self,
+        tags: Optional[Set[str]] = None,
+        min_priority: Optional[int] = None,
+        limit: int = 50,
+        predicate: Optional[Any] = None,
+    ) -> List[MemoryEntry]:
         async with self._lock:
             if tags:
                 id_sets = [self._tag_index.get(t, set()) for t in tags]

@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from pilottai_tpu.core.agent import BaseAgent
 from pilottai_tpu.core.task import Task
+from pilottai_tpu.obs.dag import global_dag
 from pilottai_tpu.utils.logging import get_logger
 
 
@@ -118,6 +119,21 @@ class TaskDelegator:
         self, task: Task, candidates: Optional[List[BaseAgent]] = None
     ) -> Tuple[Optional[BaseAgent], str]:
         """Returns (target_agent_or_None, reason)."""
+        t0 = time.perf_counter()
+        target, reason = await self._evaluate_inner(task, candidates)
+        # Delegation decision node in the task's DAG: the manager-side
+        # choice (and its reason) becomes part of the orchestration
+        # breakdown instead of invisible pre-routing latency.
+        global_dag.record(
+            task.id, "stage", "delegate",
+            start=t0, end=time.perf_counter(),
+            reason=reason, delegated=target is not None,
+        )
+        return target, reason
+
+    async def _evaluate_inner(
+        self, task: Task, candidates: Optional[List[BaseAgent]] = None
+    ) -> Tuple[Optional[BaseAgent], str]:
         should, reason = self._should_delegate(task)
         if not should:
             return None, reason

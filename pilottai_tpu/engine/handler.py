@@ -25,7 +25,12 @@ from pilottai_tpu.engine.types import (
     LLMResponse,
     ToolSpec,
 )
-from pilottai_tpu.obs import global_blackbox, global_flight, global_steps
+from pilottai_tpu.obs import (
+    global_blackbox,
+    global_dag,
+    global_flight,
+    global_steps,
+)
 from pilottai_tpu.reliability import (
     CircuitBreaker,
     CircuitOpenError,
@@ -210,6 +215,18 @@ class LLMHandler:
             update["flight_id"] = uuid.uuid4().hex[:16]
         return params.model_copy(update=update) if update else params
 
+    @staticmethod
+    def _dag_context() -> Dict[str, Any]:
+        """The ambient task-DAG node issuing this request, captured at
+        flight start (the dag ledger's finish listener joins the flight
+        into that task's DAG; the listener fires on the reader thread,
+        where the asyncio context is long gone — so it rides on the
+        flight's attributes). Empty outside any orchestrated task."""
+        cur = global_dag.current()
+        if cur is None:
+            return {}
+        return {"dag_task": cur[0], "dag_node": cur[1]}
+
     def _finish_flight(
         self,
         flight_id: str,
@@ -266,7 +283,7 @@ class LLMHandler:
         trace_id, flight_id = params.trace_id, params.flight_id
         global_flight.start(
             flight_id, trace_id=trace_id, model=self.config.model_name,
-            slo_class=params.slo_class,
+            slo_class=params.slo_class, **self._dag_context(),
         )
 
         deadline = params.deadline
@@ -486,7 +503,7 @@ class LLMHandler:
         global_flight.start(
             flight_id, trace_id=trace_id,
             model=self.config.model_name, stream=True,
-            slo_class=params.slo_class,
+            slo_class=params.slo_class, **self._dag_context(),
         )
 
         deadline = params.deadline

@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from pilottai_tpu.obs.dag import global_dag
 from pilottai_tpu.utils.logging import get_logger
 from pilottai_tpu.utils.metrics import global_metrics
 
@@ -289,6 +290,20 @@ class EnhancedMemory:
         Replaces the reference's substring scan (``enhanced_memory.py:110``).
         Returns items with similarity scores, most similar first.
         """
+        # Memory lookup node in the ambient task's DAG (no-op outside
+        # one): retrieval latency becomes task.memory_s.
+        with global_dag.recorded("memory", "semantic_search"):
+            return await self._semantic_search_inner(
+                query, limit, tags, min_priority
+            )
+
+    async def _semantic_search_inner(
+        self,
+        query: str,
+        limit: int = 5,
+        tags: Optional[Set[str]] = None,
+        min_priority: Optional[int] = None,
+    ) -> List[Dict[str, Any]]:
         async with self._semantic_lock:
             if self.embedder is None or self._vectors is None:
                 return await self._keyword_search_locked(

@@ -6,6 +6,12 @@ Correlates the three previously disconnected pieces — ``utils/metrics``
 
 * ``flight``   — per-request flight recorder (queue wait, TTFT, ITL,
   TPOT phase ledger keyed by trace id, exported as histograms).
+* ``dag``      — per-task DAG ledger: orchestration stages, queue
+  residency, agent/tool/memory nodes and joined engine flights, with
+  critical-path attribution (``task.*`` histograms), per-agent
+  occupancy gauges (``agent.<role>.busy_frac``/``queue_depth``) and the
+  ``/dag.json`` snapshot; fed by serve/agents and by every finished
+  flight via the finish-listener hook below.
 * ``ring``     — bounded engine step telemetry ring (slot occupancy,
   tokens/step, KV page utilization, strip width, pipeline depth).
 * ``slo``      — per-class (interactive/batch) SLO attainment, error-
@@ -30,6 +36,13 @@ from pilottai_tpu.obs.attribution import (
     peak_flops_per_chip,
 )
 from pilottai_tpu.obs.blackbox import BlackBox, global_blackbox
+from pilottai_tpu.obs.dag import (
+    AgentOccupancy,
+    DagLedger,
+    TaskDag,
+    global_dag,
+    global_occupancy,
+)
 from pilottai_tpu.obs.export import (
     export_completeness,
     metrics_snapshot,
@@ -50,6 +63,10 @@ from pilottai_tpu.obs.slo import (
 # "SLO attainment" a property of ALL traffic (HTTP, orchestrator, bare
 # SDK callers) rather than something each caller opts into.
 global_flight.add_finish_listener(global_slo.observe_flight)
+# ... and the task-DAG ledger: engine flights join the issuing task's
+# DAG (ambient dag context stamped at flight start; trace-id fallback),
+# so a task's breakdown can split LLM time into prefill/decode.
+global_flight.add_finish_listener(global_dag.observe_flight)
 
 # Engine admission-queue depth: maintained by the batcher (admit / fold /
 # shed paths) but declared HERE so the exported surface — and the
@@ -60,18 +77,23 @@ from pilottai_tpu.utils.metrics import global_metrics as _gm
 _gm.declare("engine.queue_depth", "gauge")
 
 __all__ = [
+    "AgentOccupancy",
     "BlackBox",
     "DEFAULT_CLASS",
+    "DagLedger",
     "DeviceTimeAttributor",
     "FlightRecorder",
     "RequestFlight",
     "SLOClass",
     "SLOTracker",
     "StepRing",
+    "TaskDag",
     "export_completeness",
     "global_attribution",
     "global_blackbox",
+    "global_dag",
     "global_flight",
+    "global_occupancy",
     "global_slo",
     "global_steps",
     "metrics_snapshot",

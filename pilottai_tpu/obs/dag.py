@@ -1,0 +1,948 @@
+"""Per-task DAG ledger: critical-path attribution for the orchestrator.
+
+``FlightRecorder`` (obs/flight.py) explains a *request*; this module
+explains a *task*. Between PR 3's request flights and PR 6's device-time
+attribution sits the orchestration layer — decomposition, queue wait,
+routing, agent reasoning steps, tool calls, memory lookups, retries,
+fan-out stragglers — and when a ``Serve`` task takes 40 s none of the
+existing surfaces can say where those seconds went. "Towards Efficient
+Agents" (PAPERS.md) argues scheduling co-design starts from exactly this
+per-stage attribution, and ROADMAP item 4 (DAG-aware scheduling) needs
+it as its input signal.
+
+Every ``Serve`` task gets a :class:`TaskDag`: nodes are lifecycle stages
+(``analyze``/``decompose``/``route``/``execute``/``evaluate``/``retry``),
+queue residencies, agent executions, tool invocations, memory ops,
+engine flights (joined from the flight recorder via the shared
+``trace_id`` + the ambient dag context), and — for decomposed parents —
+subtask rollups carrying their children's own breakdowns. Edges come
+from the ambient-context nesting plus the explicit dependency structure
+``Serve._deps_state`` already schedules on.
+
+On task finish the ledger computes the **critical path** (backward
+blame walk: from the latest-finishing node, repeatedly hop to the
+latest-finishing predecessor — dependency edges first, overlap
+containment second — recursing into children; uncovered time becomes
+synthetic ``overhead`` spans) and a time breakdown over the critical
+spans:
+
+=================================  =====================================
+``task.e2e_s``                     dag open → finish
+``task.critical_path_s``           sum of critical-path span durations
+``task.orchestrator_overhead_s``   critical time in no recorded child
+                                   (scheduling, LLM-free orchestration)
+``task.queue_wait_s``              queue nodes + flight queue waits
+``task.llm_prefill_s``             flight time up to first token
+``task.llm_decode_s``              flight time after first token
+``task.tool_s`` / ``task.memory_s``  tool / memory critical time
+``task.straggler_s``               slowest − median sibling fan-out
+                                   branch duration (0 without fan-out)
+=================================  =====================================
+
+plus per-priority queue-wait histograms
+(``task.queue_wait.<priority>_s``) fed directly by
+``PriorityTaskQueue`` put/get, and the counters ``task.completed`` /
+``task.failed`` / ``task.retries`` and gauge ``task.active``.
+
+:class:`AgentOccupancy` is the per-agent utilization companion: agents
+report busy intervals and queue depth from their step events and the
+tracker maintains ``agent.<role>.busy_frac`` (rolling 60 s window,
+normalized by the number of registered agents of the role) and
+``agent.<role>.queue_depth`` gauges.
+
+All series follow PR 6's ``declare()`` / ``export_completeness()``
+discipline: declared at construction (or at role registration), so they
+surface zero-valued from boot and the completeness walk gates them.
+
+Import cost: stdlib + utils only — no jax (``obs`` package constraint).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import statistics
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, Iterator, List, Optional, Tuple
+
+from pilottai_tpu.utils.metrics import MetricsRegistry, global_metrics
+from pilottai_tpu.utils.tracing import global_tracer
+
+#: Priority names with dedicated queue-wait histograms (core.task
+#: TaskPriority members, lower-cased; fixed so the series are declarable).
+QUEUE_PRIORITIES = ("low", "normal", "high", "critical")
+
+#: Breakdown component → histogram suffix (the ``task.*`` surface).
+BREAKDOWN_COMPONENTS = (
+    "orchestrator_overhead_s",
+    "queue_wait_s",
+    "llm_prefill_s",
+    "llm_decode_s",
+    "tool_s",
+    "memory_s",
+    "straggler_s",
+)
+
+
+@dataclass
+class DagNode:
+    """One unit of work inside a task's DAG. Timestamps are
+    ``time.perf_counter()`` — the tracer's clock, so dag spans line up
+    with the request span trees and the engine step ring in Perfetto."""
+
+    node_id: int
+    kind: str            # stage|queue|agent|tool|memory|flight|subtask|retry|overhead
+    name: str
+    start: float
+    end: Optional[float] = None
+    parent_id: Optional[int] = None
+    deps: List[int] = field(default_factory=list)
+    attributes: Dict[str, Any] = field(default_factory=dict)
+    critical: bool = False
+
+    @property
+    def duration(self) -> float:
+        return max((self.end or self.start) - self.start, 0.0)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "node_id": self.node_id,
+            "kind": self.kind,
+            "name": self.name,
+            "start": self.start,
+            "end": self.end,
+            "duration_s": round(self.duration, 6),
+            "parent_id": self.parent_id,
+            "deps": list(self.deps),
+            "critical": self.critical,
+            "attributes": dict(self.attributes),
+        }
+
+
+class TaskDag:
+    """One task's DAG record. NOT thread-safe on its own — all mutation
+    goes through :class:`DagLedger`'s lock."""
+
+    #: Per-task node cap — the same bounded-ring discipline as the
+    #: flight recorder and step ring: a pathological task (runaway
+    #: retry/iteration loop) must not grow its ledger without bound in
+    #: a long-lived serving process. Overflow is counted, not silent.
+    MAX_NODES = 512
+
+    def __init__(
+        self,
+        task_id: str,
+        trace_id: str,
+        parent_task_id: Optional[str] = None,
+        **attributes: Any,
+    ) -> None:
+        self.task_id = task_id
+        self.trace_id = trace_id
+        self.parent_task_id = parent_task_id
+        self.attributes = dict(attributes)
+        self.dropped_nodes = 0
+        self.created = time.perf_counter()
+        self.created_wall = time.time()
+        self.ended: Optional[float] = None
+        self.status: Optional[str] = None
+        self.nodes: Dict[int, DagNode] = {}
+        # Lifecycle marks in WALL time (time.time()) — the task event
+        # bus stamps events with time.time(), and the event-vs-ledger
+        # ordering test joins on this clock. First stamp wins.
+        self.marks: Dict[str, float] = {}
+        # task_id → node_id for finished subtasks rolled up into this
+        # dag (dependency edges between siblings resolve through it).
+        self.subtask_nodes: Dict[str, int] = {}
+        self._seq = 0
+        # Filled by finish():
+        self.critical_spans: List[Dict[str, Any]] = []
+        self.breakdown: Dict[str, float] = {}
+
+    # ------------------------------------------------------------------ #
+    # Construction
+    # ------------------------------------------------------------------ #
+
+    def add_node(
+        self,
+        kind: str,
+        name: str,
+        start: float,
+        end: Optional[float] = None,
+        parent_id: Optional[int] = None,
+        deps: Optional[List[int]] = None,
+        **attributes: Any,
+    ) -> DagNode:
+        self._seq += 1
+        node = DagNode(
+            node_id=self._seq, kind=kind, name=name, start=start, end=end,
+            parent_id=parent_id, deps=list(deps or ()),
+            attributes=attributes,
+        )
+        if len(self.nodes) >= self.MAX_NODES:
+            # Return the (unstored) node so call sites keep working;
+            # the overflow shows up in to_dict rather than vanishing.
+            self.dropped_nodes += 1
+            return node
+        self.nodes[node.node_id] = node
+        return node
+
+    def mark(self, event: str, at: Optional[float] = None) -> None:
+        self.marks.setdefault(event, at if at is not None else time.time())
+
+    # ------------------------------------------------------------------ #
+    # Critical path (called once, at finish, nodes frozen)
+    # ------------------------------------------------------------------ #
+
+    def _children_of(self) -> Dict[Optional[int], List[DagNode]]:
+        children: Dict[Optional[int], List[DagNode]] = {}
+        for node in self.nodes.values():
+            children.setdefault(node.parent_id, []).append(node)
+        return children
+
+    def _chain(self, siblings: List[DagNode], end_cursor: float) -> List[DagNode]:
+        """Backward blame walk over one sibling level: starting from the
+        cursor, repeatedly pick the predecessor whose end is latest —
+        explicit dependency edges of the current node first (the true
+        blocking predecessor even when a scheduling gap separates them),
+        any sibling starting before the cursor otherwise."""
+        chain: List[DagNode] = []
+        current: Optional[DagNode] = None
+        cursor = end_cursor
+        remaining = list(siblings)
+        while True:
+            pool = remaining
+            if current is not None and current.deps:
+                dep_pool = [n for n in remaining if n.node_id in current.deps]
+                if dep_pool:
+                    pool = dep_pool
+            candidates = [n for n in pool if n.start < cursor - 1e-9]
+            if not candidates:
+                break
+            best = max(candidates, key=lambda n: min(n.end or cursor, cursor))
+            chain.append(best)
+            remaining.remove(best)
+            cursor = best.start
+            current = best
+        chain.reverse()
+        return chain
+
+    def _critical_spans(
+        self,
+        node: Optional[DagNode],
+        lo: float,
+        hi: float,
+        children: Dict[Optional[int], List[DagNode]],
+    ) -> List[Dict[str, Any]]:
+        """Critical spans covering [lo, hi] attributed to ``node``'s
+        children where recorded; uncovered time becomes ``overhead``
+        spans blamed on ``node`` (None = the orchestrator itself)."""
+        kids = children.get(node.node_id if node is not None else None, [])
+        spans: List[Dict[str, Any]] = []
+        if not kids:
+            if node is not None:
+                node.critical = True
+                spans.append(self._span_of(node, lo, hi))
+            else:
+                spans.append(self._overhead_span(lo, hi, None))
+            return spans
+        chain = self._chain(kids, hi)
+        cursor = lo
+        for link in chain:
+            l_start = max(link.start, cursor)
+            l_end = min(link.end if link.end is not None else hi, hi)
+            if l_start - cursor > 1e-6:
+                spans.append(self._overhead_span(cursor, l_start, node))
+            if l_end > l_start:
+                spans.extend(
+                    self._critical_spans(link, l_start, l_end, children)
+                )
+            cursor = max(cursor, l_end)
+        if hi - cursor > 1e-6:
+            spans.append(self._overhead_span(cursor, hi, node))
+        return spans
+
+    def _span_of(self, node: DagNode, lo: float, hi: float) -> Dict[str, Any]:
+        return {
+            "node_id": node.node_id,
+            "kind": node.kind,
+            "name": node.name,
+            "start": lo,
+            "end": hi,
+            "duration_s": round(max(hi - lo, 0.0), 6),
+            "attributes": dict(node.attributes),
+        }
+
+    def _overhead_span(
+        self, lo: float, hi: float, node: Optional[DagNode]
+    ) -> Dict[str, Any]:
+        return {
+            "node_id": node.node_id if node is not None else None,
+            "kind": "overhead",
+            "name": (
+                f"overhead:{node.name}" if node is not None
+                else "overhead:orchestrator"
+            ),
+            "start": lo,
+            "end": hi,
+            "duration_s": round(max(hi - lo, 0.0), 6),
+            "attributes": {},
+        }
+
+    # ------------------------------------------------------------------ #
+    # Finish-time computation
+    # ------------------------------------------------------------------ #
+
+    def compute(self) -> None:
+        """Resolve parents, walk the critical path, derive the breakdown.
+        Called under the ledger lock exactly once, from ``finish``."""
+        end = self.ended if self.ended is not None else time.perf_counter()
+        for node in self.nodes.values():
+            if node.end is None:
+                node.end = end
+        self._resolve_orphans()
+        children = self._children_of()
+        self.critical_spans = self._critical_spans(
+            None, self.created, end, children
+        )
+        self.breakdown = self._breakdown(end)
+
+    def _resolve_orphans(self) -> None:
+        """Flight nodes recorded from the batcher's reader thread carry
+        no ambient parent — adopt the deepest non-flight node whose
+        interval contains the flight's start (time containment)."""
+        containers = [
+            n for n in self.nodes.values()
+            if n.kind in ("stage", "agent", "retry", "subtask")
+        ]
+        for node in self.nodes.values():
+            if node.parent_id is not None or node.kind not in ("flight",):
+                continue
+            best: Optional[DagNode] = None
+            for cand in containers:
+                if cand.start - 1e-6 <= node.start and (
+                    cand.end is None or cand.end + 1e-6 >= node.start
+                ):
+                    if best is None or cand.start >= best.start:
+                        best = cand
+            if best is not None:
+                node.parent_id = best.node_id
+
+    def _breakdown(self, end: float) -> Dict[str, float]:
+        out = {name: 0.0 for name in BREAKDOWN_COMPONENTS}
+        out["e2e_s"] = max(end - self.created, 0.0)
+        for span in self.critical_spans:
+            d = span["duration_s"]
+            kind = span["kind"]
+            attrs = span["attributes"]
+            if kind == "queue":
+                out["queue_wait_s"] += d
+            elif kind == "flight":
+                # Split the flight's critical time by its own phase
+                # ledger shares (queue wait / prefill / decode).
+                q = float(attrs.get("queue_wait_s") or 0.0)
+                p = float(attrs.get("prefill_s") or 0.0)
+                dec = float(attrs.get("decode_s") or 0.0)
+                total = q + p + dec
+                if total <= 0:
+                    out["llm_decode_s"] += d
+                else:
+                    out["queue_wait_s"] += d * q / total
+                    out["llm_prefill_s"] += d * p / total
+                    out["llm_decode_s"] += d * dec / total
+            elif kind == "tool":
+                out["tool_s"] += d
+            elif kind == "memory":
+                out["memory_s"] += d
+            elif kind == "subtask":
+                # Children carry their own critical-path breakdown; merge
+                # it scaled to the span's share of the child's e2e so the
+                # parent's components still sum to its critical path.
+                child = attrs.get("breakdown") or {}
+                child_total = sum(
+                    float(child.get(c) or 0.0) for c in BREAKDOWN_COMPONENTS
+                )
+                if child_total > 0:
+                    scale = d / child_total
+                    for comp in BREAKDOWN_COMPONENTS:
+                        out[comp] += float(child.get(comp) or 0.0) * scale
+                else:
+                    out["orchestrator_overhead_s"] += d
+            else:  # overhead / stage / agent / retry leaf time
+                out["orchestrator_overhead_s"] += d
+        out["critical_path_s"] = round(
+            sum(s["duration_s"] for s in self.critical_spans), 6
+        )
+        # Straggler time: across sibling fan-out branches (subtask nodes
+        # at the top level), slowest minus median duration — the price
+        # of the join waiting on its slowest branch.
+        branches = [
+            n.duration for n in self.nodes.values()
+            if n.kind == "subtask" and n.parent_id is None
+        ]
+        if len(branches) >= 2:
+            out["straggler_s"] = max(
+                max(branches) - statistics.median(branches), 0.0
+            )
+        for key in list(out):
+            out[key] = round(out[key], 6)
+        return out
+
+    # ------------------------------------------------------------------ #
+
+    def to_dict(self, nodes: bool = True) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "task_id": self.task_id,
+            "trace_id": self.trace_id,
+            "parent_task_id": self.parent_task_id,
+            "status": self.status,
+            "attributes": dict(self.attributes),
+            "created_wall": self.created_wall,
+            "e2e_s": round(
+                ((self.ended or time.perf_counter()) - self.created), 6
+            ),
+            "marks": {
+                k: round(v - self.created_wall, 6)
+                for k, v in sorted(self.marks.items(), key=lambda kv: kv[1])
+            },
+            "breakdown": dict(self.breakdown),
+            "critical_path": list(self.critical_spans),
+            "dropped_nodes": self.dropped_nodes,
+        }
+        if nodes:
+            out["nodes"] = [
+                n.to_dict() for n in sorted(
+                    self.nodes.values(), key=lambda n: n.node_id
+                )
+            ]
+        return out
+
+
+class DagLedger:
+    """Registry of in-flight and recently finished task DAGs.
+
+    Thread-safe: serve and agents mutate from the event loop while the
+    flight recorder's finish listener attaches engine flights from the
+    batcher's reader thread. Every method is a cheap no-op for unknown
+    task ids — instrumentation call sites (tools, memory, agents running
+    outside an orchestrated task) never need guards.
+    """
+
+    def __init__(
+        self,
+        max_finished: int = 256,
+        registry: MetricsRegistry = global_metrics,
+        tracer: Any = global_tracer,
+    ) -> None:
+        self._active: Dict[str, TaskDag] = {}
+        self._finished: Deque[TaskDag] = deque(maxlen=max_finished)
+        self._lock = threading.Lock()
+        self._registry = registry
+        self._tracer = tracer
+        # Queue residency start times (task_id → (perf_counter, priority)).
+        self._queued: Dict[str, Tuple[float, str]] = {}
+        # Ambient (task_id, node_id) stack — contextvars so interleaved
+        # asyncio task executions each see their own nesting.
+        self._ctx: contextvars.ContextVar[tuple] = contextvars.ContextVar(
+            f"pilottai_dag_ctx_{id(self)}", default=()
+        )
+        registry.declare("task.e2e_s", "histogram")
+        registry.declare("task.critical_path_s", "histogram")
+        for comp in BREAKDOWN_COMPONENTS:
+            registry.declare(f"task.{comp}", "histogram")
+        registry.declare("task.queue_wait_total_s", "histogram")
+        for prio in QUEUE_PRIORITIES:
+            registry.declare(f"task.queue_wait.{prio}_s", "histogram")
+        registry.declare("task.completed", "counter")
+        registry.declare("task.failed", "counter")
+        registry.declare("task.cancelled", "counter")
+        registry.declare("task.retries", "counter")
+        registry.declare("task.active", "gauge")
+        registry.set_gauge("task.active", 0.0)
+
+    # ------------------------------------------------------------------ #
+    # Ambient context
+    # ------------------------------------------------------------------ #
+
+    def current(self) -> Optional[Tuple[str, int]]:
+        stack = self._ctx.get()
+        return stack[-1] if stack else None
+
+    def current_task(self) -> Optional[str]:
+        cur = self.current()
+        return cur[0] if cur is not None else None
+
+    # ------------------------------------------------------------------ #
+    # Lifecycle
+    # ------------------------------------------------------------------ #
+
+    def start(
+        self,
+        task_id: str,
+        trace_id: Optional[str] = None,
+        parent_task_id: Optional[str] = None,
+        **attributes: Any,
+    ) -> TaskDag:
+        """Get-or-create the active dag for ``task_id`` (idempotent:
+        ``requeue_task`` re-enters ``_queue_task`` for a task whose dag
+        already exists — the record, and its history, survive)."""
+        with self._lock:
+            dag = self._active.get(task_id)
+            if dag is None:
+                dag = TaskDag(
+                    task_id, trace_id or task_id,
+                    parent_task_id=parent_task_id, **attributes,
+                )
+                self._active[task_id] = dag
+                self._registry.set_gauge("task.active", len(self._active))
+            else:
+                dag.attributes.update(attributes)
+            return dag
+
+    def mark(self, task_id: str, event: str, at: Optional[float] = None) -> None:
+        with self._lock:
+            dag = self._active.get(task_id)
+            if dag is not None:
+                dag.mark(event, at)
+
+    def record(
+        self,
+        task_id: Optional[str],
+        kind: str,
+        name: str,
+        start: float,
+        end: float,
+        deps: Optional[List[int]] = None,
+        **attributes: Any,
+    ) -> Optional[int]:
+        """Record an already-finished node. The ambient dag context (when
+        it matches ``task_id``) supplies the parent node."""
+        if task_id is None:
+            return None
+        parent_id = None
+        cur = self.current()
+        if cur is not None and cur[0] == task_id:
+            parent_id = cur[1]
+        with self._lock:
+            dag = self._active.get(task_id)
+            if dag is None:
+                return None
+            node = dag.add_node(
+                kind, name, start, end=end, parent_id=parent_id,
+                deps=deps, **attributes,
+            )
+            return node.node_id
+
+    @contextlib.contextmanager
+    def recorded(self, kind: str, name: str, **attributes: Any) -> Iterator[None]:
+        """Record the wrapped block as a node under the AMBIENT task (a
+        no-op outside one) — the one-liner for instrumenting tool-like
+        call sites (memory ops, lookups) without threading a task id."""
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(
+                self.current_task(), kind, name,
+                start=start, end=time.perf_counter(), **attributes,
+            )
+
+    @contextlib.contextmanager
+    def span(
+        self,
+        task_id: str,
+        kind: str,
+        name: str,
+        trace: bool = True,
+        **attributes: Any,
+    ) -> Iterator[Optional[DagNode]]:
+        """Open a dag node around a code block, push it as the ambient
+        dag context (tools/memory/flights nest under it), and — unless
+        ``trace=False`` — mirror it as a tracer span so the stage shows
+        up in the task's Perfetto tree with correct parentage for the
+        engine spans opened inside. No-op (yields None) for unknown
+        tasks, so direct ``BaseAgent.execute_task`` callers outside an
+        orchestrated task pay nothing."""
+        start = time.perf_counter()
+        parent_id = None
+        cur = self.current()
+        if cur is not None and cur[0] == task_id:
+            parent_id = cur[1]
+        with self._lock:
+            dag = self._active.get(task_id)
+            node = (
+                dag.add_node(kind, name, start, parent_id=parent_id,
+                             **attributes)
+                if dag is not None else None
+            )
+            dag_trace = dag.trace_id if dag is not None else None
+        if node is None:
+            yield None
+            return
+        token = self._ctx.set(self._ctx.get() + ((task_id, node.node_id),))
+        span_cm = (
+            self._tracer.span(
+                f"{kind}.{name}", trace_id=dag_trace, task_id=task_id,
+                **attributes,
+            )
+            if trace else contextlib.nullcontext()
+        )
+        try:
+            with span_cm:
+                yield node
+        finally:
+            self._ctx.reset(token)
+            with self._lock:
+                if node.end is None:  # finish() may have clamped it already
+                    node.end = time.perf_counter()
+
+    # ------------------------------------------------------------------ #
+    # Queue residency (PriorityTaskQueue put/get)
+    # ------------------------------------------------------------------ #
+
+    def queue_enter(self, task_id: str, priority: str) -> None:
+        with self._lock:
+            if task_id in self._active:
+                self._queued[task_id] = (time.perf_counter(), priority)
+
+    def queue_exit(self, task_id: str) -> None:
+        now = time.perf_counter()
+        with self._lock:
+            entry = self._queued.pop(task_id, None)
+            if entry is None:
+                return
+            entered, priority = entry
+            dag = self._active.get(task_id)
+            if dag is not None:
+                dag.add_node(
+                    "queue", "task_queue", entered, end=now, priority=priority
+                )
+        wait = max(now - entered, 0.0)
+        self._registry.observe("task.queue_wait_total_s", wait)
+        prio = priority.lower()
+        if prio in QUEUE_PRIORITIES:
+            self._registry.observe(f"task.queue_wait.{prio}_s", wait)
+
+    # ------------------------------------------------------------------ #
+    # FlightRecorder integration (finish listener; any thread)
+    # ------------------------------------------------------------------ #
+
+    def observe_flight(self, flight: Any) -> None:
+        """Join an engine flight into its task's dag. The handler stamps
+        ``dag_task``/``dag_node`` attributes at flight start (the
+        ambient dag context of the asyncio task that issued the LLM
+        call); trace-id match is the fallback for flights started
+        outside any dag context. Never raises."""
+        try:
+            task_id = flight.attributes.get("dag_task")
+            parent_node = flight.attributes.get("dag_node")
+            with self._lock:
+                dag = self._active.get(task_id) if task_id else None
+                if dag is None:
+                    dag = next(
+                        (
+                            d for d in self._active.values()
+                            if d.trace_id == flight.trace_id
+                        ),
+                        None,
+                    )
+                    parent_node = None
+                if dag is None:
+                    return
+                derived = flight.derived()
+                started = flight.started
+                ended = flight.ended or time.perf_counter()
+                queue_wait = derived.get("queue_wait_s") or 0.0
+                ttft = derived.get("ttft_s")
+                prefill = max(ttft - queue_wait, 0.0) if ttft is not None \
+                    else 0.0
+                decode = max(
+                    (ended - started) - queue_wait - prefill, 0.0
+                )
+                dag.add_node(
+                    "flight",
+                    flight.attributes.get("model", "llm"),
+                    started,
+                    end=ended,
+                    parent_id=(
+                        parent_node
+                        if isinstance(parent_node, int)
+                        and parent_node in dag.nodes else None
+                    ),
+                    flight_id=flight.flight_id,
+                    status=flight.status,
+                    tokens=flight.n_tokens,
+                    queue_wait_s=round(queue_wait, 6),
+                    prefill_s=round(prefill, 6),
+                    decode_s=round(decode, 6),
+                )
+        except Exception:  # noqa: BLE001 — telemetry must not raise
+            pass
+
+    # ------------------------------------------------------------------ #
+    # Finish
+    # ------------------------------------------------------------------ #
+
+    def finish(
+        self, task_id: str, status: str = "ok"
+    ) -> Optional[Dict[str, Any]]:
+        """Close the task's dag: compute critical path + breakdown,
+        observe the ``task.*`` histograms, roll the record up into its
+        parent's dag (when one is active), emit the critical path as
+        tracer spans (flagged ``critical_path``) and move the record to
+        the finished ring. Returns the summary dict, or None when no
+        active dag exists — safe on every finalize path."""
+        with self._lock:
+            dag = self._active.pop(task_id, None)
+            if dag is None:
+                return None
+            self._queued.pop(task_id, None)
+            dag.status = status
+            if dag.ended is None:  # synthetic ledgers may pre-stamp it
+                dag.ended = time.perf_counter()
+            dag.compute()
+            self._finished.append(dag)
+            self._registry.set_gauge("task.active", len(self._active))
+            parent = (
+                self._active.get(dag.parent_task_id)
+                if dag.parent_task_id else None
+            )
+            if parent is not None:
+                deps = [
+                    parent.subtask_nodes[d]
+                    for d in dag.attributes.get("dependencies", ())
+                    if d in parent.subtask_nodes
+                ]
+                node = parent.add_node(
+                    "subtask", task_id[:8], dag.created, end=dag.ended,
+                    deps=deps, status=status,
+                    breakdown=dict(dag.breakdown),
+                )
+                parent.subtask_nodes[task_id] = node.node_id
+        reg = self._registry
+        bd = dag.breakdown
+        reg.observe("task.e2e_s", bd.get("e2e_s", 0.0))
+        reg.observe("task.critical_path_s", bd.get("critical_path_s", 0.0))
+        for comp in BREAKDOWN_COMPONENTS:
+            reg.observe(f"task.{comp}", bd.get(comp, 0.0))
+        # Cancellation is routine (shutdown drains, queue eviction) —
+        # it must not inflate the failure counter an alert keys on.
+        if status == "ok":
+            reg.inc("task.completed")
+        elif status == "cancelled":
+            reg.inc("task.cancelled")
+        else:
+            reg.inc("task.failed")
+        retries = sum(1 for n in dag.nodes.values() if n.kind == "retry")
+        if retries:
+            reg.inc("task.retries", retries)
+        # Critical path as a span lane in the task's Perfetto trace:
+        # each critical span emitted as a finished tracer span flagged
+        # ``critical_path`` — load /trace.json?trace_id=<task trace> and
+        # the blamed lane renders alongside the live span tree.
+        for span in dag.critical_spans:
+            self._tracer.emit(
+                f"dag.critical.{span['kind']}",
+                trace_id=dag.trace_id,
+                start=span["start"],
+                end=span["end"],
+                task_id=task_id,
+                node=span["name"],
+                critical_path=True,
+            )
+        return dag.to_dict(nodes=False)
+
+    # ------------------------------------------------------------------ #
+    # Inspection (/dag.json)
+    # ------------------------------------------------------------------ #
+
+    def describe(self, task_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            dag = self._active.get(task_id)
+            if dag is None:
+                dag = next(
+                    (d for d in reversed(self._finished)
+                     if d.task_id == task_id),
+                    None,
+                )
+            return dag.to_dict() if dag is not None else None
+
+    def finished(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            records = list(self._finished)
+        if n is not None:
+            records = records[-n:]
+        return [d.to_dict(nodes=False) for d in records]
+
+    def snapshot(self, n_finished: int = 32) -> Dict[str, Any]:
+        """The ``/dag.json`` shape: active task summaries + the most
+        recent finished breakdowns/critical paths."""
+        with self._lock:
+            active = [
+                {
+                    "task_id": d.task_id,
+                    "trace_id": d.trace_id,
+                    "age_s": round(time.perf_counter() - d.created, 3),
+                    "nodes": len(d.nodes),
+                    "marks": {
+                        k: round(v - d.created_wall, 3)
+                        for k, v in d.marks.items()
+                    },
+                }
+                for d in self._active.values()
+            ]
+        return {
+            "active": active,
+            "finished": self.finished(n_finished),
+        }
+
+    def active_count(self) -> int:
+        with self._lock:
+            return len(self._active)
+
+    def reset(self) -> None:
+        """Drop all state (tests / bench section isolation)."""
+        with self._lock:
+            self._active.clear()
+            self._finished.clear()
+            self._queued.clear()
+            self._registry.set_gauge("task.active", 0.0)
+
+
+class AgentOccupancy:
+    """Per-role busy-fraction and queue-depth gauges, sampled from
+    ``BaseAgent`` step events.
+
+    ``busy_frac`` is busy-seconds over a rolling window (60 s, or the
+    time since the role registered when younger), normalized by the
+    number of registered agents of the role — 1.0 means every agent of
+    the role was executing for the whole window. Gauges follow the
+    ``declare()`` discipline per role at registration.
+    """
+
+    def __init__(
+        self,
+        registry: MetricsRegistry = global_metrics,
+        window_s: float = 60.0,
+    ) -> None:
+        self._registry = registry
+        self._window_s = window_s
+        self._lock = threading.Lock()
+        self._agents: Dict[str, set] = {}
+        self._since: Dict[str, float] = {}
+        # Per role: closed busy intervals (start, end) within the window
+        # plus currently-open step starts keyed by (agent_id, task_id).
+        self._busy: Dict[str, Deque[Tuple[float, float]]] = {}
+        self._open: Dict[str, Dict[Any, float]] = {}
+
+    def register(self, role: str, agent_id: str) -> None:
+        with self._lock:
+            fresh = role not in self._agents
+            self._agents.setdefault(role, set()).add(agent_id)
+            if fresh:
+                self._since[role] = time.perf_counter()
+                self._busy[role] = deque()
+                self._open[role] = {}
+                self._registry.declare(f"agent.{role}.busy_frac", "gauge")
+                self._registry.declare(f"agent.{role}.queue_depth", "gauge")
+                self._registry.set_gauge(f"agent.{role}.busy_frac", 0.0)
+                self._registry.set_gauge(f"agent.{role}.queue_depth", 0.0)
+
+    def unregister(self, role: str, agent_id: str) -> None:
+        """Remove an agent from its role's denominator; the LAST agent
+        of a role retires the role's tracking entirely (gauges zeroed,
+        declarations kept) — a stale role would otherwise bias every
+        mean-over-roles consumer (bench busy_frac means, scaler reads)
+        and, after agent replacement, halve busy_frac forever."""
+        with self._lock:
+            agents = self._agents.get(role)
+            if not agents:
+                return
+            agents.discard(agent_id)
+            if agents:
+                return
+            for table in (self._agents, self._since, self._busy, self._open):
+                table.pop(role, None)
+        self._registry.set_gauge(f"agent.{role}.busy_frac", 0.0)
+        self._registry.set_gauge(f"agent.{role}.queue_depth", 0.0)
+
+    def step_started(self, role: str, key: Any) -> None:
+        with self._lock:
+            if role in self._open:
+                self._open[role][key] = time.perf_counter()
+        self._refresh_role(role)
+
+    def step_finished(self, role: str, key: Any) -> None:
+        now = time.perf_counter()
+        with self._lock:
+            if role not in self._busy:
+                return
+            start = self._open[role].pop(key, None)
+            if start is not None:
+                self._busy[role].append((start, now))
+        self._refresh_role(role)
+
+    def set_queue_depth(self, role: str, depth: int) -> None:
+        if role in self._busy:
+            self._registry.set_gauge(f"agent.{role}.queue_depth", float(depth))
+
+    def _busy_frac_locked(self, role: str, now: float) -> float:
+        window = min(
+            self._window_s, max(now - self._since.get(role, now), 1e-6)
+        )
+        cutoff = now - window
+        intervals = self._busy[role]
+        while intervals and intervals[0][1] < cutoff:
+            intervals.popleft()
+        busy = sum(
+            min(end, now) - max(start, cutoff)
+            for start, end in intervals
+            if end > cutoff
+        )
+        busy += sum(
+            now - max(start, cutoff) for start in self._open[role].values()
+        )
+        n = max(len(self._agents.get(role, ())), 1)
+        return min(busy / (window * n), 1.0)
+
+    def _refresh_role(self, role: str) -> None:
+        now = time.perf_counter()
+        with self._lock:
+            if role not in self._busy:
+                return
+            frac = self._busy_frac_locked(role, now)
+        self._registry.set_gauge(f"agent.{role}.busy_frac", frac)
+
+    def refresh(self) -> Dict[str, float]:
+        """Recompute every role's busy_frac against NOW (bench reads
+        gauges after a section; step-event-only writes would freeze the
+        last mid-run value). Returns role → busy_frac."""
+        now = time.perf_counter()
+        with self._lock:
+            fracs = {
+                role: self._busy_frac_locked(role, now)
+                for role in self._busy
+            }
+        for role, frac in fracs.items():
+            self._registry.set_gauge(f"agent.{role}.busy_frac", frac)
+        return fracs
+
+    def roles(self) -> List[str]:
+        with self._lock:
+            return sorted(self._busy)
+
+    def reset(self) -> None:
+        with self._lock:
+            roles = list(self._busy)
+            for role in roles:
+                self._busy[role].clear()
+                self._open[role].clear()
+                self._since[role] = time.perf_counter()
+        for role in roles:
+            self._registry.set_gauge(f"agent.{role}.busy_frac", 0.0)
+
+
+global_dag = DagLedger()
+global_occupancy = AgentOccupancy()

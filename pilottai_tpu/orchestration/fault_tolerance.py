@@ -18,6 +18,7 @@ requeued — BASELINE config #5's recovery story.
 from __future__ import annotations
 
 import asyncio
+import inspect
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -25,6 +26,7 @@ from typing import Any, Dict, List, Optional
 from pilottai_tpu.core.agent import BaseAgent
 from pilottai_tpu.core.config import FaultToleranceConfig
 from pilottai_tpu.core.status import AgentStatus, HealthStatus
+from pilottai_tpu.obs.dag import global_dag
 from pilottai_tpu.reliability import global_injector
 from pilottai_tpu.utils.logging import get_logger
 from pilottai_tpu.utils.metrics import global_metrics
@@ -65,6 +67,10 @@ class FaultTolerance:
         self.config = config or FaultToleranceConfig()
         self.health: Dict[str, AgentHealth] = {}
         self.recovery_history: List[Dict[str, Any]] = []
+        # Last observed heartbeat staleness per agent (seconds) — when a
+        # stale heartbeat triggers recovery, the affected tasks' DAG
+        # retry nodes carry the stall so the lost time is attributed.
+        self._last_stall: Dict[str, float] = {}
         self._task: Optional[asyncio.Task] = None
         self._log = get_logger("orchestration.fault")
 
@@ -90,6 +96,7 @@ class FaultTolerance:
 
     def unregister_agent(self, agent_id: str) -> None:
         self.health.pop(agent_id, None)
+        self._last_stall.pop(agent_id, None)
         # Drop the health gauge with the record: a stale gauge for a
         # removed agent reads as a live health report forever.
         global_metrics.remove_gauge(f"fault.health.{agent_id}")
@@ -119,6 +126,9 @@ class FaultTolerance:
                 health.last_heartbeat, time.time() - float(stall)
             )
         health.error_count = info["error_count"]
+        self._last_stall[agent.id] = max(
+            time.time() - health.last_heartbeat, 0.0
+        )
         health.stuck_tasks = sum(
             1
             for t in agent.current_tasks.values()
@@ -168,6 +178,7 @@ class FaultTolerance:
         for agent_id in list(self.health):
             if agent_id not in live:
                 del self.health[agent_id]
+                self._last_stall.pop(agent_id, None)
                 global_metrics.remove_gauge(f"fault.health.{agent_id}")
         return statuses
 
@@ -207,14 +218,25 @@ class FaultTolerance:
         except Exception as exc:  # noqa: BLE001 - recovery boundary
             self._log.warning("recovery of %s failed: %s", agent.id[:8], exc)
             ok = False
+        stall_s = round(self._last_stall.get(agent.id, 0.0), 3)
+        now = time.perf_counter()
         for task in preserved:
+            # The recovery interruption lands in the task's DAG as a
+            # retry node carrying the observed heartbeat stall — a
+            # chaos-injected stall is attributable, not silent dead time
+            # (the chaos CI lane pins exactly this).
+            global_dag.record(
+                task.id, "retry", "agent_recovery",
+                start=now, end=now,
+                agent_id=agent.id[:8], stall_s=stall_s,
+            )
             if ok:
                 try:
                     await agent.add_task(task)
                     continue
                 except Exception:  # noqa: BLE001 - fall through to requeue
                     pass
-            await self._requeue(task)
+            await self._requeue(task, stall_s=stall_s)
         self._audit("recover", agent.id, ok)
         if ok:
             health.status = HealthStatus.HEALTHY
@@ -272,11 +294,33 @@ class FaultTolerance:
         )
         return replacement
 
-    async def _requeue(self, task: Any) -> None:
+    async def _requeue(self, task: Any, **dag_attrs: Any) -> None:
         """Route a detached task back through orchestrator routing; a task
-        must never be silently orphaned."""
+        must never be silently orphaned. The DAG attribution kwargs are
+        passed only when the orchestrator's signature accepts them
+        (custom/stub orchestrators may predate the DAG-aware requeue) —
+        probed via inspection, NOT except TypeError, which would also
+        swallow real TypeErrors raised inside the awaited call."""
+        kwargs: Dict[str, Any] = {}
         try:
-            await self.orchestrator.requeue_task(task)
+            params = inspect.signature(
+                self.orchestrator.requeue_task
+            ).parameters
+            var_kw = any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params.values()
+            )
+            # Filter PER KWARG: an orchestrator accepting `reason` but
+            # not **kwargs must not be handed stall_s and blow up.
+            for key, value in (
+                ("reason", "fault_recovery"), *dag_attrs.items()
+            ):
+                if var_kw or key in params:
+                    kwargs[key] = value
+        except (TypeError, ValueError):  # uninspectable callable
+            pass
+        try:
+            await self.orchestrator.requeue_task(task, **kwargs)
         except Exception as exc:  # noqa: BLE001 - last resort: log loudly
             self._log.error("task %s lost: requeue failed: %s", task.id[:8], exc)
 

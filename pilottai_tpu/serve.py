@@ -21,6 +21,7 @@ import asyncio
 import heapq
 import itertools
 import time
+import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 from pilottai_tpu.core.agent import BaseAgent
@@ -28,6 +29,7 @@ from pilottai_tpu.core.config import AgentConfig, LLMConfig, ServeConfig
 from pilottai_tpu.core.memory import Memory
 from pilottai_tpu.core.router import TaskRouter
 from pilottai_tpu.core.task import Task, TaskPriority, TaskResult, TaskStatus
+from pilottai_tpu.obs.dag import global_dag
 from pilottai_tpu.prompts.manager import PromptManager
 from pilottai_tpu.prompts.schemas import schema_for
 from pilottai_tpu.utils.json_utils import coerce_bool, extract_json
@@ -76,6 +78,10 @@ class PriorityTaskQueue:
             self._ids[task.id] = task
             heapq.heappush(self._heap, (-int(task.priority), next(self._seq), task))
             task.mark_queued()
+            # Queue residency opens here and closes at get(): the DAG
+            # ledger turns the pair into a "queue" node and the
+            # queue-wait-by-priority histograms.
+            global_dag.queue_enter(task.id, task.priority.name)
             self._not_empty.notify()
         return evicted
 
@@ -90,6 +96,7 @@ class PriorityTaskQueue:
                 _, _, task = heapq.heappop(self._heap)
                 if task.id in self._ids:  # skip tombstones (evicted/removed)
                     self._ids.pop(task.id)
+                    global_dag.queue_exit(task.id)
                     return task
             return None
 
@@ -363,6 +370,15 @@ class Serve:
         for waiter in self._waiters.values():
             if not waiter.done():
                 waiter.set_result(stopped)
+        # Settle DAG records of work that will never reach _finalize —
+        # an active ledger entry for a dead task would pin task.active
+        # and leak until process exit.
+        for task in (
+            list(self.running_tasks.values())
+            + self.task_queue.snapshot()
+            + list(self._blocked.values())
+        ):
+            global_dag.finish(task.id, "cancelled")
         for agent in self.agents.values():
             await agent.stop()
         if self.manager_llm is not None:
@@ -421,11 +437,17 @@ class Serve:
                 self._event_subs.pop(task_id, None)
 
     def _emit_event(self, task: Task | str, event: str, **data: Any) -> None:
+        tid = task if isinstance(task, str) else task.id
+        ts = time.time()
+        # One clock for both surfaces: the DAG ledger's lifecycle marks
+        # carry the same timestamp the event payload does, so the event
+        # stream and the ledger stay order-consistent by construction
+        # (first stamp wins on repeated events like step/retry).
+        global_dag.mark(tid, event, at=ts)
         if not self._event_subs:
             return
-        tid = task if isinstance(task, str) else task.id
         parent = None if isinstance(task, str) else task.parent_task_id
-        payload = {"event": event, "task_id": tid, "ts": time.time(), **data}
+        payload = {"event": event, "task_id": tid, "ts": ts, **data}
         for key in {tid, parent} - {None}:
             for q in self._event_subs.get(key, ()):
                 try:
@@ -440,15 +462,38 @@ class Serve:
                     except asyncio.QueueFull:
                         pass
 
+    def _task_trace(self, task: Task) -> str:
+        """The task's trace id, stamped once in ``metadata`` at intake:
+        adopted from the ambient span when one is live (the HTTP edge's
+        ``server.request``) and minted otherwise. Every span the task's
+        execution opens — across the processor's separate asyncio tasks,
+        retries and requeues — seeds from THIS id, so one task is one
+        Perfetto tree instead of a fresh trace per scheduling hop."""
+        trace = task.metadata.get("trace_id")
+        if not trace:
+            ambient = global_tracer.current()
+            trace = (
+                ambient.trace_id if ambient is not None
+                else uuid.uuid4().hex[:16]
+            )
+            task.metadata["trace_id"] = trace
+        return trace
+
     async def add_task(self, task: Task | Dict[str, Any] | str) -> Task:
         """Analyze, maybe decompose, and queue. Returns the (parent) Task."""
         task = self._coerce_task(task)
         self.all_tasks[task.id] = task
         self.metrics["tasks_received"] += 1
         self._waiters.setdefault(task.id, asyncio.get_running_loop().create_future())
+        global_dag.start(
+            task.id, trace_id=self._task_trace(task),
+            parent_task_id=task.parent_task_id,
+            type=task.type, priority=task.priority.name,
+        )
         self._emit_event(task, "received", description=task.description[:200])
 
-        analysis = await self._analyze_task(task)
+        with global_dag.span(task.id, "stage", "analyze"):
+            analysis = await self._analyze_task(task)
         self._emit_event(
             task, "analyzed",
             complexity=task.complexity,
@@ -515,10 +560,11 @@ class Serve:
         """LLM decomposition into dependent subtasks (reference ``:427-458``)."""
         prompt = self.prompts.format_prompt("task_decomposition", task=task.to_prompt())
         try:
-            content = await self.manager_llm.apredict(
-                prompt, json_mode=True,
-                json_schema=schema_for("orchestrator", "task_decomposition"),
-            )
+            with global_dag.span(task.id, "stage", "decompose"):
+                content = await self.manager_llm.apredict(
+                    prompt, json_mode=True,
+                    json_schema=schema_for("orchestrator", "task_decomposition"),
+                )
             data = extract_json(content) or {}
             raw_subtasks = data.get("subtasks") or []
         except Exception as exc:  # noqa: BLE001 - fall back to simple path
@@ -557,8 +603,19 @@ class Serve:
         if self.journal is not None:  # parents never pass through _queue_task
             self.journal.record_task(task)
         self.metrics["subtasks_created"] += len(subtasks)
+        trace = self._task_trace(task)
         for sub in subtasks:
             self.all_tasks[sub.id] = sub
+            # One task tree = one trace: delegated subtasks inherit the
+            # parent's trace id, and each gets its own DAG record whose
+            # finish rolls up into the parent's (with the dependency
+            # edges the scheduler runs on).
+            sub.metadata["trace_id"] = trace
+            global_dag.start(
+                sub.id, trace_id=trace, parent_task_id=task.id,
+                type=sub.type, priority=sub.priority.name,
+                dependencies=list(sub.dependencies),
+            )
             self._waiters.setdefault(
                 sub.id, asyncio.get_running_loop().create_future()
             )
@@ -614,12 +671,27 @@ class Serve:
             asyncio.shield(future), timeout=timeout or self.config.task_timeout * 4
         )
 
-    async def requeue_task(self, task: Task) -> None:
+    async def requeue_task(
+        self, task: Task, reason: str = "requeue", **dag_attrs: Any
+    ) -> None:
         """Put a detached task back through orchestrator routing (used by
-        the load balancer's last-resort rollback)."""
+        the load balancer's last-resort rollback and fault-tolerance
+        recovery). The task keeps its stamped trace id and its DAG
+        record — the requeue lands as a ``retry`` node (with the
+        caller's attribution, e.g. heartbeat stall seconds) instead of
+        restarting the trace."""
         task.status = TaskStatus.PENDING
         task.agent_id = None
         self.all_tasks.setdefault(task.id, task)
+        global_dag.start(
+            task.id, trace_id=self._task_trace(task),
+            parent_task_id=task.parent_task_id,
+            type=task.type, priority=task.priority.name,
+        )
+        now = time.perf_counter()
+        global_dag.record(
+            task.id, "retry", reason, start=now, end=now, **dag_attrs
+        )
         await self._queue_task(task)
 
     def get_task(self, task_id: str) -> Optional[Task]:
@@ -701,8 +773,16 @@ class Serve:
                 self._finalize(task, TaskResult(success=False, error=str(exc)))
 
     async def _execute_task(self, task: Task) -> None:
-        with global_tracer.span("serve.execute_task", task_id=task.id):
-            agent = await self._select_agent(task)
+        # trace_id from the task's stamped trace: execution runs in a
+        # processor-spawned asyncio task with NO ambient span, so without
+        # it every scheduling hop would mint a fresh trace and the
+        # server → orchestrator → agent → engine tree would split here.
+        with global_tracer.span(
+            "serve.execute_task", task_id=task.id,
+            trace_id=self._task_trace(task),
+        ), global_dag.span(task.id, "stage", "execute", trace=False):
+            with global_dag.span(task.id, "stage", "route"):
+                agent = await self._select_agent(task)
             if agent is None:
                 self._finalize(
                     task, TaskResult(success=False, error="no available agent")
@@ -771,14 +851,15 @@ class Serve:
                     agent_id=task.agent_id or "unknown",
                     result=str(result.output)[:2000],
                 )
-                evaluation = extract_json(
-                    await self.manager_llm.apredict(
-                        prompt, json_mode=True,
-                        json_schema=schema_for(
-                            "orchestrator", "result_evaluation"
-                        ),
-                    )
-                ) or {}
+                with global_dag.span(task.id, "stage", "evaluate"):
+                    evaluation = extract_json(
+                        await self.manager_llm.apredict(
+                            prompt, json_mode=True,
+                            json_schema=schema_for(
+                                "orchestrator", "result_evaluation"
+                            ),
+                        )
+                    ) or {}
                 needs_retry = coerce_bool(evaluation.get("requires_retry", False))
                 result.metadata["orchestrator_evaluation"] = evaluation
             except Exception as exc:  # noqa: BLE001 - evaluation is advisory
@@ -794,7 +875,15 @@ class Serve:
                 break
             self._emit_event(task, "retry", attempt=retries, agent_id=agent.id)
             task.mark_started(agent_id=agent.id)
-            result = await agent.execute_task(task)
+            # Retry attempts are CHILD spans of the task's single trace
+            # (attempt index as attribute) — one task, one Perfetto
+            # tree, retries included; restarting the ambient trace here
+            # used to orphan every post-retry span.
+            with global_dag.span(
+                task.id, "retry", f"attempt-{retries}",
+                attempt=retries, agent_id=agent.id[:8],
+            ):
+                result = await agent.execute_task(task)
             needs_retry = not result.success
         return result
 
@@ -821,6 +910,17 @@ class Serve:
             task, "completed" if result.success else "failed",
             success=result.success, error=result.error,
             execution_time=result.execution_time,
+        )
+
+        # Close the task's DAG: critical path + breakdown computed here,
+        # task.* histograms observed, subtask records rolled up into the
+        # parent's dag (when one is still active).
+        global_dag.finish(
+            task.id,
+            "ok" if result.success else (
+                "cancelled" if task.status == TaskStatus.CANCELLED
+                else "failed"
+            ),
         )
 
         waiter = self._waiters.get(task.id)

@@ -408,6 +408,20 @@ class APIServer:
             from pilottai_tpu.obs import global_slo
 
             await self._send(writer, 200, global_slo.snapshot())
+        elif path == "/dag.json" and method == "GET":
+            # Task-DAG attribution (obs/dag.py): active task summaries +
+            # recent finished breakdowns with critical paths; ?task_id=
+            # returns one task's full node-level ledger.
+            from pilottai_tpu.obs import global_dag
+
+            task_id = (parse_qs(query).get("task_id") or [None])[0]
+            if task_id:
+                described = global_dag.describe(task_id)
+                if described is None:
+                    raise _HttpError(404, f"no dag for task {task_id!r}")
+                await self._send(writer, 200, _jsonable(described))
+            else:
+                await self._send(writer, 200, _jsonable(global_dag.snapshot()))
         elif path == "/v1/models" and method == "GET":
             await self._send(writer, 200, self._models())
         elif path == "/v1/chat/completions":

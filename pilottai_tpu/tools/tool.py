@@ -21,6 +21,7 @@ from pilottai_tpu.tools.errors import (
     ToolTimeoutError,
     ToolValidationError,
 )
+from pilottai_tpu.obs.dag import global_dag
 from pilottai_tpu.utils.logging import get_logger
 from pilottai_tpu.utils.metrics import global_metrics
 
@@ -204,6 +205,13 @@ class Tool:
         self.metrics.total_time += elapsed
         self.metrics.last_used = time.time()
         global_metrics.observe(f"tool.{self.name}.latency", elapsed)
+        # Tool node in the ambient task's DAG (no-op outside one): tool
+        # time becomes a first-class breakdown component (task.tool_s)
+        # and a blame target on the critical path.
+        global_dag.record(
+            global_dag.current_task(), "tool", self.name,
+            start=start, end=time.perf_counter(), ok=success,
+        )
         if success:
             self.metrics.successes += 1
         else:

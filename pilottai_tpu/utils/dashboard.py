@@ -14,6 +14,9 @@ Stdlib-only (http.server on a daemon thread), three routes:
   one request); load it at https://ui.perfetto.dev.
 * ``/slo.json`` — per-class SLO attainment/burn-rate snapshot
   (``obs.global_slo``), same shape as the API server's route.
+* ``/dag.json`` — task-DAG attribution snapshot (``obs.global_dag``):
+  active tasks + recent finished breakdowns/critical paths;
+  ``?task_id=`` for one task's full node ledger (API server parity).
 * ``/`` — a self-refreshing HTML table over the same JSON.
 
 Read-only and unauthenticated by design: bind to localhost (the default)
@@ -29,6 +32,7 @@ from typing import Any, Optional
 from urllib.parse import parse_qs
 
 from pilottai_tpu.obs import (
+    global_dag,
     global_slo,
     global_steps,
     metrics_snapshot,
@@ -123,6 +127,17 @@ class MetricsDashboard:
                     body = json.dumps(
                         global_slo.snapshot(), default=str
                     ).encode()
+                    ctype = "application/json"
+                elif path == "/dag.json":
+                    task_id = (params.get("task_id") or [None])[0]
+                    payload = (
+                        global_dag.describe(task_id)
+                        if task_id else global_dag.snapshot()
+                    )
+                    if payload is None:  # APIServer parity: unknown=404
+                        self.send_error(404)
+                        return
+                    body = json.dumps(payload, default=str).encode()
                     ctype = "application/json"
                 elif path == "/trace.json":
                     trace_id = (params.get("trace_id") or [None])[0]

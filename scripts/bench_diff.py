@@ -1,0 +1,298 @@
+#!/usr/bin/env python3
+"""Compare two bench rounds and flag per-section regressions.
+
+The BENCH_r*.json trajectory is the repo's perf ledger, but "did round
+N regress round N-1?" has so far been a by-hand diff over a growing
+JSON. This tool makes it mechanical:
+
+    python scripts/bench_diff.py BENCH_r04.json BENCH_r05.json
+    python scripts/bench_diff.py --repo-latest          # two newest in repo
+    python scripts/bench_diff.py A.json B.json --threshold 0.15 \
+        --fail-on-regression                            # CI gate mode
+
+It walks the top level, every ``models.<section>`` block and every
+``SLO.classes.<class>`` block, compares numeric metrics whose direction
+it knows (steps/s, MFU, attainment, busy_frac up = good; p50/p99,
+host_gap, burn_rate, overhead fractions down = good), and prints a
+readable table with deltas, flagging moves beyond ``--threshold``
+(default 10%). ``x/y`` success strings compare as ratios. Keys with no
+known direction (config echoes, counts) are skipped.
+
+Exit status: 0 unless ``--fail-on-regression`` is set AND at least one
+regression beyond threshold was found. The CI job runs report-only —
+committed rounds may trade one metric for another deliberately; the
+table in the log is the review artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+# Substring → direction. First match wins; order matters (e.g.
+# "overhead_frac" must match before the generic "frac").
+HIGHER_BETTER = (
+    "steps_per_sec", "tokens_per_sec", "mfu", "attainment", "busy_frac",
+    "chunk_utilization", "vs_baseline", "success", "hit_rate",
+    "critical_path_frac", "completed",
+)
+LOWER_BETTER = (
+    "overhead_frac", "straggler_frac", "p50", "p90", "p99", "host_gap",
+    "burn_rate", "_ms", "latency", "shed", "errors", "missed", "drain_s",
+)
+
+
+def _direction(key: str) -> Optional[int]:
+    """+1 = higher is better, -1 = lower is better, None = don't judge."""
+    for sub in LOWER_BETTER:
+        if sub in key:
+            return -1
+    for sub in HIGHER_BETTER:
+        if sub in key:
+            return +1
+    return None
+
+
+def _numeric(value: Any) -> Optional[float]:
+    if isinstance(value, bool):
+        return None
+    if isinstance(value, (int, float)):
+        return float(value)
+    if isinstance(value, str):
+        m = re.fullmatch(r"(\d+)\s*/\s*(\d+)", value.strip())
+        if m and int(m.group(2)):
+            return int(m.group(1)) / int(m.group(2))
+    return None
+
+
+def _balanced(text: str, start: int) -> Optional[str]:
+    """The balanced ``{...}`` substring beginning at ``start`` (which
+    must index a ``{``), string-literal aware; None when unterminated."""
+    depth = 0
+    in_str = False
+    escape = False
+    for i in range(start, len(text)):
+        ch = text[i]
+        if in_str:
+            if escape:
+                escape = False
+            elif ch == "\\":
+                escape = True
+            elif ch == '"':
+                in_str = False
+            continue
+        if ch == '"':
+            in_str = True
+        elif ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                return text[start:i + 1]
+    return None
+
+
+_SCALAR_PAIR = re.compile(
+    r'"([A-Za-z0-9_.@-]+)"\s*:\s*'
+    r'(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?|true|false|null|"[^"]*")'
+)
+
+
+def _from_tail(tail: str) -> Dict[str, Any]:
+    """Recover a comparable document from a driver tail capture (the
+    LAST ~2000 bytes of bench output — valid JSON only from some offset
+    onward). Named blocks (``models``, ``SLO``) are extracted via
+    balanced-brace matching and parsed properly; whatever scalar pairs
+    remain outside them are treated as top-level metrics. Lossy by
+    nature — metrics truncated off the head are simply absent, and the
+    diff only compares keys present in BOTH rounds."""
+    doc: Dict[str, Any] = {}
+    remainder = tail
+    for block in ("models", "SLO", "phases"):
+        marker = f'"{block}": '
+        at = remainder.find(marker)
+        if at < 0:
+            continue
+        brace = remainder.find("{", at + len(marker) - 1)
+        if brace < 0:
+            continue
+        body = _balanced(remainder, brace)
+        if body is None:
+            continue
+        try:
+            doc[block] = json.loads(body)
+        except json.JSONDecodeError:
+            continue
+        remainder = remainder[:at] + remainder[brace + len(body):]
+    for key, raw in _SCALAR_PAIR.findall(remainder):
+        try:
+            doc.setdefault(key, json.loads(raw))
+        except json.JSONDecodeError:
+            pass
+    doc.pop("phases", None)  # percentile sub-dicts, not section metrics
+    return doc
+
+
+def _unwrap(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """The committed BENCH_r*.json files are driver capture records: the
+    bench's own JSON lives under ``parsed`` when the driver parsed it,
+    else only the trailing bytes survive under ``tail``. Accept the raw
+    bench shape, the parsed wrapper, and the tail capture."""
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and (
+        "metric" in parsed or "models" in parsed
+    ):
+        return parsed
+    tail = doc.get("tail")
+    if isinstance(tail, str) and ("models" in tail or "metric" in tail):
+        return _from_tail(tail)
+    return doc
+
+
+def _sections(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """section name → flat {metric: value}."""
+    doc = _unwrap(doc)
+    out: Dict[str, Dict[str, Any]] = {"top": {}}
+    for key, value in doc.items():
+        if key in ("models", "SLO", "phases"):
+            continue
+        num = _numeric(value)
+        if num is not None:
+            out["top"][key] = num
+    for name, block in (doc.get("models") or {}).items():
+        if isinstance(block, dict):
+            out[f"models.{name}"] = {
+                k: n for k, v in block.items()
+                if (n := _numeric(v)) is not None
+            }
+    slo = doc.get("SLO") or {}
+    for cls, block in (slo.get("classes") or {}).items():
+        if isinstance(block, dict):
+            out[f"slo.{cls}"] = {
+                k: n for k, v in block.items()
+                if (n := _numeric(v)) is not None
+            }
+    return out
+
+
+def diff(
+    old: Dict[str, Any], new: Dict[str, Any], threshold: float
+) -> Tuple[List[Tuple[str, str, float, float, float, str]], int]:
+    """Rows of (section, metric, old, new, rel_delta, flag); returns
+    (rows, n_regressions). Only metrics present in BOTH rounds with a
+    known direction are compared."""
+    rows: List[Tuple[str, str, float, float, float, str]] = []
+    regressions = 0
+    old_secs, new_secs = _sections(old), _sections(new)
+    for sec in sorted(set(old_secs) & set(new_secs)):
+        o_blk, n_blk = old_secs[sec], new_secs[sec]
+        for key in sorted(set(o_blk) & set(n_blk)):
+            direction = _direction(key)
+            if direction is None:
+                continue
+            o, n = o_blk[key], n_blk[key]
+            if o == 0 and n == 0:
+                continue
+            rel = (n - o) / abs(o) if o else float("inf")
+            flag = ""
+            if abs(rel) >= threshold:
+                improved = (rel > 0) == (direction > 0)
+                flag = "improved" if improved else "REGRESSED"
+                if not improved:
+                    regressions += 1
+            rows.append((sec, key, o, n, rel, flag))
+    return rows, regressions
+
+
+def _fmt(value: float) -> str:
+    if abs(value) >= 1000:
+        return f"{value:.0f}"
+    return f"{value:.4g}"
+
+
+def render(
+    rows: List[Tuple[str, str, float, float, float, str]],
+    only_flagged: bool,
+) -> str:
+    shown = [r for r in rows if r[5]] if only_flagged else rows
+    if not shown:
+        return "no comparable metrics moved beyond threshold\n"
+    headers = ("section", "metric", "old", "new", "delta", "")
+    table = [
+        (sec, key, _fmt(o), _fmt(n), f"{rel:+.1%}", flag)
+        for sec, key, o, n, rel, flag in shown
+    ]
+    widths = [
+        max(len(headers[i]), *(len(row[i]) for row in table))
+        for i in range(6)
+    ]
+    lines = [
+        "  ".join(h.ljust(widths[i]) for i, h in enumerate(headers)).rstrip()
+    ]
+    lines.append("  ".join("-" * w for w in widths))
+    for row in table:
+        lines.append(
+            "  ".join(c.ljust(widths[i]) for i, c in enumerate(row)).rstrip()
+        )
+    return "\n".join(lines) + "\n"
+
+
+def repo_latest_pair(root: Path) -> Tuple[Path, Path]:
+    rounds = sorted(
+        root.glob("BENCH_r*.json"),
+        key=lambda p: int(re.search(r"r(\d+)", p.stem).group(1)),
+    )
+    if len(rounds) < 2:
+        raise SystemExit(
+            f"--repo-latest needs >= 2 BENCH_r*.json under {root} "
+            f"(found {len(rounds)})"
+        )
+    return rounds[-2], rounds[-1]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("old", nargs="?", help="earlier round JSON")
+    parser.add_argument("new", nargs="?", help="later round JSON")
+    parser.add_argument(
+        "--repo-latest", action="store_true",
+        help="diff the two newest committed BENCH_r*.json in the repo root",
+    )
+    parser.add_argument(
+        "--threshold", type=float, default=0.10,
+        help="relative move that counts as a flagged change (default 0.10)",
+    )
+    parser.add_argument(
+        "--all", action="store_true",
+        help="print every compared metric, not just flagged moves",
+    )
+    parser.add_argument(
+        "--fail-on-regression", action="store_true",
+        help="exit 1 when any metric regressed beyond threshold",
+    )
+    args = parser.parse_args(argv)
+
+    if args.repo_latest:
+        old_path, new_path = repo_latest_pair(Path(__file__).parent.parent)
+    elif args.old and args.new:
+        old_path, new_path = Path(args.old), Path(args.new)
+    else:
+        parser.error("give OLD.json NEW.json, or --repo-latest")
+    old = json.loads(old_path.read_text())
+    new = json.loads(new_path.read_text())
+    rows, regressions = diff(old, new, args.threshold)
+    print(f"bench diff: {old_path.name} -> {new_path.name} "
+          f"(threshold {args.threshold:.0%})")
+    print(render(rows, only_flagged=not args.all), end="")
+    print(f"{regressions} regression(s) beyond threshold")
+    if regressions and args.fail_on_regression:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

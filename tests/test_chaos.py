@@ -612,6 +612,90 @@ async def test_heartbeat_stall_injection_degrades_health():
 
 
 @pytest.mark.asyncio
+async def test_heartbeat_stall_attributed_as_dag_retry_node():
+    """An injected ``agent.heartbeat.stall`` that triggers recovery must
+    surface in the affected task's DAG as a ``retry`` node carrying the
+    observed stall seconds — chaos-induced dead time is attributed, not
+    silently swallowed (obs/dag.py)."""
+    from pilottai_tpu.core.task import Task
+    from pilottai_tpu.obs import global_dag
+    from pilottai_tpu.orchestration.fault_tolerance import FaultTolerance
+    from pilottai_tpu.serve import Serve
+
+    agent = _worker()
+    await agent.start()
+    serve = Serve(name="chaos-dag", agents=[agent])
+    ft = FaultTolerance(serve, FaultToleranceConfig(
+        heartbeat_timeout=60.0, max_recovery_attempts=1,
+        recovery_cooldown=0.0,
+    ))
+    ft.register_agent(agent)
+    task = Task(description="work interrupted by a stalled heartbeat")
+    global_dag.start(task.id, trace_id="chaos-dag-stall-1")
+    await agent.add_task(task)
+    global_injector.arm("agent.heartbeat.stall", value=120.0, times=1)
+    await ft.check_once()  # UNHEALTHY -> in-place recovery path
+    try:
+        d = global_dag.describe(task.id)
+        assert d is not None
+        retries = [
+            n for n in d["nodes"]
+            if n["kind"] == "retry" and n["name"] == "agent_recovery"
+        ]
+        assert retries, [n["name"] for n in d["nodes"]]
+        # The injected 120 s stall (minus the loop's own wall) is
+        # attributed on the retry node.
+        assert retries[0]["attributes"]["stall_s"] >= 60.0
+        assert retries[0]["attributes"]["agent_id"] == agent.id[:8]
+    finally:
+        global_dag.finish(task.id, "cancelled")
+        await agent.stop()
+
+
+@pytest.mark.asyncio
+async def test_fault_requeue_adapts_to_orchestrator_signature():
+    """The requeue kwargs are filtered per-parameter against the
+    orchestrator's signature: a `reason`-only orchestrator must not be
+    handed stall_s (TypeError → task lost), a **kwargs one gets the
+    full attribution, and a bare legacy one gets the task alone."""
+    from pilottai_tpu.core.task import Task
+    from pilottai_tpu.orchestration.fault_tolerance import FaultTolerance
+
+    task = Task(description="requeue me")
+    calls = []
+
+    class ReasonOnly:
+        def agent_list(self):
+            return []
+
+        async def requeue_task(self, task, reason=""):
+            calls.append(("reason_only", reason))
+
+    class FullKwargs:
+        def agent_list(self):
+            return []
+
+        async def requeue_task(self, task, reason="", **attrs):
+            calls.append(("full", reason, attrs))
+
+    class Legacy:
+        def agent_list(self):
+            return []
+
+        async def requeue_task(self, task):
+            calls.append(("legacy",))
+
+    for orch in (ReasonOnly(), FullKwargs(), Legacy()):
+        ft = FaultTolerance(orch, FaultToleranceConfig())
+        await ft._requeue(task, stall_s=12.0)
+    assert calls == [
+        ("reason_only", "fault_recovery"),
+        ("full", "fault_recovery", {"stall_s": 12.0}),
+        ("legacy",),
+    ]
+
+
+@pytest.mark.asyncio
 async def test_health_gauge_keyed_by_full_id_and_reaped():
     from pilottai_tpu.orchestration.fault_tolerance import FaultTolerance
     from pilottai_tpu.serve import Serve

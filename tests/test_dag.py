@@ -1,0 +1,585 @@
+"""Task-DAG tracing (obs/dag.py): critical-path attribution, breakdown
+reconciliation, trace continuity across retries, per-agent occupancy
+gauges, /dag.json on both HTTP surfaces, and Perfetto critical-path
+flagging — the orchestration layer's observability story."""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from pilottai_tpu.core.agent import BaseAgent
+from pilottai_tpu.core.config import AgentConfig, LLMConfig, ServeConfig
+from pilottai_tpu.core.task import Task
+from pilottai_tpu.engine.handler import LLMHandler
+from pilottai_tpu.engine.mock import MockBackend
+from pilottai_tpu.obs import export_completeness
+from pilottai_tpu.obs.dag import (
+    BREAKDOWN_COMPONENTS,
+    AgentOccupancy,
+    DagLedger,
+    global_dag,
+    global_occupancy,
+)
+from pilottai_tpu.serve import Serve
+from pilottai_tpu.utils.metrics import MetricsRegistry, global_metrics
+from pilottai_tpu.utils.tracing import Tracer, global_tracer
+
+
+def _mock_llm(**kwargs) -> LLMHandler:
+    return LLMHandler(LLMConfig(provider="mock"), backend=MockBackend(**kwargs))
+
+
+def _serve(llm, agents=None, **cfg) -> Serve:
+    cfg.setdefault("decomposition_enabled", False)
+    return Serve(
+        name="dag-test", manager_llm=llm,
+        agents=agents or [BaseAgent(
+            config=AgentConfig(role="worker", specializations=["generic"]),
+            llm=llm,
+        )],
+        config=ServeConfig(**cfg),
+    )
+
+
+def _components_sum(breakdown) -> float:
+    return sum(
+        breakdown[c] for c in BREAKDOWN_COMPONENTS if c != "straggler_s"
+    )
+
+
+# ---------------------------------------------------------------------- #
+# Ledger arithmetic on synthetic DAGs (no engine, no clocks to race)
+# ---------------------------------------------------------------------- #
+
+
+def test_critical_path_follows_dependency_edges():
+    """Three subtask branches a, b, c with c depending on a: the chain
+    must walk c -> a (its dep), NOT c -> b (the overlapping sibling),
+    and the scheduling gap between a and c lands in overhead."""
+    ledger = DagLedger(registry=MetricsRegistry(), tracer=Tracer())
+    dag = ledger.start("t1", trace_id="tr1")
+    t0 = dag.created
+    a = dag.add_node("subtask", "a", t0 + 0.0, end=t0 + 1.0)
+    dag.add_node("subtask", "b", t0 + 0.0, end=t0 + 1.8)
+    c = dag.add_node(
+        "subtask", "c", t0 + 2.0, end=t0 + 3.0, deps=[a.node_id]
+    )
+    dag.ended = t0 + 3.0
+    dag.compute()
+    critical_ids = [
+        s["node_id"] for s in dag.critical_spans if s["kind"] == "subtask"
+    ]
+    assert critical_ids == [a.node_id, c.node_id]
+    # Gap a-end(1.0) -> c-start(2.0) is orchestrator overhead.
+    overhead = sum(
+        s["duration_s"] for s in dag.critical_spans
+        if s["kind"] == "overhead"
+    )
+    assert overhead == pytest.approx(1.0, abs=1e-6)
+    # b (1.8) vs siblings: straggler = max - median of [1.0, 1.8, 1.0].
+    assert dag.breakdown["straggler_s"] == pytest.approx(0.8, abs=1e-6)
+    # Critical path covers e2e exactly on a closed ledger.
+    assert dag.breakdown["critical_path_s"] == pytest.approx(
+        3.0, abs=1e-6
+    )
+
+
+def test_flight_split_and_breakdown_components_sum():
+    """A flight's critical time splits into queue/prefill/decode by its
+    own phase shares, and the non-straggler components sum to the
+    critical path (which equals e2e on a closed ledger)."""
+    ledger = DagLedger(registry=MetricsRegistry(), tracer=Tracer())
+    dag = ledger.start("t2", trace_id="tr2")
+    t0 = dag.created
+    agent = dag.add_node("agent", "worker", t0 + 0.1, end=t0 + 2.1)
+    dag.add_node(
+        "flight", "m", t0 + 0.3, end=t0 + 1.3,
+        parent_id=agent.node_id,
+        queue_wait_s=0.2, prefill_s=0.3, decode_s=0.5,
+    )
+    dag.add_node(
+        "tool", "search", t0 + 1.5, end=t0 + 2.0,
+        parent_id=agent.node_id,
+    )
+    dag.ended = t0 + 2.2
+    dag.compute()
+    bd = dag.breakdown
+    assert bd["queue_wait_s"] == pytest.approx(0.2, abs=1e-6)
+    assert bd["llm_prefill_s"] == pytest.approx(0.3, abs=1e-6)
+    assert bd["llm_decode_s"] == pytest.approx(0.5, abs=1e-6)
+    assert bd["tool_s"] == pytest.approx(0.5, abs=1e-6)
+    assert _components_sum(bd) == pytest.approx(
+        bd["critical_path_s"], abs=1e-5
+    )
+    assert bd["critical_path_s"] == pytest.approx(bd["e2e_s"], abs=1e-5)
+
+
+def test_subtask_rollup_merges_child_breakdown():
+    """A finished subtask rolls up into its parent's dag as a node whose
+    breakdown attribute redistributes the child's span on the parent's
+    critical path (LLM time stays LLM time through the rollup)."""
+    registry = MetricsRegistry()
+    ledger = DagLedger(registry=registry, tracer=Tracer())
+    parent_dag = ledger.start("parent", trace_id="tr3")
+    child_dag = ledger.start(
+        "child", trace_id="tr3", parent_task_id="parent"
+    )
+    t0 = child_dag.created
+    child_dag.add_node(
+        "flight", "m", t0, end=t0 + 1.0,
+        queue_wait_s=0.0, prefill_s=0.5, decode_s=0.5,
+    )
+    child_dag.ended = t0 + 1.0  # synthetic clock: pre-stamp both ends
+    summary = ledger.finish("child", "ok")
+    assert summary["breakdown"]["llm_prefill_s"] == pytest.approx(
+        0.5, abs=1e-5
+    )
+    parent_dag.ended = t0 + 1.05
+    parent_summary = ledger.finish("parent", "ok")
+    # The child covered ~all of the parent's life, so the parent's
+    # breakdown is dominated by the child's LLM components.
+    bd = parent_summary["breakdown"]
+    assert bd["llm_prefill_s"] > 0.3
+    assert bd["llm_decode_s"] > 0.3
+    # task.* histograms observed twice (child + parent).
+    hists = registry.snapshot()["histograms"]
+    assert hists["task.e2e_s"]["count"] == 2
+
+
+def test_dag_node_cap_counts_overflow():
+    """A runaway task must not grow its ledger unboundedly: past
+    MAX_NODES, nodes are dropped and counted, not silently kept."""
+    ledger = DagLedger(registry=MetricsRegistry(), tracer=Tracer())
+    dag = ledger.start("cap", trace_id="cap")
+    t0 = dag.created
+    for i in range(dag.MAX_NODES + 5):
+        dag.add_node("tool", f"n{i}", t0, end=t0 + 0.001)
+    assert len(dag.nodes) == dag.MAX_NODES
+    assert dag.dropped_nodes == 5
+    dag.ended = t0 + 0.01
+    summary = ledger.finish("cap", "ok")
+    assert summary["dropped_nodes"] == 5
+
+
+# ---------------------------------------------------------------------- #
+# Serve integration (mock engine)
+# ---------------------------------------------------------------------- #
+
+
+@pytest.mark.asyncio
+async def test_serve_task_dag_reconciles_and_nests():
+    llm = _mock_llm()
+    serve = _serve(llm)
+    await serve.start()
+    try:
+        task = serve.prepare_task("count the widgets")
+        t0 = time.perf_counter()
+        result = await serve.execute_task(task)
+        wall = time.perf_counter() - t0
+        assert result.success
+        d = global_dag.describe(task.id)
+        assert d is not None and d["status"] == "ok"
+        bd = d["breakdown"]
+        # Reconciliation: critical-path sum ~= ledger e2e (15% bar) and
+        # ledger e2e ~= the caller-observed wall.
+        assert bd["critical_path_s"] == pytest.approx(
+            bd["e2e_s"], rel=0.15
+        )
+        assert bd["e2e_s"] <= wall * 1.15
+        # Components sum to >= 90% of e2e.
+        assert _components_sum(bd) >= 0.9 * bd["e2e_s"]
+        kinds = {(n["kind"], n["name"]) for n in d["nodes"]}
+        assert ("stage", "analyze") in kinds
+        assert ("stage", "route") in kinds
+        assert ("queue", "task_queue") in kinds
+        assert ("agent", "worker") in kinds
+        # Engine flights joined and nested under the agent node.
+        agent_ids = {
+            n["node_id"] for n in d["nodes"] if n["kind"] == "agent"
+        }
+        flights = [n for n in d["nodes"] if n["kind"] == "flight"]
+        assert flights and any(
+            f["parent_id"] in agent_ids for f in flights
+        )
+        # Queue wait observed, by priority too.
+        hists = global_metrics.snapshot()["histograms"]
+        assert hists["task.queue_wait.normal_s"]["count"] >= 1
+    finally:
+        await serve.stop()
+
+
+def _force_decomposition(prompt):
+    if '"requires_decomposition"' in prompt:
+        return {"requires_decomposition": True, "complexity": 7,
+                "estimated_resources": {}}
+    return None  # protocol defaults (3 subtasks with dependencies)
+
+
+@pytest.mark.asyncio
+async def test_fanout_dag_rollup_one_trace():
+    llm = _mock_llm(responders=[_force_decomposition])
+    serve = _serve(llm, decomposition_enabled=True)
+    await serve.start()
+    try:
+        task = serve.prepare_task("produce the annual report")
+        result = await serve.execute_task(task, timeout=60)
+        assert result.success
+        d = global_dag.describe(task.id)
+        subtasks = [n for n in d["nodes"] if n["kind"] == "subtask"]
+        assert len(subtasks) >= 3
+        # Dependency edges resolved between sibling subtask nodes.
+        assert any(n["deps"] for n in subtasks)
+        # One task tree = one trace: every subtask dag carries the
+        # parent's trace id.
+        sub_ids = result.metadata["subtask_ids"]
+        for sid in sub_ids:
+            sub = global_dag.describe(sid)
+            assert sub is not None and sub["trace_id"] == d["trace_id"]
+        bd = d["breakdown"]
+        assert bd["critical_path_s"] == pytest.approx(
+            bd["e2e_s"], rel=0.15
+        )
+        assert _components_sum(bd) >= 0.9 * bd["e2e_s"]
+        # Fan-out ran: LLM time reached the parent through the rollup.
+        assert bd["llm_decode_s"] + bd["llm_prefill_s"] > 0
+    finally:
+        await serve.stop()
+
+
+def _fail_first_evaluation():
+    """Responder: the FIRST agent result_evaluation fails the task, so
+    the orchestrator's retry path runs exactly once."""
+    state = {"failed": False}
+
+    def responder(prompt):
+        if '"success"' in prompt and "issues" in prompt:
+            if not state["failed"]:
+                state["failed"] = True
+                return {"success": False, "issues": ["forced failure"]}
+            return {"success": True, "issues": []}
+        return None
+
+    return responder
+
+
+@pytest.mark.asyncio
+async def test_retry_attempts_stay_in_one_trace():
+    """Regression (trace continuity): a retry attempt must be a child
+    span of the original task trace with its attempt index — not a
+    fresh ambient trace."""
+    llm = _mock_llm(responders=[_fail_first_evaluation()])
+    serve = _serve(llm)
+    await serve.start()
+    try:
+        task = serve.prepare_task("flaky work")
+        result = await serve.execute_task(task, timeout=30)
+        assert result.success
+        trace_id = task.metadata["trace_id"]
+        spans = global_tracer.for_trace(trace_id)
+        names = [s.name for s in spans]
+        assert "serve.execute_task" in names
+        retry_spans = [s for s in spans if s.name.startswith("retry.")]
+        assert retry_spans, names
+        assert retry_spans[0].attributes.get("attempt") == 1
+        # BOTH agent executions (original + retry) are in this trace.
+        agent_spans = [s for s in spans if s.name == "agent.execute_task"]
+        assert len(agent_spans) >= 2
+        assert {s.trace_id for s in agent_spans} == {trace_id}
+        # The dag recorded the retry node with the attempt index.
+        d = global_dag.describe(task.id)
+        retries = [n for n in d["nodes"] if n["kind"] == "retry"]
+        assert retries and retries[0]["attributes"]["attempt"] == 1
+        assert global_metrics.get("task.retries") >= 1
+    finally:
+        await serve.stop()
+
+
+@pytest.mark.asyncio
+async def test_requeue_keeps_trace_and_records_retry_node():
+    llm = _mock_llm()
+    serve = _serve(llm)
+    await serve.start()
+    try:
+        task = serve.prepare_task("requeued work")
+        await serve.add_task(task)
+        trace_id = task.metadata["trace_id"]
+        await serve.requeue_task(task, reason="rebalance", stall_s=1.5)
+        assert task.metadata["trace_id"] == trace_id  # trace survives
+        result = await serve.wait_for(task.id, timeout=30)
+        assert result.success
+        d = global_dag.describe(task.id)
+        requeues = [
+            n for n in d["nodes"]
+            if n["kind"] == "retry" and n["name"] == "rebalance"
+        ]
+        assert requeues
+        assert requeues[0]["attributes"]["stall_s"] == 1.5
+        assert d["trace_id"] == trace_id
+    finally:
+        await serve.stop()
+
+
+# ---------------------------------------------------------------------- #
+# Export surfaces
+# ---------------------------------------------------------------------- #
+
+
+@pytest.mark.asyncio
+async def test_dag_json_on_api_server_and_dashboard():
+    from pilottai_tpu.server import APIServer
+    from pilottai_tpu.utils.dashboard import MetricsDashboard
+    from tests.test_server import _request
+
+    llm = _mock_llm()
+    serve = _serve(llm)
+    await serve.start()
+    server = await APIServer(llm, serve=serve).start()
+    dash = MetricsDashboard().start()
+    try:
+        task = serve.prepare_task("export me")
+        result = await serve.execute_task(task)
+        assert result.success
+        status, _, body = await _request(server.port, "GET", "/dag.json")
+        assert status == 200
+        snap = json.loads(body)
+        assert any(
+            f["task_id"] == task.id for f in snap["finished"]
+        )
+        status, _, body = await _request(
+            server.port, "GET", f"/dag.json?task_id={task.id}"
+        )
+        assert status == 200
+        described = json.loads(body)
+        assert described["status"] == "ok" and described["nodes"]
+        status, _, _ = await _request(
+            server.port, "GET", "/dag.json?task_id=nope"
+        )
+        assert status == 404
+
+        # Dashboard parity (threaded http.server).
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{dash.port}/dag.json", timeout=10
+        ) as resp:
+            dsnap = json.loads(resp.read())
+        assert any(
+            f["task_id"] == task.id for f in dsnap["finished"]
+        )
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{dash.port}/dag.json?task_id={task.id}",
+            timeout=10,
+        ) as resp:
+            assert json.loads(resp.read())["task_id"] == task.id
+        # Unknown task: 404 on the dashboard too (APIServer parity).
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{dash.port}/dag.json?task_id=nope",
+                timeout=10,
+            )
+        assert err.value.code == 404
+    finally:
+        dash.stop()
+        await server.stop()
+        await serve.stop()
+
+
+@pytest.mark.asyncio
+async def test_perfetto_critical_path_spans_flagged():
+    from pilottai_tpu.obs import perfetto_trace
+
+    llm = _mock_llm()
+    serve = _serve(llm)
+    await serve.start()
+    try:
+        task = serve.prepare_task("flag my critical path")
+        result = await serve.execute_task(task)
+        assert result.success
+        trace_id = task.metadata["trace_id"]
+        spans = global_tracer.for_trace(trace_id)
+        critical = [
+            s for s in spans if s.attributes.get("critical_path")
+        ]
+        assert critical  # dag.finish emitted the flagged lane
+        assert all(s.name.startswith("dag.critical.") for s in critical)
+        trace = perfetto_trace(spans)
+        flagged = [
+            e for e in trace["traceEvents"]
+            if e.get("args", {}).get("critical_path")
+        ]
+        assert flagged
+        # Stage spans + agent + engine spans share the one track.
+        names = {
+            e["name"] for e in trace["traceEvents"] if e.get("ph") == "X"
+        }
+        assert "serve.execute_task" in names
+        assert "stage.route" in names
+        assert "agent.execute_task" in names
+    finally:
+        await serve.stop()
+
+
+@pytest.mark.asyncio
+async def test_export_completeness_covers_task_and_agent_series():
+    llm = _mock_llm()
+    serve = _serve(llm)
+    await serve.start()
+    try:
+        result = await serve.execute_task("wire check")
+        assert result.success
+    finally:
+        await serve.stop()
+    declared = global_metrics.declared()
+    for series in (
+        "task.e2e_s", "task.critical_path_s",
+        "task.orchestrator_overhead_s", "task.queue_wait_s",
+        "task.llm_prefill_s", "task.llm_decode_s", "task.tool_s",
+        "task.straggler_s", "task.queue_wait.normal_s",
+        "task.completed", "task.retries", "task.active",
+        "agent.worker.busy_frac", "agent.worker.queue_depth",
+    ):
+        assert series in declared, series
+    problems = export_completeness()
+    assert problems == [], problems
+
+
+# ---------------------------------------------------------------------- #
+# Agent occupancy
+# ---------------------------------------------------------------------- #
+
+
+def test_occupancy_busy_frac_window_arithmetic():
+    registry = MetricsRegistry()
+    occ = AgentOccupancy(registry=registry, window_s=10.0)
+    occ.register("writer", "a1")
+    occ.register("writer", "a2")
+    now = time.perf_counter()
+    # Fake two closed busy intervals by poking the tracked structures
+    # through the public step API (keys distinguish agents).
+    occ._since["writer"] = now - 10.0
+    occ._busy["writer"].append((now - 8.0, now - 3.0))   # 5 s agent 1
+    occ._busy["writer"].append((now - 6.0, now - 1.0))   # 5 s agent 2
+    fracs = occ.refresh()
+    # 10 busy-seconds over a 10 s window x 2 agents = 0.5.
+    assert fracs["writer"] == pytest.approx(0.5, abs=0.05)
+    assert registry.snapshot()["gauges"][
+        "agent.writer.busy_frac"
+    ] == pytest.approx(0.5, abs=0.05)
+    occ.set_queue_depth("writer", 3)
+    assert registry.snapshot()["gauges"]["agent.writer.queue_depth"] == 3.0
+
+
+@pytest.mark.asyncio
+async def test_agent_execution_drives_busy_frac_gauge():
+    llm = _mock_llm(latency=0.05)
+    agent = BaseAgent(
+        config=AgentConfig(role="busyrole", specializations=["generic"]),
+        llm=llm,
+    )
+    serve = _serve(llm, agents=[agent])
+    await serve.start()
+    try:
+        result = await serve.execute_task("keep the agent busy")
+        assert result.success
+        fracs = global_occupancy.refresh()
+        assert fracs.get("busyrole", 0.0) > 0.0
+    finally:
+        await serve.stop()
+    # stop() retired the role: the gauge zeroes and the role leaves the
+    # tracker (a stale role would bias every mean-over-roles consumer).
+    assert "busyrole" not in global_occupancy.refresh()
+    gauges = global_metrics.snapshot()["gauges"]
+    assert gauges["agent.busyrole.busy_frac"] == 0.0
+
+
+# ---------------------------------------------------------------------- #
+# Native CPU engine: acceptance reconciliation + one-trace nesting
+# ---------------------------------------------------------------------- #
+
+
+@pytest.mark.slow  # CI main lane; real-engine boot is a soak, like
+@pytest.mark.asyncio  # PR 6's live-vs-profiled MFU reconciliation.
+async def test_cpu_engine_fanout_one_trace_and_reconciliation():
+    """The acceptance scenario: a Serve fan-out task whose agents run on
+    the REAL CPU engine produces ONE Perfetto trace nesting server ->
+    orchestrator stages -> agent steps -> engine flights with critical
+    spans flagged, and the ledger reconciles (critical path ~= e2e
+    within 15%, components >= 90% of e2e). The mock-engine variants
+    above keep the same reconciliation bars in the tier-1 lane."""
+    from pilottai_tpu.server import APIServer
+    from tests.test_server import _request
+
+    engine = LLMHandler(LLMConfig(
+        model_name="llama-tiny", provider="cpu",
+        engine_slots=4, engine_max_seq=128, engine_chunk=4,
+    ))
+    # Manager decisions are mock-driven (deterministic fan-out into 3
+    # dependent subtasks); agent reasoning steps run on the CPU engine.
+    manager = _mock_llm(responders=[_force_decomposition])
+    serve = Serve(
+        name="dag-cpu", manager_llm=manager,
+        agents=[BaseAgent(
+            config=AgentConfig(
+                role="cpuworker", specializations=["generic"],
+                max_iterations=2,
+            ),
+            llm=engine,
+        )],
+        config=ServeConfig(decomposition_enabled=True,
+                           max_concurrent_tasks=4),
+    )
+    await serve.start()
+    server = await APIServer(engine, serve=serve).start()
+    try:
+        status, headers, body = await _request(
+            server.port, "POST", "/v1/tasks",
+            {"task": "compile the quarterly report", "timeout": 120},
+            headers={"x-request-id": "dag-cpu-trace-1"},
+        )
+        assert status == 200, body
+        payload = json.loads(body)
+        assert payload["success"], payload
+        sub_ids = payload["metadata"]["subtask_ids"]
+        assert len(sub_ids) >= 3
+
+        spans = global_tracer.for_trace("dag-cpu-trace-1")
+        names = {s.name for s in spans}
+        # server -> orchestrator stages -> agent steps -> engine flights,
+        # all in the ONE trace the request carried in.
+        assert "server.request" in names
+        assert "stage.analyze" in names
+        assert "serve.execute_task" in names
+        assert "agent.execute_task" in names
+        assert "engine.generate" in names
+        assert "engine.batch_decode" in names  # native batcher span
+        assert any(
+            s.attributes.get("critical_path") for s in spans
+        )
+
+        # Ledger reconciliation on the parent AND every subtask.
+        task_id = next(
+            d["task_id"] for d in global_dag.finished()
+            if d.get("attributes", {}) is not None
+            and d["task_id"] not in sub_ids
+            and d["trace_id"] == "dag-cpu-trace-1"
+            and d["parent_task_id"] is None
+        )
+        for tid in [task_id] + list(sub_ids):
+            d = global_dag.describe(tid)
+            assert d is not None, tid
+            bd = d["breakdown"]
+            assert bd["critical_path_s"] == pytest.approx(
+                bd["e2e_s"], rel=0.15
+            ), (tid, bd)
+            assert _components_sum(bd) >= 0.9 * bd["e2e_s"], (tid, bd)
+        # Real engine time was attributed: decode shows up in a subtask.
+        sub_bd = global_dag.describe(sub_ids[0])["breakdown"]
+        assert sub_bd["llm_decode_s"] + sub_bd["llm_prefill_s"] > 0
+    finally:
+        await server.stop()
+        await serve.stop()
+        await engine.stop()

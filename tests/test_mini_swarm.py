@@ -53,6 +53,15 @@ def test_mini_swarm_success_rate_and_checkpoint_routing():
     from pilottai_tpu.serve import Serve
 
     async def main():
+        from pilottai_tpu.obs import global_occupancy
+        from pilottai_tpu.utils.metrics import global_metrics
+
+        # Section-pure task histograms (same discipline as PR 6's
+        # `request.` resets): earlier suites' task.* samples — and the
+        # occupancy windows their agents filled — must not land in this
+        # soak's window accounting.
+        global_metrics.reset_histograms("task.")
+        global_occupancy.reset()
         llm = _swarm_llm()
         agents = [
             BaseAgent(

@@ -20,6 +20,7 @@ async def _request(
     body: dict | None = None,
     token: str | None = None,
     raw_body: bytes | None = None,
+    headers: dict | None = None,
 ):
     """Minimal HTTP/1.1 client over asyncio streams. Returns
     (status, headers, body_bytes)."""
@@ -27,7 +28,10 @@ async def _request(
     payload = raw_body if raw_body is not None else (
         json.dumps(body).encode() if body is not None else b""
     )
+    extra = headers or {}
     headers = f"Content-Length: {len(payload)}\r\n"
+    for key, value in extra.items():
+        headers += f"{key}: {value}\r\n"
     if token:
         headers += f"Authorization: Bearer {token}\r\n"
     writer.write(

@@ -117,6 +117,166 @@ async def test_slow_subscriber_drops_oldest_not_blocks():
         serve.unsubscribe_events(task.id, q)
 
 
+def _first_occurrence_order(events, task_id):
+    seen = []
+    for e in events:
+        if e["task_id"] == task_id and e["event"] not in seen:
+            seen.append(e["event"])
+    return seen
+
+
+@pytest.mark.asyncio
+async def test_event_order_matches_dag_marks_for_fanout():
+    """The event stream and the DAG ledger stamp lifecycle transitions
+    with ONE clock (serve._emit_event feeds both), so for a fan-out
+    task the queued -> started -> completed ordering must agree between
+    the two surfaces — for the parent AND its subtasks."""
+    from pilottai_tpu.obs import global_dag
+
+    def force_decomposition(prompt):
+        if '"requires_decomposition"' in prompt:
+            return {"requires_decomposition": True, "complexity": 7,
+                    "estimated_resources": {}}
+        return None
+
+    llm = _mock_llm(responders=[force_decomposition])
+    serve = Serve(
+        name="dag-events", manager_llm=llm,
+        agents=[BaseAgent(
+            config=AgentConfig(role="worker", specializations=["generic"]),
+            llm=llm,
+        )],
+        config=ServeConfig(decomposition_enabled=True),
+    )
+    await serve.start()
+    try:
+        task = serve.prepare_task("produce the annual report")
+        q = serve.subscribe_events(task.id)
+        result = await serve.execute_task(task, timeout=60)
+        assert result.success
+        events = _drain(q)
+
+        # Parent: the ledger's marks dict is ordered by timestamp; its
+        # order over the parent's lifecycle events must equal the event
+        # stream's first-occurrence order.
+        d = global_dag.describe(task.id)
+        assert d is not None
+        event_order = [
+            e for e in _first_occurrence_order(events, task.id)
+            if e in d["marks"]
+        ]
+        mark_order = [k for k in d["marks"] if k in event_order]
+        assert event_order == mark_order
+        assert "decomposed" in d["marks"]
+
+        # Every subtask: queued <= assigned <= completed on the ledger
+        # clock, matching the stream's ordering guarantees.
+        sub_ids = {e["task_id"] for e in events if e["task_id"] != task.id}
+        assert len(sub_ids) >= 3
+        for sid in sub_ids:
+            sd = global_dag.describe(sid)
+            assert sd is not None, sid
+            marks = sd["marks"]
+            assert marks["queued"] <= marks["assigned"] <= marks["completed"]
+            sub_order = [
+                e for e in _first_occurrence_order(events, sid)
+                if e in marks
+            ]
+            assert sub_order == [k for k in marks if k in sub_order]
+    finally:
+        await serve.stop()
+        serve.unsubscribe_events(task.id, q)
+
+
+@pytest.mark.asyncio
+async def test_cancelled_eviction_closes_dag_with_event_parity():
+    """Queue eviction (the cancelled path): the evicted task's DAG must
+    finish with status 'cancelled' and its marks must cover the same
+    lifecycle the event stream reported."""
+    from pilottai_tpu.obs import global_dag
+
+    llm = _mock_llm()
+    serve = Serve(
+        name="evict-dag", manager_llm=llm,
+        agents=[BaseAgent(
+            config=AgentConfig(role="worker", specializations=["generic"]),
+            llm=llm,
+        )],
+        config=ServeConfig(decomposition_enabled=False, max_queue_size=1),
+    )
+    # Deliberately NOT started: the processor must not drain the queue
+    # before the higher-priority arrival evicts the low one.
+    low = serve.prepare_task(
+        {"description": "backlog filler", "priority": "low"}
+    )
+    q = serve.subscribe_events(low.id)
+    try:
+        from pilottai_tpu.utils.metrics import global_metrics
+
+        cancelled0 = global_metrics.get("task.cancelled")
+        failed0 = global_metrics.get("task.failed")
+        await serve.add_task(low)
+        await serve.add_task(
+            {"description": "urgent work", "priority": "critical"}
+        )
+        events = _drain(q)
+        kinds = [e["event"] for e in events]
+        assert "queued" in kinds and "failed" in kinds
+        d = global_dag.describe(low.id)
+        assert d is not None and d["status"] == "cancelled"
+        assert d["marks"]["queued"] <= d["marks"]["failed"]
+        # Eviction is routine cancellation, not a failure — it must land
+        # in task.cancelled, never inflate task.failed.
+        assert global_metrics.get("task.cancelled") == cancelled0 + 1
+        assert global_metrics.get("task.failed") == failed0
+    finally:
+        serve.unsubscribe_events(low.id, q)
+        # The un-started serve still holds the urgent task's dag open.
+        for t in serve.task_queue.snapshot():
+            global_dag.finish(t.id, "cancelled")
+
+
+@pytest.mark.asyncio
+async def test_expired_task_closes_dag_as_failed():
+    """The expired path: a task whose budget elapses mid-execution must
+    close its DAG as failed, with the failed mark after assigned."""
+    from pilottai_tpu.obs import global_dag
+
+    llm = _mock_llm(latency=0.3)  # each LLM step outlives the budget
+    serve = Serve(
+        name="expire-dag", manager_llm=llm,
+        agents=[BaseAgent(
+            config=AgentConfig(role="worker", specializations=["generic"]),
+            llm=llm,
+        )],
+        config=ServeConfig(decomposition_enabled=False),
+    )
+    await serve.start()
+    try:
+        # Budget on the TASK (not the caller wait): the orchestrator's
+        # wait_for kills execution at 0.5 s while the caller keeps a
+        # comfortable wait — no race between the two timers.
+        task = serve.prepare_task(
+            {"description": "doomed to expire", "timeout": 0.5}
+        )
+        q = serve.subscribe_events(task.id)
+        result = await serve.execute_task(task)
+        assert not result.success
+        events = _drain(q)
+        kinds = [e["event"] for e in events]
+        assert "assigned" in kinds and "failed" in kinds
+        d = global_dag.describe(task.id)
+        assert d is not None and d["status"] == "failed"
+        assert d["marks"]["assigned"] <= d["marks"]["failed"]
+        # The breakdown still reconciles on the failure path.
+        assert d["breakdown"]["critical_path_s"] == pytest.approx(
+            d["breakdown"]["e2e_s"], rel=0.15
+        )
+    finally:
+        await serve.stop()
+        serve.unsubscribe_events(task.id, q)
+
+
 @pytest.mark.asyncio
 async def test_server_task_stream_sse():
     from pilottai_tpu.server import APIServer
