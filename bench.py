@@ -490,6 +490,86 @@ async def bench_slo(cfg, rate_rps, duration_s=30.0, n_chips=1, seed=7,
     }
 
 
+async def bench_recovery(cfg, n_requests=6, max_new_tokens=48):
+    """RECOVERY section (ISSUE 9): scripted single-fault soak. A wave of
+    greedy requests decodes concurrently; one injected ``engine.step``
+    failure lands mid-decode (``skip=1`` lets the first dispatch through
+    so real tokens have folded); every in-flight request must complete
+    through the engine's in-flight recovery with output byte-identical
+    to an uninjected reference wave. Reports ``recovered_frac`` (1.0 =
+    every requeued request completed), ``recovery_ms`` p50/p99
+    (fault-snapshot → re-admission wall) and ``tokens_replayed`` (tokens
+    re-prefilled over prompt+generated)."""
+    from pilottai_tpu.engine.handler import LLMHandler
+    from pilottai_tpu.engine.types import GenerationParams
+    from pilottai_tpu.reliability import global_injector
+    from pilottai_tpu.utils.metrics import global_metrics as _gm
+
+    handler = LLMHandler(cfg)
+    await handler.start()
+    try:
+        prompts = [_prompt(9000 + i) for i in range(n_requests)]
+
+        async def wave():
+            return await asyncio.gather(*[
+                handler.apredict(
+                    p,
+                    params=GenerationParams(
+                        max_new_tokens=max_new_tokens, temperature=0.0,
+                    ),
+                )
+                for p in prompts
+            ], return_exceptions=True)
+
+        base = await wave()
+        counters = (
+            "engine.recovery_requeued", "engine.recovered_requests",
+            "engine.recovery_failed", "engine.tokens_replayed",
+            "engine.rebuilds",
+        )
+        before = {k: _gm.get(k) for k in counters}
+        _gm.reset_histograms("engine.recovery_ms")
+        global_injector.arm(
+            "engine.step", RuntimeError("bench-injected device fault"),
+            times=1, skip=1,
+        )
+        t0 = time.perf_counter()
+        injected = await wave()
+        wall = time.perf_counter() - t0
+        delta = {k: _gm.get(k) - before[k] for k in counters}
+        errors = sum(isinstance(o, Exception) for o in injected)
+        identical = sum(
+            1 for a, b in zip(base, injected)
+            if not isinstance(b, Exception) and a == b
+        )
+        hist = (_gm.snapshot()["histograms"].get("engine.recovery_ms")
+                or {})
+        requeued = delta["engine.recovery_requeued"]
+        return {
+            # 1.0 ⇔ every request the fault interrupted completed anyway.
+            "recovered_frac": (
+                round(delta["engine.recovered_requests"] / requeued, 4)
+                if requeued else (1.0 if errors == 0 else 0.0)
+            ),
+            "outputs_identical": identical == n_requests,
+            "client_errors": errors,
+            "requests": n_requests,
+            "requeued": int(requeued),
+            "recovery_failed": int(delta["engine.recovery_failed"]),
+            "recovery_ms_p50": hist.get("p50"),
+            "recovery_ms_p99": hist.get("p99"),
+            "tokens_replayed": int(delta["engine.tokens_replayed"]),
+            "rebuilds": int(delta["engine.rebuilds"]),
+            "fault_fired": global_injector.fired("engine.step") > 0,
+            "wall_s": round(wall, 2),
+            "model": cfg.model_name,
+        }
+    finally:
+        global_injector.disarm("engine.step")
+        await handler.stop()
+        gc.collect()
+
+
 async def bench_pipeline(provider: str, rounds: int = 4):
     """BASELINE config #3 through the orchestrator: Serve + manager + 3
     specialists on the document pipeline, real engine, measured at
@@ -870,6 +950,26 @@ async def run_bench():
         _note("slo FAILED", {"error": str(exc)})
         sec_slo = {"slo_error": str(exc)}
 
+    # Section 7: scripted single-fault recovery soak (ISSUE 9) — one
+    # injected mid-decode device failure against a concurrent greedy
+    # wave; the engine's in-flight recovery must complete every request
+    # byte-identically (recovered_frac == 1.0 is the acceptance bar).
+    sec_recovery = None
+    try:
+        sec_recovery = await bench_recovery(
+            LLMConfig(
+                model_name="llama3-1b-byte" if on_accel else "llama-tiny",
+                engine_slots=8, engine_chunk=16,
+                **common,
+            ),
+            n_requests=6 if on_accel else 4,
+            max_new_tokens=48,
+        )
+        _note("recovery", sec_recovery)
+    except Exception as exc:  # noqa: BLE001 — keep earlier sections
+        _note("recovery FAILED", {"error": str(exc)})
+        sec_recovery = {"recovery_error": str(exc)}
+
     headline = sec_8b or sec_1b
     out = {
         "metric": "agent_steps_per_sec_per_chip",
@@ -911,6 +1011,12 @@ async def run_bench():
             .get("attainment") if sec_slo else None
         ),
         "SLO": sec_slo,
+        # Fault-domain headline (ISSUE 9): fraction of fault-interrupted
+        # requests that completed anyway (full breakdown under RECOVERY).
+        "recovered_frac": (
+            sec_recovery.get("recovered_frac") if sec_recovery else None
+        ),
+        "RECOVERY": sec_recovery,
         **sec_pipeline,
         **(sec_swarm or {}),
         # Orchestrator-path phase percentiles: traffic since the last

@@ -97,6 +97,33 @@ class ReliabilityConfig(BaseModel):
     breaker_failure_threshold: int = Field(default=5, ge=1)
     breaker_recovery_timeout: float = Field(default=30.0, gt=0)
     breaker_half_open_max: int = Field(default=1, ge=1)
+    # In-flight request recovery (engine/batcher.py): on a device/reader
+    # failure each occupied slot's progress (prompt + accepted tokens)
+    # re-admits through the normal admission path after the device-state
+    # rebuild instead of failing the request — greedy output stays
+    # byte-identical across a mid-decode crash. Attempts are bounded per
+    # request; exhausting them fails with the original exception.
+    # 0 disables (the pre-0.10 fail-all behavior).
+    recovery_max_attempts: int = Field(default=2, ge=0)
+    # Device watchdog (reliability/watchdog.py): declare the engine
+    # stalled when fold/prefill heartbeats go stale this many seconds
+    # with work in flight — a hung dispatch becomes a 503 with
+    # diagnostics instead of silent client hangs. Must exceed the
+    # slowest healthy dispatch (warmup compiles are excluded). None
+    # disables.
+    watchdog_stall_s: Optional[float] = Field(default=None, gt=0)
+    # Degradation ladder (reliability/degrade.py): this many faults
+    # inside the rolling window step capability down one rung
+    # (drafting → chunk size → slots → batch-class shed); a clean
+    # promote-window soak steps back up.
+    degrade_enabled: bool = True
+    degrade_fault_threshold: int = Field(default=3, ge=1)
+    degrade_window_s: float = Field(default=30.0, gt=0)
+    degrade_promote_s: float = Field(default=60.0, gt=0)
+    # Per-SLO-class shedding: non-interactive (batch) requests shed at
+    # this fraction of max_queue_depth, so backlog pressure sheds the
+    # traffic nobody is watching before the traffic someone is.
+    batch_shed_frac: float = Field(default=0.5, gt=0, le=1.0)
 
 
 class LLMConfig(BaseModel):

@@ -102,9 +102,13 @@ from pilottai_tpu.obs import (
 )
 from pilottai_tpu.reliability import (
     DeadlineExceeded,
+    DegradeLadder,
     EngineOverloaded,
+    PoisonedOutput,
+    Watchdog,
     global_injector,
 )
+from pilottai_tpu.reliability import degrade as degrade_levels
 from pilottai_tpu.utils.logging import get_logger
 from pilottai_tpu.utils.metrics import global_metrics
 from pilottai_tpu.utils.tracing import global_tracer
@@ -154,6 +158,22 @@ class GenRequest:
     trace_id: Optional[str] = None
     flight_id: Optional[str] = None
     parent_span_id: Optional[str] = None
+    # SLO service class (obs/slo.py): per-class shed thresholds — batch
+    # traffic sheds at a lower queue depth than interactive, and the
+    # degradation ladder's last rung sheds it outright. None =
+    # interactive semantics.
+    slo_class: Optional[str] = None
+    # In-flight recovery bookkeeping (engine fault domain): on a
+    # device/reader failure the batcher snapshots this request's
+    # progress and re-admits it — ``recovered_tokens`` carries the
+    # already-accepted output (prepended to the final result and never
+    # re-emitted to ``on_tokens``), ``recovery_attempts`` bounds the
+    # strikes before the request fails with the original exception, and
+    # ``recovery_started_at`` times the snapshot→re-admission span for
+    # the ``engine.recovery_ms`` histogram.
+    recovery_attempts: int = 0
+    recovered_tokens: List[int] = field(default_factory=list)
+    recovery_started_at: Optional[float] = None
 
     @property
     def flight_key(self) -> Optional[str]:
@@ -276,6 +296,15 @@ class ContinuousBatcher:
         chunk_buckets: Optional[Tuple[int, ...]] = None,  # adaptive sizes
         overlap_admission: bool = True,  # prep admissions off the device
                                          # thread's critical path
+        recovery_max_attempts: int = 2,  # in-flight re-admissions per
+                                         # request before the original
+                                         # exception wins (0 = off)
+        watchdog_stall_s: Optional[float] = None,  # heartbeat-staleness
+                                                   # bound (None = no dog)
+        degrade: Optional[DegradeLadder] = None,  # capability ladder
+                                                  # (None = default knobs)
+        batch_shed_frac: float = 0.5,   # batch-class shed depth as a
+                                        # fraction of max_queue_depth
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -332,7 +361,29 @@ class ContinuousBatcher:
         # Overload shedding: submits beyond this many queued-not-admitted
         # requests raise EngineOverloaded instead of growing the queue
         # unboundedly (the HTTP edge maps it to 429). None = unbounded.
+        # Batch-class requests shed at batch_shed_frac of the depth —
+        # backlog pressure drops the traffic nobody is watching first.
         self.max_queue_depth = max_queue_depth
+        self.batch_shed_frac = batch_shed_frac
+        # Engine fault domain: bounded in-flight recovery, the capability
+        # ladder, and (optionally) the device watchdog.
+        self.recovery_max_attempts = max(0, recovery_max_attempts)
+        self.degrade = degrade if degrade is not None else DegradeLadder()
+        # Device-thread rebuild request from other threads' failure paths
+        # (reader errors, failed failure-path rebuilds): consumed at the
+        # top of the device loop, where rebuilds are safe.
+        self._rebuild_requested: Optional[str] = None
+        self._watchdog: Optional[Watchdog] = None
+        if watchdog_stall_s:
+            self._watchdog = Watchdog(
+                stall_s=watchdog_stall_s,
+                has_work=self._watchdog_has_work,
+                on_stall=self._on_watchdog_stall,
+                # Unique health-registry source per batcher: in a
+                # multi-engine process, one engine recovering must not
+                # clear a sibling's stall from /healthz.
+                name=f"{cfg.name}:{id(self) & 0xFFFF:04x}",
+            )
         # Whether this batcher's computations actually run on a TPU (the
         # cpu provider can run on a machine whose default backend IS a
         # TPU, so the process-level check is not enough for the Pallas
@@ -622,11 +673,15 @@ class ContinuousBatcher:
                 daemon=True,
             )
             self._prep_thread.start()
+        if self._watchdog is not None:
+            self._watchdog.start()
 
     def stop(self) -> None:
         self._stop.set()
         self._wake.set()
         self._prep_wake.set()
+        if self._watchdog is not None:
+            self._watchdog.stop()
         if self._prep_thread is not None:
             self._prep_thread.join(timeout=60)
             self._prep_thread = None
@@ -689,6 +744,48 @@ class ContinuousBatcher:
             if self.alloc is not None:
                 self.alloc.release(idx)
         self._slots = [None] * self.n_slots
+
+    # ------------------------------------------------------------------ #
+    # Device watchdog (reliability/watchdog.py)
+    # ------------------------------------------------------------------ #
+
+    def _beat(self) -> None:
+        """Progress heartbeat: folds, prefill installs and segment
+        advances call this so the watchdog can tell a hung dispatch from
+        a healthy slow one (any thread; a plain float store)."""
+        wd = self._watchdog
+        if wd is not None:
+            wd.beat()
+
+    def _watchdog_has_work(self) -> bool:
+        """Anything in flight or queued? (watchdog thread; lock-free
+        approximation — a one-poll-late answer only shifts the stall
+        clock by poll_s). Warmup is excluded: its compile sweeps stall
+        heartbeats for legitimate minutes."""
+        if self._warming:
+            return False
+        return (
+            self._inflight > 0
+            or any(s is not None for s in self._slots)
+            or bool(self._backlog)
+            or self._pending.qsize() > 0
+            or self._segmenting is not None
+            # Prepared-but-not-installed admissions: during a PREFILL
+            # dispatch the group's slots live only in _prep_reserved
+            # (slots install after admit_group returns, _prepped_reqs
+            # decrements at pop) — without these a hung prefill on an
+            # otherwise idle engine would never trip the watchdog.
+            or bool(self._prep_reserved)
+            or self._prepped_reqs > 0
+        )
+
+    def _on_watchdog_stall(self, info: Dict[str, Any]) -> None:
+        """Stall diagnostics (watchdog thread): the black-box dump is
+        the flight recorder for "what was the engine doing when it
+        hung"; the ladder counts the stall as a fault."""
+        global_steps.record("engine.watchdog_stall", **info)
+        global_blackbox.dump("watchdog_stall", **info)
+        self.degrade.record_fault("stall")
 
     def _max_safe_strip(self, want: int) -> int:
         """Largest strip ≤ ``want`` whose double-buffered K/V blocks stay
@@ -872,13 +969,61 @@ class ContinuousBatcher:
             and self.queue_depth() >= self.max_queue_depth
         )
 
+    def _shed_reason(self, request: GenRequest) -> Optional[str]:
+        """Why this submit must shed, or None. Per-SLO-class thresholds:
+        interactive traffic sheds at the full ``max_queue_depth``; any
+        other class (batch) at ``batch_shed_frac`` of it — under backlog
+        pressure the fan-out branches nobody is watching drop before the
+        stream a human is. The degradation ladder's last rung sheds
+        batch outright: a faulting engine's remaining capacity defends
+        the interactive SLO class.
+
+        Only the literal ``batch`` class gets the early-shed policy:
+        ``slo_class`` is a free-form client string (the HTTP edge
+        validates it, direct SDK callers may not), and treating every
+        unknown string as batch would silently early-shed typo'd or
+        deployment-defined latency-sensitive classes."""
+        cls = self._shed_class(request)
+        if (
+            cls == "batch"
+            and self.degrade.level() >= degrade_levels.SHED_BATCH
+        ):
+            return (
+                f"engine degraded to level {degrade_levels.SHED_BATCH} "
+                f"({degrade_levels.LEVEL_NAMES[degrade_levels.SHED_BATCH]}); "
+                f"shedding {cls}-class requests"
+            )
+        limit = self.max_queue_depth
+        if limit is None:
+            return None
+        if cls == "batch":
+            limit = max(1, int(limit * self.batch_shed_frac))
+        depth = self.queue_depth()
+        if depth >= limit:
+            return (
+                f"engine queue depth {depth} at configured "
+                f"{cls}-class limit {limit}; shedding"
+            )
+        return None
+
+    @staticmethod
+    def _shed_class(request: GenRequest) -> str:
+        """Shed-policy class: ``batch``, ``interactive``, or ``other``
+        (unknown strings — interactive semantics, but a bounded metrics
+        key so free-form client strings can't grow the registry)."""
+        cls = request.slo_class or "interactive"
+        return cls if cls in ("interactive", "batch") else "other"
+
     def submit(self, request: GenRequest) -> Future:
         # Admission control first: a shed request must cost nothing — no
         # queue entry, no truncation work, no future resolution. Raising
         # (rather than failing the future) lets the HTTP edge turn this
         # into a structured 429 before any engine state exists for it.
-        if self.saturated():
+        shed = self._shed_reason(request)
+        if shed is not None:
+            cls = self._shed_class(request)
             global_metrics.inc("engine.shed")
+            global_metrics.inc(f"engine.shed.{cls}")
             global_metrics.set_gauge(
                 "engine.queue_depth", float(self.queue_depth())
             )
@@ -886,12 +1031,10 @@ class ContinuousBatcher:
                 "engine.shed",
                 queue_depth=self.queue_depth(),
                 max_queue_depth=self.max_queue_depth,
+                slo_class=cls,
                 trace_id=request.trace_id,
             )
-            raise EngineOverloaded(
-                f"engine queue depth {self.queue_depth()} at configured "
-                f"limit {self.max_queue_depth}; shedding"
-            )
+            raise EngineOverloaded(shed)
         # A request born expired (edge queueing, client retry storms)
         # fails immediately instead of wasting a prefill.
         if (
@@ -1215,39 +1358,62 @@ class ContinuousBatcher:
                 continue
             try:
                 self._dispatch_prefill(prep)
-            except Exception as exc:  # noqa: BLE001 — fail this group only
+            except Exception as exc:  # noqa: BLE001 — contain to this group
                 self._log.error("prefill failed: %s", exc, exc_info=True)
-                self._fail_group(prep.group, exc)
+                # A failed prefill DISPATCH is a device fault: the group
+                # re-admits (bounded strikes) instead of failing — no
+                # tokens existed for it yet, so the retry is transparent.
+                self._fail_group(prep.group, exc, recover=True)
+                self.degrade.record_fault("prefill")
                 # admit_group donates cache/dstate/sampling: a dispatch
                 # that failed mid-flight may have consumed them. If so the
-                # engine state is gone with it — fail in-flight work loudly
+                # engine state is gone with it — recover in-flight work
                 # and rebuild fresh state so the engine stays serviceable
                 # (silently keeping deleted buffers would crash the next
                 # chunk and kill every request anyway, without recovery).
                 if self.cache.lengths.is_deleted():
-                    self._fail_occupied_slots(exc)
-                    self._rebuild_device_state()
+                    self._fail_occupied_slots(exc, record_fault=False)
+                    self._rebuild_device_state(reason="prefill_failure")
                     self._requeue_prepared(preps[gi + 1:])
                     break
         if stale_preps:
             self._requeue_prepared(stale_preps)
 
     def _fail_group(self, group: List[Tuple[int, GenRequest]],
-                    exc: Exception) -> None:
+                    exc: Exception, recover: bool = False) -> None:
         """Fail one admission group's requests and return their
-        resources (either thread)."""
+        resources (either thread). With ``recover=True`` (the prefill
+        DISPATCH failure path — a device fault, not a client one) the
+        group's requests requeue at the backlog head instead, bounded
+        by the same per-request strike budget as slot recovery: an
+        admission group has no accepted tokens yet, so its replay is a
+        pure re-admission."""
+        now = time.monotonic()
+        t_snap = time.perf_counter()
+        requeue: List[GenRequest] = []
         with self._lock:
             for idx, req in group:
                 self._slots[idx] = None
                 self._prep_reserved.discard(idx)
-                if not req.future.done():
-                    req.future.set_exception(exc)
                 # Reclaim the group's KV pages (under the lock — the
                 # reader thread releases pages too) — leaking them here
                 # permanently shrinks the pool AND trips allocate()'s
                 # held-pages invariant when the slot is reused.
                 if self.alloc is not None:
                     self.alloc.release(idx)
+                if req.future.done():
+                    continue
+                if not recover:
+                    req.future.set_exception(exc)
+                    continue
+                if self._recovery_decision_locked(req, exc, now, t_snap):
+                    requeue.append(req)
+            for req in reversed(requeue):
+                self._backlog.appendleft(req)
+        if requeue:
+            global_metrics.inc("engine.recovery_requeued", len(requeue))
+            self._prep_wake.set()
+            self._wake.set()
 
     def _requeue_prepared(self, items: List[Any]) -> None:
         """Return prepared-but-undispatchable admissions to the backlog
@@ -1385,6 +1551,16 @@ class ContinuousBatcher:
                 i for i in self._free_slot_indices()
                 if i not in not_yet and i not in self._prep_reserved
             ]
+            # Degrade rung 3+ (reliability/degrade.py): cap live
+            # occupancy at half the slots — less work in flight per
+            # fault, faster drains, smaller recovery replays.
+            if self.degrade.level() >= degrade_levels.HALF_SLOTS:
+                occupied = (
+                    sum(s is not None for s in self._slots)
+                    + len(self._prep_reserved)
+                )
+                cap = max(1, self.n_slots // 2)
+                free = free[: max(0, cap - occupied)]
             groups: List[Tuple[Any, List[Tuple[int, GenRequest]]]] = []
             # The in-progress group lives outside the try so the unwind
             # below sees it even when the failure lands mid-formation.
@@ -1611,6 +1787,7 @@ class ContinuousBatcher:
                     with self._lock:
                         self._prefill_since_fold += seg_dur
                 self._segmenting[2] = done + seg
+                self._beat()  # segment landed: watchdog-visible progress
                 self._wake.set()  # next cycle advances without the idle wait
                 return
             # Final segment: the tokens already written are this slot's
@@ -1635,22 +1812,31 @@ class ContinuousBatcher:
             self._dispatch_prefill(
                 self._prepare_prefill([(idx, req)], entry, n_rows=1)
             )
-        except Exception as exc:  # noqa: BLE001 — fail this request only
+        except Exception as exc:  # noqa: BLE001 — contain to this request
             self._log.error("chunked prefill failed: %s", exc, exc_info=True)
             # Cleanup before _end_segmentation for the same reason as the
             # cancel branch: once prep wakes, the slot must either hold
-            # no pages or stay reserved — never "empty with pages".
+            # no pages or stay reserved — never "empty with pages". A
+            # segmented admission has produced no tokens yet, so a
+            # device fault here re-admits from scratch (bounded strikes)
+            # rather than failing the request.
+            now = time.monotonic()
             with self._lock:
-                if not req.future.done():
-                    req.future.set_exception(exc)
                 self._slots[idx] = None
                 self._prep_reserved.discard(idx)
                 if self.alloc is not None:
                     self.alloc.release(idx)
+                if not req.future.done():
+                    if self._recovery_decision_locked(
+                        req, exc, now, time.perf_counter()
+                    ):
+                        self._backlog.appendleft(req)
+                        global_metrics.inc("engine.recovery_requeued")
             self._end_segmentation()
+            self.degrade.record_fault("prefill")
             if self.cache.lengths.is_deleted():
-                self._fail_occupied_slots(exc)
-                self._rebuild_device_state()
+                self._fail_occupied_slots(exc, record_fault=False)
+                self._rebuild_device_state(reason="prefill_failure")
 
     def _prepare_prefill(
         self,
@@ -1858,6 +2044,7 @@ class ContinuousBatcher:
         first_copy = _HostCopy((first,))
         self._last_prefill_t = time.perf_counter()
         admit_at = time.perf_counter()
+        self._beat()  # prefill enqueued: watchdog-visible progress
         if not self._warming:
             # Attribution: tokens actually prefilled this dispatch (the
             # AI_LEN rows carry tail lengths on prefix paths — prefix-hit
@@ -1900,6 +2087,15 @@ class ContinuousBatcher:
                 # only if this request's output proves unpredictable.
                 self._slot_rate[idx] = float(max(self.speculate, 1))
                 self._draft_on[idx] = False
+                if req.recovery_started_at is not None:
+                    # Snapshot → re-admission wall: the latency a
+                    # recovered request paid for the fault (bench
+                    # RECOVERY reports p50/p99).
+                    global_metrics.observe(
+                        "engine.recovery_ms",
+                        (admit_at - req.recovery_started_at) * 1e3,
+                    )
+                    req.recovery_started_at = None
             self._first_reads.append(
                 ([(idx, self._gen[idx]) for idx, _ in group], first_copy)
             )
@@ -2012,12 +2208,16 @@ class ContinuousBatcher:
                 self._log.warning("prefix export failed: %s", exc)
                 return
 
-    def _fold_first_tokens(self, groups, hosts: List[np.ndarray]) -> List:
+    def _fold_first_tokens(
+        self, groups, hosts: List[np.ndarray],
+        poisoned: Optional[List] = None,
+    ) -> List:
         """Fold prefill-sampled first tokens into their slots (lock held).
         Entries carry the admission generation, so a stale entry from a
         failed/aborted generation can never feed the slot's next occupant.
         Returns ``(on_tokens, ids)`` stream emissions for the caller to
-        fire AFTER releasing the lock."""
+        fire AFTER releasing the lock; poisoned slots append to
+        ``poisoned`` for the caller's outside-the-lock reporting."""
         emits: List = []
         for (rows, _), host in zip(groups, hosts):
             host = np.asarray(host)
@@ -2027,6 +2227,15 @@ class ContinuousBatcher:
                     continue
                 slot.first_pending = False
                 tok = int(host[row])
+                # Poison containment at the fold boundary: an
+                # out-of-vocab first token (the host-visible symptom of
+                # NaN logits / corrupted device memory) fails THIS
+                # request, not the engine.
+                if not 0 <= tok < self.cfg.vocab_size:
+                    entry = self._poison_slot_locked(idx, [tok])
+                    if poisoned is not None:
+                        poisoned.append(entry)
+                    continue
                 slot.generated.append(tok)
                 req = slot.request
                 if tok != req.eos_id and tok not in req.stop_ids:
@@ -2039,6 +2248,43 @@ class ContinuousBatcher:
                         emits.append((req.on_tokens, [tok]))
                 self._check_finished(idx)
         return emits
+
+    def _poison_slot_locked(
+        self, idx: int, bad_ids: List[int]
+    ) -> Tuple[int, GenRequest]:
+        """Contain a poisoned fold to ITS request (slot lock held): the
+        slot releases and the future fails with PoisonedOutput; the
+        engine and every other occupant keep serving. Callers run the
+        dump/ladder bookkeeping outside the lock."""
+        slot = self._slots[idx]
+        req = slot.request
+        self._slots[idx] = None
+        self._gen[idx] += 1
+        self._release.append(idx)
+        self._release_pages_locked(idx)
+        if not req.future.done():
+            req.future.set_exception(PoisonedOutput(
+                f"decode fold produced out-of-vocab token id(s) "
+                f"{bad_ids[:4]} (vocab {self.cfg.vocab_size}, slot {idx}); "
+                f"failing this request only"
+            ))
+        global_metrics.inc("engine.poisoned")
+        return idx, req
+
+    def _report_poisoned(
+        self, poisoned: List[Tuple[int, GenRequest]]
+    ) -> None:
+        """Poison observability OUTSIDE the slot lock (dump = file IO)."""
+        for idx, req in poisoned:
+            self.degrade.record_fault("poison")
+            global_steps.record(
+                "engine.poison", slot=idx, trace_id=req.trace_id
+            )
+            global_blackbox.dump(
+                "poisoned_fold", trace_id=req.trace_id, slot=idx,
+            )
+        if poisoned:
+            self._prep_wake.set()
 
     def _drain_first_reads(self) -> None:
         """Reader thread ONLY: fold pending first tokens outside a chunk
@@ -2056,9 +2302,12 @@ class ContinuousBatcher:
         # Each entry's copy started at admission dispatch; materializing
         # here is not a fresh device round trip.
         hosts = [copy.wait()[0] for _, copy in groups]
+        poisoned: List = []
         with self._lock:
-            emits = self._fold_first_tokens(groups, hosts)
+            emits = self._fold_first_tokens(groups, hosts, poisoned)
+        self._report_poisoned(poisoned)
         self._fire_stream(emits)
+        self._beat()
 
     def _check_finished(self, idx: int) -> None:
         """Apply host-side completion rules to a slot; complete + free it
@@ -2111,7 +2360,17 @@ class ContinuousBatcher:
                 tokens=len(out),
             )
         if not req.future.done():
+            # A recovered request's result is the tokens accepted BEFORE
+            # the fault plus this (re-admitted) generation — the exact
+            # sequence an uninterrupted run would have produced for
+            # greedy sampling, and exactly what the streaming callbacks
+            # already emitted (recovered tokens were streamed pre-fault,
+            # never re-emitted).
+            if req.recovered_tokens:
+                out = req.recovered_tokens + out
             req.future.set_result(out)
+            if req.recovery_attempts:
+                global_metrics.inc("engine.recovered_requests")
 
     def _release_pages_locked(self, idx: int) -> None:
         """Return a finished/expired/failed slot's KV pages to the pool
@@ -2167,6 +2426,11 @@ class ContinuousBatcher:
         bounded at len(chunk_buckets) per prefix-bound rung."""
         if self._force_chunk is not None:  # warmup compile sweep
             return max(1, min(self._force_chunk, self.chunk_size))
+        # Degrade rung 2+ (reliability/degrade.py): clamp to the
+        # smallest compiled bucket — short dispatches mean a short blast
+        # radius per fault and fast fold heartbeats for the watchdog.
+        if self.degrade.level() >= degrade_levels.MIN_CHUNK:
+            return self.chunk_buckets[0]
         if self.chunk_policy != "adaptive":
             return self.chunk_size
         rate = self._spec_rate if self.speculate else 1.0
@@ -2205,9 +2469,15 @@ class ContinuousBatcher:
         hi: int = 0, table_np: Optional[np.ndarray] = None,
     ):
         # Chaos point: a failed decode dispatch. Raises propagate to the
-        # device loop boundary → _fail_occupied_slots fails the occupants
-        # with this exception while queued requests survive to re-admit.
+        # device loop boundary → _fail_occupied_slots RECOVERS the
+        # occupants (re-admission after rebuild) or, strikes exhausted,
+        # fails them with this exception; queued requests are untouched.
         global_injector.fire("engine.step")
+        # Chaos point: a STUCK dispatch — delay= pins the device thread
+        # here without raising, exactly the shape of a hung XLA call or
+        # a wedged collective. Nothing downstream ever observes it; the
+        # watchdog's heartbeat staleness is the only detector.
+        global_injector.fire("engine.dispatch.hang")
         # Host-gap telemetry: how long the device sat with NOTHING in
         # flight between the last fold/feed and this dispatch — the
         # host-side bubble overlapped admission + non-blocking folds
@@ -2267,6 +2537,17 @@ class ContinuousBatcher:
                 for s in self._slots
             ) else None
         )
+        # Degrade rung 1+ (reliability/degrade.py): speculative MODEL
+        # drafting off — n-gram drafts only. The mode vector is a traced
+        # input, so an all-False vector reuses the compiled executable
+        # while skipping the shallow-layer draft passes on a device that
+        # is already faulting.
+        draft_vec = self._draft_on
+        if (
+            self.draft_layers
+            and self.degrade.level() >= degrade_levels.NO_DRAFT
+        ):
+            draft_vec = np.zeros_like(self._draft_on)
         with global_metrics.timer("engine.chunk_dispatch_latency"):
             if self.speculate:
                 (
@@ -2282,7 +2563,7 @@ class ContinuousBatcher:
                     page_strip=self.page_strip,
                     draft_layers=self.draft_layers,
                     draft_mode=(
-                        jnp.asarray(self._draft_on)
+                        jnp.asarray(draft_vec)
                         if self.draft_layers else None
                     ),
                 )
@@ -2328,7 +2609,23 @@ class ContinuousBatcher:
         with global_metrics.timer("engine.chunk_read_latency"):
             toks_h, valid_h = copies.wait()
             first_hosts = [copy.wait()[0] for _, copy in groups]
+        # Chaos point: poison one slot's folded ids with an out-of-vocab
+        # value at the fold boundary (value= the slot index, or True for
+        # the first slot that emitted) — drives the containment path a
+        # real NaN-logits / corrupted-HBM fold would take.
+        corrupt = global_injector.fire("engine.fold.corrupt")
+        if corrupt is not None and toks_h.size:
+            toks_h = toks_h.copy()
+            if isinstance(corrupt, bool) or not isinstance(corrupt, int):
+                cols = np.flatnonzero(valid_h.any(axis=0))
+                corrupt = int(cols[0]) if cols.size else 0
+            toks_h[:, corrupt] = self.cfg.vocab_size + 7
         n, B = toks_h.shape
+        # Poison precheck, vectorized: one pass over the fold buffer; the
+        # per-slot containment below only runs when something is actually
+        # out of vocab (never on the healthy hot path).
+        bad_valid = ((toks_h < 0) | (toks_h >= self.cfg.vocab_size)) & valid_h
+        any_bad = bool(bad_valid.any())
         # One block-validity view serves the draft EMA, the utilization
         # counters and the acceptance EMA below.
         blk_any = valid_h.reshape(
@@ -2338,11 +2635,14 @@ class ContinuousBatcher:
             slot_blocks = blk_any.sum(axis=0)                # [B]
             slot_tokens = valid_h.sum(axis=0)
         emits: List = []
+        poisoned: List = []
         with self._lock:
             # First tokens were sampled before this chunk ran — fold them
             # first so token order inside each slot is right.
             if groups:
-                emits = self._fold_first_tokens(groups, first_hosts)
+                emits = self._fold_first_tokens(
+                    groups, first_hosts, poisoned
+                )
             for b in range(B):
                 slot = self._slots[b]
                 if slot is None or gen_stamp[b] != self._gen[b]:
@@ -2374,6 +2674,15 @@ class ContinuousBatcher:
                 if slot.first_pending:
                     continue
                 req = slot.request
+                # Poison containment: validate what crosses the fold
+                # boundary. Out-of-vocab ids are the host-visible symptom
+                # of NaN logits or corrupted device memory; they fail
+                # ONLY this slot's request — folding them would crash (or
+                # corrupt) the tokenizer and detokenized stream instead.
+                if any_bad and bad_valid[:, b].any():
+                    bad = [int(t) for t in toks_h[bad_valid[:, b], b]]
+                    poisoned.append(self._poison_slot_locked(b, bad))
+                    continue
                 fresh: List[int] = []
                 for i in range(n):
                     if not valid_h[i, b]:
@@ -2392,6 +2701,7 @@ class ContinuousBatcher:
                 if fresh and req.on_tokens is not None:
                     emits.append((req.on_tokens, fresh))
             slots_active = sum(s is not None for s in self._slots)
+        self._report_poisoned(poisoned)
         self._fire_stream(emits)
         # Chunk utilization: blocks where at least one slot emitted ÷
         # blocks dispatched. The gap is exactly the straggler/tail waste
@@ -2487,6 +2797,8 @@ class ContinuousBatcher:
             else:
                 dur = max(t_fold - t_dispatch, 0.0)
             global_attribution.record("decode", dur, tokens=accepted)
+        # Fold landed: the watchdog's definition of forward progress.
+        self._beat()
 
     def _fire_stream(self, emits: List) -> None:
         """Fire streaming callbacks OUTSIDE the slot lock (reader thread).
@@ -2520,7 +2832,17 @@ class ContinuousBatcher:
                 # The chunk's tokens are lost on the host while the device
                 # has already consumed their budget; swallowing would hang
                 # the affected requests forever and leak their slots.
+                # Recovery re-admits the occupants; the rebuild request
+                # (consumed by the device thread, where rebuilds are
+                # safe) resets the pool a failed transfer makes suspect.
+                # Flag BEFORE the sweep: _fail_occupied_slots wakes the
+                # device thread, and it must observe the rebuild request
+                # before it can re-admit the recovered requests — or
+                # they would prefill against the suspect pool and the
+                # deferred rebuild would then swap state under live
+                # occupants (silent output corruption).
                 self._log.error("reader error: %s", exc, exc_info=True)
+                self._rebuild_requested = "reader_error"
                 self._fail_occupied_slots(exc)
                 # The failed chunk left the pipeline without reaching
                 # _process_chunk's bookkeeping tail. Sentinel failures
@@ -2533,15 +2855,26 @@ class ContinuousBatcher:
             self._wake.set()
         self._log.info("reader stopped")
 
-    def _rebuild_device_state(self) -> None:
+    def _rebuild_device_state(self, reason: Optional[str] = None) -> None:
         """(Re)create cache/sampling/decode state — at construction, and
-        after a failed donated dispatch consumed the previous buffers
-        (device thread only; failure callers must fail the occupants
-        first). The allocator swap and epoch bump happen under the slot
-        lock, so a concurrent admission prep can never allocate half in
-        the old pool and half in the new: a prep stamped with the old
-        epoch requeues at dispatch time instead of prefilling against
-        the fresh allocator's sentinel rows."""
+        after a failed dispatch left the previous buffers consumed or
+        suspect (device thread only; failure callers must fail/recover
+        the occupants first). The allocator swap and epoch bump happen
+        under the slot lock, so a concurrent admission prep can never
+        allocate half in the old pool and half in the new: a prep
+        stamped with the old epoch requeues at dispatch time instead of
+        prefilling against the fresh allocator's sentinel rows.
+
+        ``reason`` marks a FAILURE-path rebuild (None = construction):
+        those were previously visible only as log lines — now each one
+        counts under ``engine.rebuilds{reason=}``, lands in the step
+        ring and writes a black-box dump, so an engine quietly
+        rebuilding once a minute shows up on a dashboard instead of in
+        grep."""
+        if reason is not None:
+            # Chaos point: a rebuild that itself fails (exc=) — retried
+            # next device-loop cycle via _rebuild_requested.
+            global_injector.fire("engine.rebuild", reason=reason)
         if self.paged:
             cache = PagedKVCache.create(
                 self.cfg.n_layers, self.n_slots, self.num_pages,
@@ -2575,21 +2908,130 @@ class ContinuousBatcher:
             jnp.zeros((self.n_slots, self.max_seq_len), jnp.int32)
             if self.speculate else None
         )
+        if reason is not None:
+            global_metrics.inc("engine.rebuilds")
+            global_metrics.inc(f"engine.rebuilds.{reason}")
+            global_steps.record("engine.rebuild", reason=reason)
+            global_blackbox.dump("engine_rebuild", rebuild_reason=reason)
+            self._log.warning("device state rebuilt (reason=%s)", reason)
+            # The rebuild IS forward progress — recovery re-admissions
+            # must not race the watchdog's stall clock.
+            self._beat()
 
-    def _fail_occupied_slots(self, exc: Exception) -> None:
-        """Fail every in-flight request and reset slot bookkeeping after an
-        unrecoverable device/transfer error (either thread)."""
+    def _recoverable(self, req: GenRequest, now: float) -> bool:
+        """May this request re-admit instead of failing? (lock held)"""
+        return (
+            self.recovery_max_attempts > 0
+            and req.recovery_attempts < self.recovery_max_attempts
+            and not req.cancelled
+            and not req.future.cancelled()
+            and (req.deadline is None or now < req.deadline)
+        )
+
+    def _recovery_decision_locked(
+        self, req: GenRequest, exc: Exception, now: float, t_snap: float
+    ) -> bool:
+        """ONE requeue-or-fail policy for every failure arm (slot lock
+        held). True → the request re-admits: attempts bumped, recovery
+        stamp set — the CALLER appends it to the backlog so each site
+        keeps its own FIFO ordering. False → the future was failed with
+        ``exc`` (strike accounting included)."""
+        if self._recoverable(req, now):
+            req.recovery_attempts += 1
+            req.recovery_started_at = t_snap
+            return True
+        if (
+            self.recovery_max_attempts > 0
+            and req.recovery_attempts >= self.recovery_max_attempts
+        ):
+            global_metrics.inc("engine.recovery_failed")
+        req.future.set_exception(exc)
+        return False
+
+    def _fail_occupied_slots(
+        self, exc: Exception, record_fault: bool = True
+    ) -> None:
+        """Contain a device/transfer failure to the ENGINE, not its
+        requests (either thread). Every occupied slot's progress —
+        original prompt plus the tokens already accepted — is
+        snapshotted and re-admitted at the backlog head through the
+        normal admission path: the re-prefill runs over prompt+generated
+        (the prefix cache absorbs most of it when the pool survived), so
+        a greedy request's final output is byte-identical to an
+        uninterrupted run, and streaming consumers resume at the next
+        NEW token (``recovered_tokens`` are never re-emitted). Attempts
+        are bounded per request (``recovery_max_attempts`` strikes →
+        fail with the original exception); cancelled/expired requests
+        and grammar-constrained requests that already streamed tokens
+        fail immediately (the JSON automaton's state is derived from
+        the position *after the prompt*, so a spliced replay prompt
+        would constrain against the wrong state — restart-from-scratch
+        is only transparent when nothing was emitted)."""
+        now = time.monotonic()
+        t_snap = time.perf_counter()
+        recovered: List[GenRequest] = []
+        failed = 0
         with self._lock:
             for i, slot in enumerate(self._slots):
-                if slot is not None:
-                    if not slot.request.future.done():
-                        slot.request.future.set_exception(exc)
-                    self._slots[i] = None
-                    self._gen[i] += 1
-                    self._release.append(i)
-                    self._release_pages_locked(i)
+                if slot is None:
+                    continue
+                self._slots[i] = None
+                self._gen[i] += 1
+                self._release.append(i)
+                self._release_pages_locked(i)
+                req = slot.request
+                if req.future.done():
+                    continue
+                replay = list(slot.generated)
+                json_bound = req.json_mode or req.json_schema_id >= 0
+                if json_bound and replay and req.on_tokens is not None:
+                    # Streamed grammar-constrained output can neither be
+                    # spliced (DFA state is position-derived) nor
+                    # restarted (the consumer already saw tokens).
+                    req.future.set_exception(exc)
+                    failed += 1
+                    continue
+                if not self._recovery_decision_locked(req, exc, now, t_snap):
+                    failed += 1
+                    continue
+                if json_bound and replay:
+                    # Restart the whole generation (nothing was
+                    # streamed): the grammar mask re-derives cleanly
+                    # from the original prompt, and greedy output is
+                    # the same either way.
+                    replay = []
+                if replay:
+                    # New list, not in-place extend: callers hold
+                    # references to the original prompt (usage counting).
+                    req.prompt_ids = req.prompt_ids + replay
+                    req.recovered_tokens.extend(replay)
+                    req.max_new_tokens -= len(replay)
+                    global_metrics.inc("engine.tokens_replayed", len(replay))
+                recovered.append(req)
             self._first_reads.clear()
+            # Backlog HEAD in original submission order: these requests
+            # were admitted earliest, so FIFO fairness keeps holding.
+            for req in reversed(recovered):
+                self._backlog.appendleft(req)
+        if recovered or failed:
+            global_metrics.inc("engine.recovery_requeued", len(recovered))
+            global_steps.record(
+                "engine.recovery",
+                requeued=len(recovered),
+                failed=failed,
+                error=str(exc)[:200],
+            )
+            self._log.warning(
+                "engine failure (%s): %d in-flight request(s) requeued "
+                "for recovery, %d failed", exc, len(recovered), failed,
+            )
+        if record_fault:
+            # record_fault=False when the caller already counted this
+            # incident (the prefill-failure arms record "prefill" first)
+            # — one incident must step the ladder once, not twice.
+            self.degrade.record_fault("device")
         self._prep_wake.set()
+        self._wake.set()
 
     def _run(self) -> None:
         self._log.info(
@@ -2600,10 +3042,31 @@ class ContinuousBatcher:
             try:
                 # Self-heal after any donated dispatch (decode_chunk too,
                 # not just admission) failed mid-flight and consumed the
-                # state buffers; the except arm below already failed the
-                # occupants on the way here.
-                if self.cache.lengths.is_deleted():
-                    self._rebuild_device_state()
+                # state buffers — or after another thread's failure path
+                # requested a rebuild; the failure arms already
+                # failed/recovered the occupants on the way here.
+                if (
+                    self.cache.lengths.is_deleted()
+                    or self._rebuild_requested is not None
+                ):
+                    reason = self._rebuild_requested or "state_consumed"
+                    self._rebuild_requested = None
+                    # A deferred rebuild can race an admission that was
+                    # mid-dispatch when the requesting thread swept its
+                    # occupants (slots install only after admit_group
+                    # returns): anyone occupying a slot NOW must be
+                    # recovered before the swap, or they would decode
+                    # against the fresh allocator's sentinel rows.
+                    # Idempotent when the original sweep got everyone.
+                    if any(s is not None for s in self._slots):
+                        self._fail_occupied_slots(
+                            RuntimeError(
+                                f"device state rebuilt ({reason}) with "
+                                f"request in flight"
+                            ),
+                            record_fault=False,
+                        )
+                    self._rebuild_device_state(reason=reason)
                 self._expire_deadlines()
                 self._admit()
                 with self._lock:
@@ -2666,6 +3129,21 @@ class ContinuousBatcher:
             except Exception as exc:  # noqa: BLE001 — device loop boundary
                 self._log.error("device loop error: %s", exc, exc_info=True)
                 self._fail_occupied_slots(exc)
+                # Conservative containment: a dispatch that raised
+                # mid-flight may have partially mutated device state even
+                # when the donated buffers survived — rebuild fresh so
+                # recovered re-admissions never decode against suspect
+                # KV. (This is what makes recovered greedy output
+                # byte-identical by construction: everything re-prefills
+                # from the tokens, nothing trusts the old pool.)
+                try:
+                    self._rebuild_device_state(reason="device_loop_error")
+                except Exception as rexc:  # noqa: BLE001 — retry next cycle
+                    self._log.error(
+                        "device-state rebuild failed: %s", rexc,
+                        exc_info=True,
+                    )
+                    self._rebuild_requested = "rebuild_retry"
         self._log.info("device loop stopped")
 
     # ------------------------------------------------------------------ #
@@ -2720,4 +3198,20 @@ class ContinuousBatcher:
                 if self.max_queue_depth is not None else {}
             ),
             "expired": global_metrics.get("engine.expired"),
+            # Engine fault domain: ladder rung, failure-path rebuilds,
+            # in-flight recovery accounting and fold-poison containment.
+            "degrade_level": self.degrade.level(),
+            "rebuilds": global_metrics.get("engine.rebuilds"),
+            "poisoned": global_metrics.get("engine.poisoned"),
+            "recovery": {
+                "max_attempts": self.recovery_max_attempts,
+                "requeued": global_metrics.get("engine.recovery_requeued"),
+                "recovered": global_metrics.get("engine.recovered_requests"),
+                "failed": global_metrics.get("engine.recovery_failed"),
+                "tokens_replayed": global_metrics.get("engine.tokens_replayed"),
+            },
+            **(
+                {"watchdog_stalled": self._watchdog.stalled}
+                if self._watchdog is not None else {}
+            ),
         }

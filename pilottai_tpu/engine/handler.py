@@ -36,6 +36,7 @@ from pilottai_tpu.reliability import (
     CircuitOpenError,
     DeadlineExceeded,
     EngineOverloaded,
+    global_engine_health,
     global_injector,
 )
 from pilottai_tpu.utils.logging import get_logger
@@ -134,6 +135,12 @@ class LLMHandler:
             self.breaker.on_open = lambda name: global_blackbox.dump(
                 "breaker_open", breaker=name, model=self.config.model_name,
             )
+            # A watchdog-declared engine stall force-opens this breaker:
+            # a HUNG backend produces no failures to count (calls never
+            # return), so without this new requests would queue onto a
+            # dead device until their own timeouts. Weakly held — a
+            # collected handler's breaker just drops off the registry.
+            global_engine_health.subscribe(self.breaker.on_engine_stall)
         self._log = get_logger("engine.handler")
         self._started = False
 

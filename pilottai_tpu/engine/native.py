@@ -45,6 +45,7 @@ from pilottai_tpu.parallel.mesh import (
     initialize_distributed,
 )
 from pilottai_tpu.parallel.sharding import shard_params
+from pilottai_tpu.reliability import DegradeLadder
 from pilottai_tpu.utils.logging import get_logger
 
 
@@ -238,6 +239,18 @@ class NativeEngine(LLMBackend):
             schema_bank=self.schema_bank,
             prefill_chunk=self.config.engine_prefill_chunk,
             max_queue_depth=self.config.reliability.max_queue_depth,
+            # Engine fault domain (ReliabilityConfig): bounded in-flight
+            # recovery, per-class shedding, the capability ladder and
+            # (when configured) the device watchdog.
+            recovery_max_attempts=self.config.reliability.recovery_max_attempts,
+            watchdog_stall_s=self.config.reliability.watchdog_stall_s,
+            batch_shed_frac=self.config.reliability.batch_shed_frac,
+            degrade=DegradeLadder(
+                fault_threshold=self.config.reliability.degrade_fault_threshold,
+                window_s=self.config.reliability.degrade_window_s,
+                promote_s=self.config.reliability.degrade_promote_s,
+                enabled=self.config.reliability.degrade_enabled,
+            ),
         )
         self.batcher.start()
         self.batcher.warmup()
@@ -315,6 +328,10 @@ class NativeEngine(LLMBackend):
             ),
             json_schema_id=schema_id,
             deadline=params.deadline,
+            # Per-class engine shedding: batch-class traffic sheds at a
+            # lower backlog depth than interactive (and outright at the
+            # degradation ladder's last rung).
+            slo_class=params.slo_class,
             # Flight-recorder correlation: the batcher marks admission /
             # token phases against the flight id and emits its span
             # against the trace id.

@@ -75,6 +75,20 @@ global_flight.add_finish_listener(global_dag.observe_flight)
 from pilottai_tpu.utils.metrics import global_metrics as _gm
 
 _gm.declare("engine.queue_depth", "gauge")
+# Engine fault domain (reliability/{watchdog,degrade}.py + batcher):
+# declared at boot so dashboards and the health surface can alert on
+# zero-valued gauges before the first fault ever happens.
+_gm.declare("engine.stalled", "gauge")          # watchdog verdict (0/1)
+_gm.declare("engine.degrade_level", "gauge")    # capability ladder rung
+_gm.declare("engine.rebuilds", "counter")       # failure-path rebuilds
+_gm.declare("engine.watchdog_stalls", "counter")
+_gm.declare("engine.watchdog_recoveries", "counter")
+_gm.declare("engine.poisoned", "counter")       # fold-boundary containment
+_gm.declare("engine.recovery_requeued", "counter")
+_gm.declare("engine.recovered_requests", "counter")
+_gm.declare("engine.recovery_failed", "counter")
+_gm.declare("engine.tokens_replayed", "counter")
+_gm.declare("engine.recovery_ms", "histogram")  # snapshot → re-admission
 
 __all__ = [
     "AgentOccupancy",

@@ -10,6 +10,14 @@ package holds the pieces that keep overload and failure *bounded*:
   tests script to provoke the failure paths the tree claims to handle.
 * ``breaker`` — a circuit breaker wrapping engine calls so repeated
   device failures flip to fast-fail 503s with half-open probing.
+* ``watchdog`` — a heartbeat-staleness monitor that turns a *hung*
+  dispatch (which never raises anywhere) into an explicit stalled
+  state: health endpoint 503s, subscribed breakers force-open, and a
+  black-box dump captures the engine's last steps.
+* ``degrade`` — a capability ladder: repeated faults inside a rolling
+  window step serving capability down (drafting → chunk size → slots →
+  batch-class shed) instead of oscillating between full speed and
+  total failure; a clean soak promotes back up.
 
 Import cost: utils-only dependencies, no jax — safe for control-plane
 processes.
@@ -25,9 +33,16 @@ from pilottai_tpu.reliability.breaker import (
 from pilottai_tpu.reliability.deadline import (
     DeadlineExceeded,
     EngineOverloaded,
+    PoisonedOutput,
     deadline_from_timeout,
     expired,
     remaining,
+)
+from pilottai_tpu.reliability.degrade import DegradeLadder
+from pilottai_tpu.reliability.watchdog import (
+    EngineHealth,
+    Watchdog,
+    global_engine_health,
 )
 from pilottai_tpu.reliability.inject import (
     Fault,
@@ -43,11 +58,16 @@ __all__ = [
     "CircuitBreaker",
     "CircuitOpenError",
     "DeadlineExceeded",
+    "DegradeLadder",
+    "EngineHealth",
     "EngineOverloaded",
     "Fault",
     "FaultInjector",
+    "PoisonedOutput",
+    "Watchdog",
     "deadline_from_timeout",
     "expired",
+    "global_engine_health",
     "global_injector",
     "inject",
     "remaining",

@@ -150,6 +150,31 @@ class CircuitBreaker:
             except Exception:  # noqa: BLE001 — hook must not break the breaker
                 pass
 
+    def force_open(self, reason: str = "forced") -> None:
+        """Open immediately regardless of the failure count — the device
+        watchdog's path: a *hung* engine produces no failures to count
+        (calls never return), so the stall itself is the verdict. The
+        normal half-open probing recovers it once ``recovery_timeout``
+        elapses and the engine answers again."""
+        with self._lock:
+            prev = self._state
+            if prev != OPEN:
+                self._open()
+            self._set_gauge()
+            opened = self._state == OPEN and prev != OPEN
+        hook = self.on_open
+        if opened and hook is not None:
+            try:
+                hook(self.name)
+            except Exception:  # noqa: BLE001 — hook must not break the breaker
+                pass
+
+    def on_engine_stall(self, snapshot: Optional[Dict[str, Any]] = None) -> None:
+        """``EngineHealth`` subscriber form (reliability/watchdog.py):
+        a bound method, so the health registry can hold it weakly."""
+        del snapshot
+        self.force_open("engine watchdog stall")
+
     # ------------------------------------------------------------------ #
 
     def _open(self) -> None:

@@ -30,6 +30,16 @@ class EngineOverloaded(RuntimeError):
     partial work exists for the request."""
 
 
+class PoisonedOutput(RuntimeError):
+    """The device returned tokens that fail validation at the fold
+    boundary (out-of-vocab ids — the host-visible symptom of NaN logits
+    or corrupted device memory). Contained per request: only the
+    affected slot fails; the engine and its other occupants keep
+    serving. Not replayed by in-flight recovery (re-decoding corrupted
+    state would reproduce the poison); the handler's normal retry loop
+    gives the request a fresh attempt instead."""
+
+
 def deadline_from_timeout(
     timeout: Optional[float], now: Optional[float] = None
 ) -> Optional[float]:

@@ -14,6 +14,18 @@ Canonical points wired in-tree (callers may add more; names are free-form):
 ``engine.step``              decode-chunk dispatch (``batcher._dispatch_chunk``)
 ``engine.prefill``           admission prefill — ``delay=`` simulates a
                              slow/hung prefill, ``exc=`` a failed one
+``engine.dispatch.hang``     a stuck dispatch — ``delay=`` pins the device
+                             thread inside ``_dispatch_chunk`` without
+                             raising, exactly what a hung XLA call or a
+                             wedged collective looks like (the watchdog's
+                             detection target)
+``engine.fold.corrupt``      poisons one slot's folded tokens with
+                             out-of-vocab ids at the fold boundary —
+                             ``value=`` the slot index (or ``True`` for
+                             the first live slot)
+``engine.rebuild``           failure-path ``_rebuild_device_state`` —
+                             ``exc=`` simulates a rebuild that itself
+                             fails (retried next device-loop cycle)
 ``handler.timeout``          ``LLMHandler``'s backend call boundary
 ``agent.heartbeat.stall``    ``FaultTolerance._assess`` consumes ``value=``
                              seconds of injected heartbeat staleness
@@ -53,6 +65,9 @@ class Fault:
     value: Any = None
     times: Optional[int] = 1    # fires before auto-disarm; None = unlimited
     probability: float = 1.0
+    skip: int = 0               # let this many passes through first — e.g.
+                                # land a fault mid-decode, after real
+                                # tokens have already folded
     fired: int = field(default=0)
 
     def _materialize(self) -> BaseException:
@@ -85,10 +100,11 @@ class FaultInjector:
         value: Any = None,
         times: Optional[int] = 1,
         probability: float = 1.0,
+        skip: int = 0,
     ) -> Fault:
         fault = Fault(
             name=name, exc=exc, delay=delay, value=value,
-            times=times, probability=probability,
+            times=times, probability=probability, skip=skip,
         )
         with self._lock:
             self._faults[name] = fault
@@ -135,6 +151,9 @@ class FaultInjector:
         with self._lock:
             fault = self._faults.get(name)
             if fault is None:
+                return None
+            if fault.skip > 0:
+                fault.skip -= 1
                 return None
             if fault.probability < 1.0 and self._rng.random() >= fault.probability:
                 return None

@@ -37,6 +37,22 @@ from pilottai_tpu.utils.logging import get_logger
 from pilottai_tpu.utils.metrics import global_metrics
 from pilottai_tpu.utils.tracing import global_tracer
 
+
+def _engine_health_snapshot() -> Dict[str, Any]:
+    """Engine fault-domain summary for Serve.get_metrics: watchdog
+    verdict + capability-ladder rung, from the process-global registries
+    (no engine reference needed — the orchestrator may be remote from
+    the device)."""
+    from pilottai_tpu.reliability import global_engine_health
+
+    snap = global_engine_health.snapshot()
+    return {
+        "stalled": snap["stalled"],
+        **({"reason": snap["reason"]} if snap["stalled"] else {}),
+        "degrade_level": global_metrics.get("engine.degrade_level"),
+        "rebuilds": global_metrics.get("engine.rebuilds"),
+    }
+
 TaskCallback = Callable[[Task, TaskResult], Any]
 
 
@@ -1039,6 +1055,10 @@ class Serve:
             "engine": (
                 self.manager_llm.get_metrics() if self.manager_llm is not None else None
             ),
+            # Engine fault-domain surface (reliability/watchdog.py +
+            # degrade.py): operators polling the orchestrator see a
+            # stalled/degraded engine here without a separate scrape.
+            "engine_health": _engine_health_snapshot(),
             # Trailing-60s window, stated explicitly: this is CURRENT
             # throughput (0 after a minute idle), not the run's all-time
             # average — pass window=None for that.

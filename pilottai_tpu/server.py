@@ -369,7 +369,23 @@ class APIServer:
         writer: asyncio.StreamWriter,
     ) -> None:
         if path == "/healthz" and method == "GET":
-            await self._send(writer, 200, {"status": "ok"})
+            # Liveness AND engine liveness: a watchdog-declared stall (a
+            # hung dispatch — reliability/watchdog.py) flips this to 503
+            # with a retry_after hint, so load balancers stop routing to
+            # a process whose device can't serve, long before clients'
+            # own timeouts would reveal it.
+            from pilottai_tpu.reliability import global_engine_health
+
+            if global_engine_health.healthy():
+                await self._send(writer, 200, {"status": "ok"})
+            else:
+                snap = global_engine_health.snapshot()
+                await self._send(writer, 503, {
+                    "status": "stalled",
+                    "reason": snap.get("reason"),
+                    "stalled_for_s": snap.get("stalled_for_s"),
+                    "retry_after": snap.get("retry_after"),
+                })
         elif path == "/metrics" and method == "GET":
             handler_metrics = (
                 {n: _jsonable(h.get_metrics()) for n, h in self.handlers.items()}

@@ -123,6 +123,26 @@ class MetricsDashboard:
                             dashboard.snapshot(), default=str
                         ).encode()
                         ctype = "application/json"
+                elif path == "/healthz":
+                    # APIServer parity: a watchdog-declared engine stall
+                    # is a 503 with retry_after, not a quiet 200.
+                    from pilottai_tpu.reliability import (
+                        global_engine_health,
+                    )
+
+                    snap = global_engine_health.snapshot()
+                    stalled = snap.get("stalled")
+                    body = json.dumps(
+                        {"status": "stalled", "reason": snap.get("reason"),
+                         "retry_after": snap.get("retry_after")}
+                        if stalled else {"status": "ok"}
+                    ).encode()
+                    self.send_response(503 if stalled else 200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 elif path == "/slo.json":
                     body = json.dumps(
                         global_slo.snapshot(), default=str

@@ -10,10 +10,11 @@ JSON. This tool makes it mechanical:
     python scripts/bench_diff.py A.json B.json --threshold 0.15 \
         --fail-on-regression                            # CI gate mode
 
-It walks the top level, every ``models.<section>`` block and every
-``SLO.classes.<class>`` block, compares numeric metrics whose direction
-it knows (steps/s, MFU, attainment, busy_frac up = good; p50/p99,
-host_gap, burn_rate, overhead fractions down = good), and prints a
+It walks the top level, every ``models.<section>`` block, every
+``SLO.classes.<class>`` block and the ``RECOVERY`` block, compares
+numeric metrics whose direction it knows (steps/s, MFU, attainment,
+busy_frac, recovered_frac up = good; p50/p99, host_gap, burn_rate,
+recovery_ms, tokens_replayed, overhead fractions down = good), and prints a
 readable table with deltas, flagging moves beyond ``--threshold``
 (default 10%). ``x/y`` success strings compare as ratios. Keys with no
 known direction (config echoes, counts) are skipped.
@@ -39,10 +40,18 @@ HIGHER_BETTER = (
     "steps_per_sec", "tokens_per_sec", "mfu", "attainment", "busy_frac",
     "chunk_utilization", "vs_baseline", "success", "hit_rate",
     "critical_path_frac", "completed",
+    # RECOVERY section (ISSUE 9): fraction of fault-interrupted requests
+    # that completed anyway.
+    "recovered_frac", "outputs_identical", "fault_fired",
 )
 LOWER_BETTER = (
     "overhead_frac", "straggler_frac", "p50", "p90", "p99", "host_gap",
     "burn_rate", "_ms", "latency", "shed", "errors", "missed", "drain_s",
+    # RECOVERY section: recovery_ms_* already match "_ms"; replayed
+    # tokens, failure-path rebuilds, strikes-exhausted failures and
+    # fold-poison counts are all cost.
+    "tokens_replayed", "rebuilds", "recovery_failed", "poisoned",
+    "degrade_level", "watchdog_stalls",
 )
 
 
@@ -158,11 +167,17 @@ def _sections(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     doc = _unwrap(doc)
     out: Dict[str, Dict[str, Any]] = {"top": {}}
     for key, value in doc.items():
-        if key in ("models", "SLO", "phases"):
+        if key in ("models", "SLO", "phases", "RECOVERY"):
             continue
         num = _numeric(value)
         if num is not None:
             out["top"][key] = num
+    recovery = doc.get("RECOVERY")
+    if isinstance(recovery, dict):
+        out["recovery"] = {
+            k: n for k, v in recovery.items()
+            if (n := _numeric(v)) is not None
+        }
     for name, block in (doc.get("models") or {}).items():
         if isinstance(block, dict):
             out[f"models.{name}"] = {

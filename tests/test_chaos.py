@@ -37,8 +37,13 @@ from pilottai_tpu.reliability import (
     CircuitBreaker,
     CircuitOpenError,
     DeadlineExceeded,
+    DegradeLadder,
+    EngineHealth,
     EngineOverloaded,
     FaultInjector,
+    PoisonedOutput,
+    Watchdog,
+    global_engine_health,
     global_injector,
     inject,
 )
@@ -50,8 +55,10 @@ pytestmark = pytest.mark.chaos
 @pytest.fixture(autouse=True)
 def _clean_injector():
     global_injector.reset()
+    global_engine_health.reset()
     yield
     global_injector.reset()
+    global_engine_health.reset()
 
 
 def _tiny_batcher(max_seq=64, n_slots=2, **kw):
@@ -394,10 +401,11 @@ def test_queue_depth_shedding_while_inflight_completes():
 
 
 def test_injected_step_failure_fails_occupied_not_queued():
-    """Satellite: chaos-driven regression for the device-failure path —
-    _fail_occupied_slots fails the in-flight request with the ORIGINAL
-    exception; the queued request survives and completes."""
-    b = _tiny_batcher(n_slots=1)
+    """Chaos regression for the device-failure path with recovery OFF
+    (recovery_max_attempts=0, the pre-0.10 contract): the in-flight
+    request fails with the ORIGINAL exception; the queued request
+    survives and completes."""
+    b = _tiny_batcher(n_slots=1, recovery_max_attempts=0)
     global_injector.arm(
         "engine.step", RuntimeError("injected device failure"), times=1
     )
@@ -447,6 +455,413 @@ def test_chaos_soak_probabilistic_step_failures():
         assert isinstance(out, list)
     finally:
         b.stop()
+
+
+# ----------------------- engine fault domain ---------------------------- #
+# In-flight recovery, the device watchdog, poison containment and the
+# degradation ladder (ISSUE 9). Everything here drives the failure paths
+# through the named injection registry — no monkeypatching.
+
+
+def test_injected_step_failure_recovers_in_flight_byte_identical():
+    """Acceptance: an injected engine.step failure mid-decode → every
+    in-flight request completes with byte-identical greedy output vs an
+    uninjected run, zero client-visible errors, engine.rebuilds == 1."""
+    from pilottai_tpu.obs import global_blackbox
+
+    b = _tiny_batcher(n_slots=2)
+    b.start()
+    try:
+        prompts = [[3, 4, 5], [6, 7]]
+        ref = [
+            b.submit(GenRequest(prompt_ids=list(p), max_new_tokens=12))
+            .result(timeout=120)
+            for p in prompts
+        ]
+        before = global_metrics.get("engine.rebuilds")
+        global_injector.arm(
+            "engine.step", RuntimeError("injected device failure"), times=1
+        )
+        futs = [
+            b.submit(GenRequest(prompt_ids=list(p), max_new_tokens=12))
+            for p in prompts
+        ]
+        got = [f.result(timeout=120) for f in futs]  # no client errors
+        assert got == ref
+        assert global_injector.fired("engine.step") == 1
+        assert global_metrics.get("engine.rebuilds") == before + 1
+        assert global_metrics.get("engine.recovered_requests") >= 1
+        # Satellite: the failure-path rebuild writes a black-box dump
+        # and counts under engine.rebuilds{reason=} (was log-lines only).
+        assert any(
+            r["reason"] == "engine_rebuild" for r in global_blackbox.recent(20)
+        )
+        assert global_metrics.get("engine.rebuilds.device_loop_error") >= 1
+    finally:
+        b.stop()
+
+
+def test_recovery_replays_folded_tokens_and_streams_without_duplicates():
+    """Mid-decode fault AFTER tokens already streamed: the re-admission
+    re-prefills over prompt+generated (tokens_replayed counts them), the
+    stream resumes at the next NEW token (no duplicates — the collected
+    stream equals the final result), and greedy output matches the
+    uninjected run."""
+    b = _tiny_batcher(n_slots=1)
+    b.start()
+    try:
+        ref = b.submit(
+            GenRequest(prompt_ids=[3, 4, 5], max_new_tokens=64)
+        ).result(timeout=120)
+        before = global_metrics.get("engine.tokens_replayed")
+        got: list = []
+        req = GenRequest(
+            prompt_ids=[3, 4, 5], max_new_tokens=64,
+            on_tokens=lambda ids: got.extend(ids),
+        )
+        fut = b.submit(req)
+        # Wait until real tokens have folded, THEN break the device.
+        t_end = time.time() + 60
+        while time.time() < t_end and not got:
+            time.sleep(0.005)
+        assert got, "no tokens streamed before arming the fault"
+        global_injector.arm(
+            "engine.step", RuntimeError("mid-decode device failure"), times=1
+        )
+        out = fut.result(timeout=120)
+        assert out == ref
+        assert got == out  # stream == result: nothing duplicated or lost
+        assert global_metrics.get("engine.tokens_replayed") > before
+        assert req.recovery_attempts == 1
+    finally:
+        b.stop()
+
+
+def test_recovery_strikes_exhausted_fails_with_original_exception():
+    """N strikes → the ORIGINAL exception surfaces, and the engine stays
+    serviceable for new work afterwards."""
+    b = _tiny_batcher(n_slots=1, recovery_max_attempts=2)
+    b.start()
+    try:
+        before = global_metrics.get("engine.recovery_failed")
+        with inject(
+            "engine.step", RuntimeError("persistent device failure"),
+            times=None,
+        ):
+            fut = b.submit(GenRequest(prompt_ids=[3, 4], max_new_tokens=8))
+            with pytest.raises(RuntimeError, match="persistent device"):
+                fut.result(timeout=120)
+        assert global_metrics.get("engine.recovery_failed") >= before + 1
+        out = b.submit(
+            GenRequest(prompt_ids=[5, 6], max_new_tokens=4)
+        ).result(timeout=120)
+        assert isinstance(out, list) and len(out) >= 1
+    finally:
+        b.stop()
+
+
+def test_prefill_dispatch_failure_unwinds_prep_and_recovers():
+    """Satellite: injected ``engine.prefill`` failure against a
+    _PreparedAdmission mid-flight — slot reservations (``_prep_reserved``)
+    and allocated pages fully release (no leak), admission resumes, and
+    the group's requests complete via bounded re-admission."""
+    b = _tiny_batcher(
+        n_slots=2, paged=True, page_size=16, overlap_admission=True,
+    )
+    before = global_metrics.get("engine.recovery_requeued")
+    global_injector.arm(
+        "engine.prefill", RuntimeError("injected prefill fault"), times=1
+    )
+    b.start()
+    try:
+        futs = [
+            b.submit(GenRequest(prompt_ids=[3 + i, 4, 5], max_new_tokens=6))
+            for i in range(2)
+        ]
+        for fut in futs:
+            out = fut.result(timeout=120)
+            assert isinstance(out, list) and len(out) >= 1
+        assert global_injector.fired("engine.prefill") == 1
+        assert global_metrics.get("engine.recovery_requeued") >= before + 1
+        # Resources fully unwound once everything completed: no leaked
+        # reservation (admission would wedge) and no leaked pages (the
+        # pool would shrink forever).
+        t_end = time.time() + 30
+        while time.time() < t_end and (
+            b._prep_reserved or b.alloc.free_pages < b.num_pages - 1
+        ):
+            time.sleep(0.05)
+        assert b._prep_reserved == set()
+        assert b.alloc.free_pages == b.num_pages - 1
+    finally:
+        b.stop()
+
+
+def test_fold_corruption_poisons_only_affected_request():
+    """Poison containment: an injected out-of-vocab fold fails ONLY the
+    affected request (PoisonedOutput); the other occupant completes and
+    the engine stays serviceable."""
+    b = _tiny_batcher(n_slots=2)
+    b.start()
+    try:
+        r1 = GenRequest(prompt_ids=[3, 4, 5], max_new_tokens=48)
+        r2 = GenRequest(prompt_ids=[6, 7], max_new_tokens=48)
+        f1, f2 = b.submit(r1), b.submit(r2)
+        # Wait for both to occupy slots, then poison r2's slot.
+        t_end = time.time() + 60
+        idx = None
+        while time.time() < t_end and idx is None:
+            idx = next(
+                (
+                    i for i, s in enumerate(b._slots)
+                    if s is not None and s.request is r2
+                ),
+                None,
+            )
+            time.sleep(0.005)
+        assert idx is not None
+        before = global_metrics.get("engine.poisoned")
+        global_injector.arm("engine.fold.corrupt", value=idx, times=1)
+        with pytest.raises(PoisonedOutput, match="out-of-vocab"):
+            f2.result(timeout=120)
+        out = f1.result(timeout=120)  # the other occupant is untouched
+        assert isinstance(out, list) and len(out) >= 1
+        assert global_metrics.get("engine.poisoned") == before + 1
+        out2 = b.submit(
+            GenRequest(prompt_ids=[9, 9], max_new_tokens=4)
+        ).result(timeout=120)
+        assert isinstance(out2, list)
+    finally:
+        b.stop()
+
+
+# ----------------------------- watchdog --------------------------------- #
+
+
+def test_watchdog_unit_trip_and_recover():
+    """Deterministic (fake-clock) watchdog semantics: idle never trips;
+    stale heartbeats WITH work trip (breaker force-opened via the health
+    registry, on_stall fired); a late beat recovers."""
+    health = EngineHealth()
+    br = CircuitBreaker(name="wd-unit")
+    health.subscribe(br.on_engine_stall)
+    stalls: list = []
+    busy = {"v": False}
+    t = {"now": 0.0}
+    wd = Watchdog(
+        stall_s=1.0, has_work=lambda: busy["v"],
+        on_stall=stalls.append, health=health,
+        clock=lambda: t["now"], poll_s=0.005,
+    )
+    wd.start()
+    try:
+        def wait_for(cond, timeout=5.0):
+            end = time.time() + timeout
+            while time.time() < end and not cond():
+                time.sleep(0.005)
+            assert cond()
+
+        t["now"] = 50.0  # huge clock jump while IDLE: never a stall
+        time.sleep(0.05)
+        assert health.healthy()
+        busy["v"] = True
+        t["now"] = 50.5  # busy but not stale yet
+        time.sleep(0.05)
+        assert health.healthy()
+        t["now"] = 52.0  # stale with work in flight → stalled
+        wait_for(lambda: not health.healthy())
+        assert br.state == "open"
+        assert stalls and stalls[0]["stall_s"] == 1.0
+        assert global_metrics.get("engine.watchdog_stalls") >= 1
+        wd.beat()  # the hang resolved
+        wait_for(health.healthy)
+    finally:
+        wd.stop()
+
+
+def test_watchdog_trips_on_hung_dispatch_then_engine_recovers():
+    """Acceptance: an injected dispatch hang (a stuck XLA call — never
+    raises, never reaches an except arm) trips the watchdog within
+    stall_s + grace: health flips, the subscribed breaker force-opens,
+    a black-box dump is written. When the hang resolves the request
+    still completes and health recovers."""
+    from pilottai_tpu.obs import global_blackbox
+
+    b = _tiny_batcher(n_slots=1, watchdog_stall_s=0.5)
+    b.start()
+    try:
+        # Prime: compiles the admission + decode executables so the
+        # injected phase measures the hang, not the compiler.
+        b.submit(
+            GenRequest(prompt_ids=[3, 4, 5], max_new_tokens=8)
+        ).result(timeout=120)
+        global_engine_health.reset()  # drop any compile-phase stall
+        br = CircuitBreaker(name="wd-hang")
+        global_engine_health.subscribe(br.on_engine_stall)
+        before = global_metrics.get("engine.watchdog_stalls")
+        global_injector.arm("engine.dispatch.hang", delay=2.5, times=1)
+        fut = b.submit(GenRequest(prompt_ids=[3, 4, 5], max_new_tokens=8))
+        # Trip within stall_s + grace (poll granularity + scheduling).
+        t_end = time.time() + 2.0
+        while time.time() < t_end and global_engine_health.healthy():
+            time.sleep(0.01)
+        assert not global_engine_health.healthy()
+        assert global_engine_health.snapshot()["retry_after"] > 0
+        assert global_metrics.get("engine.watchdog_stalls") >= before + 1
+        # The subscriber fires right after the health flip — poll
+        # briefly rather than racing mark_stalled's callback loop.
+        t_end = time.time() + 2.0
+        while time.time() < t_end and br.state != "open":
+            time.sleep(0.01)
+        assert br.state == "open"  # new requests now fast-fail 503
+        assert any(
+            r["reason"] == "watchdog_stall"
+            for r in global_blackbox.recent(20)
+        )
+        # The hang resolves: the request completes and health recovers.
+        out = fut.result(timeout=120)
+        assert isinstance(out, list) and len(out) >= 1
+        t_end = time.time() + 5.0
+        while time.time() < t_end and not global_engine_health.healthy():
+            time.sleep(0.01)
+        assert global_engine_health.healthy()
+    finally:
+        b.stop()
+
+
+@pytest.mark.asyncio
+async def test_healthz_and_chat_503_when_engine_stalled():
+    """HTTP surface of a stall: /healthz flips to 503 with retry_after;
+    the handler's breaker (subscribed at construction) force-opens so
+    chat requests fast-fail 503 with retry_after."""
+    from pilottai_tpu.server import APIServer
+
+    h = _handler(MockBackend(), breaker_recovery_timeout=60.0)
+    server = await APIServer(h).start()
+    try:
+        status, _ = await _request(server.port, "GET", "/healthz")
+        assert status == 200
+        global_engine_health.mark_stalled(
+            reason="device loop heartbeat stale (test)", retry_after=2.5,
+        )
+        status, data = await _request(server.port, "GET", "/healthz")
+        assert status == 503
+        assert data["status"] == "stalled"
+        assert data["retry_after"] == 2.5
+        assert "stale" in data["reason"]
+        status, data = await _request(
+            server.port, "POST", "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "hi"}]},
+        )
+        assert status == 503
+        assert data["error"]["type"] == "overloaded_error"
+        assert data["error"]["retry_after"] > 0
+        global_engine_health.mark_recovered()
+        status, _ = await _request(server.port, "GET", "/healthz")
+        assert status == 200
+    finally:
+        await server.stop()
+
+
+# ------------------------- degradation ladder --------------------------- #
+
+
+def test_degrade_ladder_steps_and_promotes_on_clean_soak():
+    t = {"now": 0.0}
+    lad = DegradeLadder(
+        fault_threshold=2, window_s=10.0, promote_s=30.0,
+        clock=lambda: t["now"],
+    )
+    assert lad.level() == 0
+    lad.record_fault("a")
+    assert lad.level() == 0  # below threshold
+    lad.record_fault("b")
+    assert lad.level() == 1  # burst crossed the threshold
+    lad.record_fault("c")
+    lad.record_fault("d")
+    assert lad.level() == 2  # each rung needs a fresh burst
+    # Faults outside the rolling window never accumulate into a step.
+    t["now"] = 100.0
+    lad.record_fault("e")
+    t["now"] = 120.0  # > window_s later
+    lad.record_fault("f")
+    assert lad.level() <= 2
+    # Clean soak: one rung back per promote_s period.
+    t["now"] = 300.0
+    assert lad.level() == 0
+    # Disabled ladder counts faults but never steps.
+    off = DegradeLadder(fault_threshold=1, enabled=False)
+    off.record_fault("x")
+    off.record_fault("y")
+    assert off.level() == 0
+
+
+def test_degrade_rungs_cap_chunks_slots_and_shed_batch():
+    """Batcher integration: rung 2 clamps dispatches to the smallest
+    compiled chunk bucket, rung 3 halves admissible slots, rung 4 sheds
+    batch-class submits outright while interactive still queues."""
+    from pilottai_tpu.engine.batcher import _Slot
+
+    lad = DegradeLadder(fault_threshold=1, window_s=60.0, promote_s=3600.0)
+    b = _tiny_batcher(n_slots=4, degrade=lad, max_queue_depth=16)
+    # Rung 2: a slot needing ~100 tokens would normally take the largest
+    # bucket; degraded it takes the smallest.
+    b._slots[0] = _Slot(
+        request=GenRequest(prompt_ids=[1, 2], max_new_tokens=100),
+        prompt_len=2,
+    )
+    assert b._pick_chunk_blocks() == b.chunk_buckets[-1]
+    lad.record_fault("t")
+    lad.record_fault("t")
+    assert lad.level() == 2
+    assert b._pick_chunk_blocks() == b.chunk_buckets[0]
+    b._slots[0] = None
+    # Rung 3: selection caps occupancy at n_slots // 2.
+    lad.record_fault("t")
+    assert lad.level() == 3
+    for i in range(4):
+        b._backlog.append(GenRequest(prompt_ids=[3 + i], max_new_tokens=4))
+    groups, seg, _epoch = b._select_groups()
+    assert seg is None
+    assert sum(len(g) for _, g in groups) == 2
+    for _, g in groups:  # unwind the white-box selection
+        for idx, req in g:
+            b._prep_reserved.discard(idx)
+    b._backlog.clear()
+    # Rung 4: batch sheds outright (empty queue!), interactive queues.
+    lad.record_fault("t")
+    assert lad.level() == 4
+    before = global_metrics.get("engine.shed.batch")
+    with pytest.raises(EngineOverloaded, match="shedding batch-class"):
+        b.submit(GenRequest(
+            prompt_ids=[5], max_new_tokens=2, slo_class="batch",
+        ))
+    assert global_metrics.get("engine.shed.batch") == before + 1
+    fut = b.submit(GenRequest(prompt_ids=[5], max_new_tokens=2))
+    assert not fut.done()  # interactive accepted (engine not started)
+
+
+def test_batch_class_sheds_at_lower_queue_depth():
+    """Satellite: per-SLO-class shed thresholds — batch sheds at
+    batch_shed_frac × max_queue_depth, interactive at the full depth,
+    each counted under engine.shed.<class>."""
+    b = _tiny_batcher(n_slots=1, max_queue_depth=4, batch_shed_frac=0.5)
+    b.submit(GenRequest(prompt_ids=[1], max_new_tokens=2))
+    b.submit(GenRequest(prompt_ids=[2], max_new_tokens=2))
+    # Depth 2 == the batch limit (4 × 0.5): batch sheds...
+    before = global_metrics.get("engine.shed.batch")
+    with pytest.raises(EngineOverloaded, match="batch-class limit 2"):
+        b.submit(GenRequest(
+            prompt_ids=[3], max_new_tokens=2, slo_class="batch",
+        ))
+    assert global_metrics.get("engine.shed.batch") == before + 1
+    # ...while interactive still gets the remaining depth.
+    b.submit(GenRequest(prompt_ids=[4], max_new_tokens=2))
+    b.submit(GenRequest(prompt_ids=[5], max_new_tokens=2))
+    before_i = global_metrics.get("engine.shed.interactive")
+    with pytest.raises(EngineOverloaded, match="interactive-class limit 4"):
+        b.submit(GenRequest(prompt_ids=[6], max_new_tokens=2))
+    assert global_metrics.get("engine.shed.interactive") == before_i + 1
 
 
 # ----------------------------- HTTP edge -------------------------------- #

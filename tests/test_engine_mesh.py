@@ -150,6 +150,10 @@ def test_rebuild_requeues_later_groups():
     batcher = ContinuousBatcher(
         cfg, params, n_slots=2, max_seq_len=64, cache_dtype=jnp.float32,
         admit_batch=1, paged=True, page_size=8,
+        # Recovery off: this regression pins the REQUEUE of later groups
+        # after a mid-wave rebuild; with recovery on, req1 would simply
+        # re-admit and complete too (that contract is test_chaos.py's).
+        recovery_max_attempts=0,
     )
     real_admit = bmod.admit_group
     calls = {"n": 0}

@@ -18,11 +18,11 @@ from pilottai_tpu.parallel.mesh import MeshConfig, create_mesh
 from pilottai_tpu.parallel.sharding import shard_params
 
 
-def _tiny_batcher(max_seq=64, n_slots=2):
+def _tiny_batcher(max_seq=64, n_slots=2, **kw):
     cfg = get_model_config("llama-tiny")
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
     return ContinuousBatcher(cfg, params, n_slots=n_slots, max_seq_len=max_seq,
-                             cache_dtype=jnp.float32), cfg
+                             cache_dtype=jnp.float32, **kw), cfg
 
 
 def test_submit_truncation_never_noop():
@@ -202,10 +202,13 @@ def test_donated_admit_failure_rebuilds_state():
     """admit_group donates cache/dstate/sampling; a dispatch failure that
     consumed them must not leave the engine pointing at deleted buffers —
     in-flight work fails loudly, state is rebuilt, and the engine serves
-    the next request (code-review finding, round 2)."""
+    the next request (code-review finding, round 2). Recovery is OFF
+    here so the ORIGINAL failure surfaces after one attempt and the
+    rebuild machinery is tested surgically (recovery's own contract
+    lives in tests/test_chaos.py)."""
     import pilottai_tpu.engine.batcher as bmod
 
-    batcher, cfg = _tiny_batcher()
+    batcher, cfg = _tiny_batcher(recovery_max_attempts=0)
     real_admit = bmod.admit_group
 
     def poison(params, cfg_, cache, dstate, sampling, *a, **k):
