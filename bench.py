@@ -570,6 +570,91 @@ async def bench_recovery(cfg, n_requests=6, max_new_tokens=48):
         gc.collect()
 
 
+async def bench_kvcache(cfg, n_sessions=6, turns=3, max_new_tokens=24):
+    """KVCACHE section (ISSUE 10): multi-turn session workload against
+    the global KV cache tier. ``n_sessions`` conversations interleave
+    round-robin, each turn re-sending the session's full transcript
+    (the multi-turn agent shape). The device-resident store is
+    deliberately tiny (``engine_prefix_cache`` in cfg), so by the time
+    a session's next turn arrives its entry has been evicted — and with
+    the host tier enabled the eviction SPILLED instead of discarding,
+    so the resume restores from host RAM and prefills only the new
+    tail. Headlines: ``prefix_hit_rate`` (hits ÷ lookups; > 0 on resume
+    after eviction is the acceptance bar), ``prefill_tokens_saved`` and
+    restore p50/p99 (host-side staging wall). Greedy parity tier on/off
+    is pinned by tests/test_kvcache.py, not re-measured here."""
+    from pilottai_tpu.engine.handler import LLMHandler
+    from pilottai_tpu.engine.types import GenerationParams
+    from pilottai_tpu.utils.metrics import global_metrics as _gm
+
+    handler = LLMHandler(cfg)
+    await handler.start()
+    try:
+        # Per-session preambles diverge immediately (distinct lineages:
+        # cross-session LCP entries must not mask the cold tier) and
+        # clear the store's 64-token entry floor on their own.
+        def preamble(s):
+            return (
+                f"Session {s:03d} memory: persona agent-{s}; "
+                f"goals g{s * 7}, g{s * 11}; constraints c{s * 13}. "
+                + PREAMBLE
+            )
+
+        history = {s: "" for s in range(n_sessions)}
+        counters = (
+            "lookups", "hits", "host_hits", "spills", "restores",
+            "prefill_tokens_saved",
+        )
+        before = {
+            k: _gm.get(f"engine.kvcache.{k}") for k in counters
+        }
+        _gm.reset_histograms("engine.kvcache.restore_ms")
+        t0 = time.perf_counter()
+        for turn in range(turns):
+            for s in range(n_sessions):
+                prompt = (
+                    preamble(s) + history[s]
+                    + f"\nuser: next step for item {turn}?\nassistant:"
+                )
+                params = GenerationParams(
+                    max_new_tokens=max_new_tokens, temperature=0.0,
+                    session_id=f"bench-sess-{s}",
+                )
+                reply = await handler.apredict(prompt, params=params)
+                history[s] += (
+                    f"\nuser: next step for item {turn}?"
+                    f"\nassistant: {reply}"
+                )
+        wall = time.perf_counter() - t0
+        delta = {
+            k: _gm.get(f"engine.kvcache.{k}") - before[k] for k in counters
+        }
+        hist = (
+            _gm.snapshot()["histograms"].get("engine.kvcache.restore_ms")
+            or {}
+        )
+        return {
+            "prefix_hit_rate": round(
+                delta["lookups"] and delta["hits"] / delta["lookups"], 4
+            ),
+            "prefill_tokens_saved": int(delta["prefill_tokens_saved"]),
+            "host_hits": int(delta["host_hits"]),
+            "spills": int(delta["spills"]),
+            "restores": int(delta["restores"]),
+            "restore_ms_p50": hist.get("p50"),
+            "restore_ms_p99": hist.get("p99"),
+            "host_bytes": int(_gm.get("engine.kvcache.host_bytes")),
+            "sessions": n_sessions,
+            "turns": turns,
+            "requests": n_sessions * turns,
+            "wall_s": round(wall, 2),
+            "model": cfg.model_name,
+        }
+    finally:
+        await handler.stop()
+        gc.collect()
+
+
 async def bench_pipeline(provider: str, rounds: int = 4):
     """BASELINE config #3 through the orchestrator: Serve + manager + 3
     specialists on the document pipeline, real engine, measured at
@@ -970,6 +1055,31 @@ async def run_bench():
         _note("recovery FAILED", {"error": str(exc)})
         sec_recovery = {"recovery_error": str(exc)}
 
+    # Section 8: global KV cache tier (ISSUE 10) — multi-turn sessions
+    # against a deliberately tiny device-resident store, so session
+    # resumes exercise the spill→restore path: hit-rate > 0 with
+    # restores > 0 means the cold tier served KV that eviction would
+    # previously have thrown away.
+    sec_kvcache = None
+    try:
+        sec_kvcache = await bench_kvcache(
+            LLMConfig(
+                model_name="llama3-1b-byte" if on_accel else "llama-tiny",
+                engine_slots=4, engine_chunk=8,
+                # Two hot entries vs six sessions: every resume lands
+                # after its entry was evicted (and spilled).
+                engine_prefix_cache=2,
+                engine_kvcache_host_mb=256,
+                **common,
+            ),
+            n_sessions=6 if on_accel else 4,
+            turns=3 if on_accel else 2,
+        )
+        _note("kvcache", sec_kvcache)
+    except Exception as exc:  # noqa: BLE001 — keep earlier sections
+        _note("kvcache FAILED", {"error": str(exc)})
+        sec_kvcache = {"kvcache_error": str(exc)}
+
     headline = sec_8b or sec_1b
     out = {
         "metric": "agent_steps_per_sec_per_chip",
@@ -1017,6 +1127,12 @@ async def run_bench():
             sec_recovery.get("recovered_frac") if sec_recovery else None
         ),
         "RECOVERY": sec_recovery,
+        # KV cache tier headline (ISSUE 10): session-resume hit rate on
+        # the multi-turn workload (full breakdown under KVCACHE).
+        "kvcache_prefix_hit_rate": (
+            sec_kvcache.get("prefix_hit_rate") if sec_kvcache else None
+        ),
+        "KVCACHE": sec_kvcache,
         **sec_pipeline,
         **(sec_swarm or {}),
         # Orchestrator-path phase percentiles: traffic since the last

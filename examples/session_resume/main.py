@@ -1,0 +1,97 @@
+"""Session resume through the global KV cache tier (ISSUE 10).
+
+A multi-turn conversation re-sends its whole transcript every turn —
+the exact workload where prefill dominates. This example runs several
+interleaved sessions against an engine whose device-resident prefix
+store is deliberately tiny, so each session's entry is evicted between
+its turns; with the host-RAM cold tier enabled
+(``engine_kvcache_host_mb``) the eviction spills instead of discarding,
+and the resume restores from host memory — only the new tail prefills.
+
+Run (CPU, no checkpoint needed):
+
+    python -m examples.session_resume.main
+
+Over HTTP the same behavior is driven by the ``session_id`` body field
+or the ``x-session-id`` header on ``/v1/chat/completions``
+(docs/SERVING.md).
+"""
+
+import asyncio
+
+from pilottai_tpu.core.config import LLMConfig
+from pilottai_tpu.engine.handler import LLMHandler
+from pilottai_tpu.engine.types import GenerationParams
+from pilottai_tpu.utils.metrics import global_metrics
+
+SESSIONS = 4
+TURNS = 3
+
+KV_COUNTERS = (
+    "lookups", "hits", "host_hits", "spills", "restores",
+    "prefill_tokens_saved",
+)
+
+
+def _snapshot():
+    return {k: global_metrics.get(f"engine.kvcache.{k}") for k in KV_COUNTERS}
+
+
+async def main() -> None:
+    handler = LLMHandler(LLMConfig(
+        model_name="llama-tiny",
+        provider="cpu",
+        dtype="float32",
+        engine_slots=4,
+        engine_max_seq=512,
+        engine_chunk=8,
+        # Two hot entries vs four sessions: resumes always land after
+        # eviction — the cold tier is what makes them cheap anyway.
+        engine_prefix_cache=2,
+        engine_kvcache_host_mb=128,
+    ))
+    await handler.start()
+    before = _snapshot()
+    try:
+        history = {s: "" for s in range(SESSIONS)}
+        for turn in range(TURNS):
+            for s in range(SESSIONS):
+                # Distinct per-session preamble = distinct KV lineage.
+                prompt = (
+                    f"Session {s:03d} memory: persona agent-{s}; "
+                    f"goals g{s * 7}, g{s * 11}. You are a planning "
+                    f"assistant; answer in one short sentence."
+                    + history[s]
+                    + f"\nuser: what is step {turn + 1}?\nassistant:"
+                )
+                reply = await handler.apredict(
+                    prompt,
+                    params=GenerationParams(
+                        max_new_tokens=24, temperature=0.0,
+                        session_id=f"demo-session-{s}",
+                    ),
+                )
+                history[s] += (
+                    f"\nuser: what is step {turn + 1}?"
+                    f"\nassistant: {reply}"
+                )
+                print(f"[session {s} turn {turn + 1}] {reply[:60]!r}")
+    finally:
+        after = _snapshot()
+        await handler.stop()
+
+    delta = {k: int(after[k] - before[k]) for k in KV_COUNTERS}
+    rate = delta["hits"] / delta["lookups"] if delta["lookups"] else 0.0
+    print("\nKV cache tier over this run:")
+    for k, v in delta.items():
+        print(f"  {k:>22}: {v}")
+    print(f"  {'prefix_hit_rate':>22}: {rate:.2f}")
+    if delta["restores"]:
+        print(
+            "\nSession resumes restored spilled KV from host RAM instead "
+            "of re-prefilling the transcript."
+        )
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -229,6 +229,29 @@ class LLMConfig(BaseModel):
     # Entry HBM cost: 2 (K and V) x L x K x bucket(len, cap 1024) x H x
     # itemsize — ~67 MB for llama3-8b bf16 at bucket 512.
     engine_prefix_cache: int = Field(default=4, ge=0)
+    # Global KV cache tier (engine/kvcache/): host-RAM cold-tier budget
+    # in MB. Evicted prefix KV (dense panel entries, paged chain pages)
+    # spills to pinned host buffers via async D2H instead of being
+    # dropped; a session resume or repeated preamble restores via async
+    # H2D instead of re-prefilling. 0 disables the cold tier (evictions
+    # discard KV — the pre-tier behavior). Greedy output is
+    # byte-identical on/off (tests/test_kvcache.py).
+    engine_kvcache_host_mb: int = Field(default=0, ge=0)
+    # Tier eviction policy ("cost" | "lru"): "cost" scores entries by
+    # recency x reconstruction cost (prefill FLOPs saved per byte held),
+    # so densely packed preambles outlive equally old mostly-padding
+    # entries; "lru" is plain recency. Applies to the device-resident
+    # dense store and the host tier.
+    engine_kvcache_policy: str = Field(default="cost")
+
+    @field_validator("engine_kvcache_policy")
+    @classmethod
+    def _valid_kvcache_policy(cls, v: str) -> str:
+        if v not in ("cost", "lru"):
+            raise ValueError(
+                "engine_kvcache_policy must be 'cost' or 'lru'"
+            )
+        return v
     # Adaptive draft-model speculation: >0 enables shallow-layer
     # self-drafting (the target's own first N layers + unembed propose
     # drafts — LayerSkip-style, no second checkpoint, no extra HBM) for

@@ -87,6 +87,7 @@ from pilottai_tpu.engine.decode import (
     pack_admit_meta,
     release_decode,
 )
+from pilottai_tpu.engine.kvcache import KVCacheIndex, SpillCopy
 from pilottai_tpu.engine.page_prefix import PagePrefixIndex
 from pilottai_tpu.engine.prefix_cache import PrefixStore
 from pilottai_tpu.engine.sampling import SamplingState
@@ -163,6 +164,12 @@ class GenRequest:
     # degradation ladder's last rung sheds it outright. None =
     # interactive semantics.
     slo_class: Optional[str] = None
+    # KV-cache session handle (engine/kvcache/): multi-turn agent
+    # conversations send the same id every turn, pinning their KV
+    # lineage in the host tier across device-cache evictions — a resume
+    # restores from host RAM instead of re-prefilling the whole
+    # history. None = anonymous (cacheable, but not eviction-pinned).
+    session_id: Optional[str] = None
     # In-flight recovery bookkeeping (engine fault domain): on a
     # device/reader failure the batcher snapshots this request's
     # progress and re-admits it — ``recovered_tokens`` carries the
@@ -174,6 +181,12 @@ class GenRequest:
     recovery_attempts: int = 0
     recovered_tokens: List[int] = field(default_factory=list)
     recovery_started_at: Optional[float] = None
+    # engine.kvcache.lookups/hits are per-REQUEST counters: a
+    # page-blocked backlog head re-runs the prefix lookup every prep
+    # cycle (~20 ms), and counting each attempt would inflate the
+    # bench's prefix_hit_rate arbitrarily. Set by the first counted
+    # lookup.
+    kv_counted: bool = field(default=False, repr=False)
 
     @property
     def flight_key(self) -> Optional[str]:
@@ -202,28 +215,14 @@ class _Slot:
     hi_pending: int = 0
 
 
-class _HostCopy:
-    """Handle for a device→host read whose transfer was STARTED at
-    dispatch time (``copy_to_host_async``) and is only awaited at fold
-    time — the reader materializes an already-in-flight copy instead of
-    issuing a fresh blocking round trip (``jax.device_get`` would).
-    This is the one sanctioned wait on the fold path; the AST tripwire
-    (tests/test_no_blocking_hotpath.py) allowlists exactly it."""
-
-    __slots__ = ("_arrays",)
-
-    def __init__(self, arrays) -> None:
-        self._arrays = tuple(arrays)
-        for a in self._arrays:
-            try:
-                a.copy_to_host_async()
-            except AttributeError:  # non-jax array types in tests
-                pass
-
-    def wait(self) -> List[np.ndarray]:
-        """Materialize as numpy — blocks only until the copy already in
-        flight lands, never starts a new device round trip."""
-        return [np.asarray(a) for a in self._arrays]
+# Handle for a device→host read whose transfer was STARTED at dispatch
+# time (``copy_to_host_async``) and is only awaited at fold time — the
+# reader materializes an already-in-flight copy instead of issuing a
+# fresh blocking round trip (``jax.device_get`` would). ONE definition
+# shared with the KV cache tier's spill path (the same discipline at
+# eviction time); the AST tripwire (tests/test_no_blocking_hotpath.py)
+# sanctions exactly this shape on both surfaces.
+_HostCopy = SpillCopy
 
 
 @dataclass
@@ -305,6 +304,9 @@ class ContinuousBatcher:
                                                   # (None = default knobs)
         batch_shed_frac: float = 0.5,   # batch-class shed depth as a
                                         # fraction of max_queue_depth
+        kvcache_host_mb: int = 0,       # host-RAM cold tier for evicted
+                                        # prefix KV (0 = off)
+        kvcache_policy: str = "cost",   # tier eviction: "cost" | "lru"
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -572,7 +574,29 @@ class ContinuousBatcher:
                     # around 0.5 GB worst case next to 8 GB of weights
                     # on a 16 GB chip.
                     max_len=min(max_seq_len or cfg.max_seq_len, 1024),
+                    policy=kvcache_policy,
                 )
+        # Global KV cache tier (engine/kvcache/): ONE lookup over the
+        # dense store and the paged radix, plus (when kvcache_host_mb >
+        # 0) the host-RAM cold tier — evictions spill via async D2H and
+        # session resumes restore via async H2D instead of
+        # re-prefilling. Greedy output is byte-identical tier on/off
+        # (tests/test_kvcache.py).
+        self.kvcache: Optional[KVCacheIndex] = None
+        if prefix_cache > 0:
+            self.kvcache = KVCacheIndex(
+                prefix_store=self.prefix_store,
+                page_index=self.page_index,
+                page_size=page_size,
+                host_bytes=int(kvcache_host_mb) * 1024 * 1024,
+                policy=kvcache_policy,
+                get_cache=lambda: self.cache,
+            )
+        # Restored page chains awaiting their device-thread pool write
+        # (engine/kvcache/index.py:PendingRestore; appended under the
+        # slot lock at lookup time, drained by _apply_restores before
+        # any dispatch can read the pages).
+        self._pending_restores: List[Any] = []
         # Slot table / gen / release / first_reads / allocator are shared
         # between the device thread, the reader thread (completion) and
         # the admission-prep thread (selection) — the lock exists before
@@ -691,6 +715,14 @@ class ContinuousBatcher:
         if self._reader is not None:
             self._reader.join(timeout=60)
             self._reader = None
+        # Restores staged but not yet scattered: apply them now (threads
+        # are joined — this thread owns the device state) so a restart
+        # can never serve a registered chain whose pages were never
+        # written.
+        try:
+            self._apply_restores()
+        except Exception:  # noqa: BLE001 — best-effort on shutdown
+            pass
         # Quiesce the device: chunks dispatched right before stop may still
         # be executing, and tearing the process down mid-computation
         # crashes the backend's thread pool at exit.
@@ -1103,11 +1135,37 @@ class ContinuousBatcher:
         Paged cache: block-granular radix match instead — returns a
         PageNode whose ``path_pages`` get mapped (not copied) into the
         slot's block table. No clamp hazard there (writes go through the
-        table), so the only fit check is that the prefix leaves room."""
+        table), so the only fit check is that the prefix leaves room.
+
+        Both shapes route through ONE lookup — the KV cache tier
+        (engine/kvcache/index.py): device-resident hit first, then the
+        host-RAM cold tier, whose hit RESTORES the spilled KV (async
+        H2D staged here on the prep thread; the pool write for paged
+        chains runs on the device thread via _apply_restores) instead
+        of re-prefilling. Called under the slot lock."""
+        if self.kvcache is None or self._warming:
+            # Warmup gate: the sweep's ascending same-start prompts
+            # would otherwise hit earlier rungs' entries and admit via
+            # the tail path — skipping the full-prefill compile the
+            # sweep exists to guarantee.
+            return None
+        count = not req.kv_counted
+        req.kv_counted = True
         if self.page_index is not None:
-            if self._warming:
-                return None
-            node = self.page_index.match(req.prompt_ids)
+            need = min(
+                len(req.prompt_ids) + req.max_new_tokens, self.max_seq_len
+            )
+            node, rec = self.kvcache.lookup_paged(
+                req.prompt_ids,
+                session_id=req.session_id,
+                alloc=self.alloc,
+                max_seq_len=self.max_seq_len,
+                need_tokens=need,
+                epoch=self._alloc_epoch,
+                count=count,
+            )
+            if rec is not None:
+                self._pending_restores.append(rec)
             if node is None:
                 return None
             if node.depth * self.page_size >= self.max_seq_len:
@@ -1115,21 +1173,18 @@ class ContinuousBatcher:
             return node
         if self.prefix_store is None:
             return None
-        # Warmup gate, mirroring the paged path above: the sweep's
-        # ascending same-start prompts would otherwise hit earlier
-        # rungs' store entries and admit via the tail path — skipping
-        # the full-prefill compile the sweep exists to guarantee.
-        if self._warming:
-            return None
-        entry = self.prefix_store.match(req.prompt_ids)
-        if entry is None:
-            return None
-        plen = len(entry.ids)
-        if plen + self._tail_bucket(len(req.prompt_ids) - plen) > self.max_seq_len:
-            return None
-        if entry.p_bucket > self.max_seq_len:
-            return None
-        return entry
+        n = len(req.prompt_ids)
+
+        def fits(plen: int, p_bucket: int) -> bool:
+            return (
+                plen + self._tail_bucket(n - plen) <= self.max_seq_len
+                and p_bucket <= self.max_seq_len
+            )
+
+        return self.kvcache.lookup_dense(
+            req.prompt_ids, session_id=req.session_id, fits=fits,
+            bucket=self._bucket, count=count,
+        )
 
     def _decode_bucket(self, n: int) -> int:
         """Prefix-bound bucket for a decode chunk: the prefill bucket
@@ -1218,6 +1273,9 @@ class ContinuousBatcher:
         byte-identical output either way). Admits until slots or
         pending run out — completions arrive in waves, and refilling
         only one group per chunk would leave slots idle."""
+        # Pending host-tier restores scatter into the pool FIRST: any
+        # admission this cycle may map the restored pages.
+        self._apply_restores()
         with self._lock:
             released = list(self._release)
             self._release.clear()
@@ -1724,6 +1782,11 @@ class ContinuousBatcher:
         only); the final segment admits through the normal prefix-paged
         path, which samples the first token and installs the slot."""
         idx, req, done = self._segmenting
+        # A segmented admission's chain may include freshly restored
+        # pages (its prefix hit ran the host-tier path at selection):
+        # they must be pool-resident before extend_prompt_paged attends
+        # over them.
+        self._apply_restores()
         if self._seg_epoch != self._alloc_epoch:
             # Device state was rebuilt mid-segmentation (a concurrent
             # dispatch failure consumed the buffers): the KV written so
@@ -1962,6 +2025,12 @@ class ContinuousBatcher:
         decode interleave with no host-side bubble between them."""
         group = prep.group
         entry = prep.entry
+        # Restored page chains must be pool-resident before this
+        # dispatch can read them: drain here (not only in _admit) so a
+        # prep whose restore record landed between _admit's drain and
+        # its own dequeue still scatters first — the drain and this
+        # dispatch share the device thread, so program order holds.
+        self._apply_restores()
         # Chaos point: a slow (delay=) or failed (exc=) admission prefill.
         # Raises land in _dispatch_admissions' per-group failure handling
         # — exactly the production path a device fault would take.
@@ -1998,6 +2067,12 @@ class ContinuousBatcher:
                 # it as a cache hit would report near-100% hit rates on
                 # deployments with the prefix cache disabled.
                 global_metrics.inc("engine.prefix_hits", len(group))
+                # Tokens the shared chain saved this dispatch: every
+                # group member skipped the chain's prefill FLOPs.
+                global_metrics.inc(
+                    "engine.kvcache.prefill_tokens_saved",
+                    entry.depth * self.page_size * len(group),
+                )
             # Blocks past the shared chain that the prompt fully covers
             # are immutable too — register them as chain extensions.
             self._maybe_register(group)
@@ -2015,6 +2090,10 @@ class ContinuousBatcher:
                     schema_tables=group_schema,
                 )
             global_metrics.inc("engine.prefix_hits", len(group))
+            global_metrics.inc(
+                "engine.kvcache.prefill_tokens_saved",
+                len(entry.ids) * len(group),
+            )
         else:
             with global_metrics.timer("engine.prefill_latency"):
                 # One fused dispatch for the whole admission (prefill +
@@ -2116,6 +2195,30 @@ class ContinuousBatcher:
             queue_depth=depth,
         )
         global_metrics.inc("engine.admitted", len(group))
+
+    def _apply_restores(self) -> None:
+        """Scatter pending host-tier page restores into the pool (device
+        thread only; a donated jitted write per chain — enqueued on the
+        device stream, never awaited). Runs before any admission or
+        segment dispatch, so a restored chain is always pool-resident by
+        the time something reads it. Stale-epoch records (their pool was
+        rebuilt) are dropped inside apply_restores."""
+        if self.kvcache is None:
+            return
+        with self._lock:
+            if not self._pending_restores:
+                return
+            records = self._pending_restores
+            self._pending_restores = []
+            epoch = self._alloc_epoch
+        self.cache = self.kvcache.apply_restores(self.cache, records, epoch)
+        with self._lock:
+            # Writes are enqueued: the unwritten-page spill guard lifts
+            # (stale records too — their pages died with the old pool,
+            # and holding ids hostage would suppress spills of innocent
+            # same-numbered pages in the new allocator).
+            self.kvcache.mark_written(records)
+        self._beat()  # restore landed: watchdog-visible progress
 
     def _schema_tables(self):
         """Device copies of the SchemaBank tables, refreshed when the
@@ -3171,6 +3274,28 @@ class ContinuousBatcher:
                 {"prefix_pages": self.page_index.pinned_pages,
                  "prefix_hits": global_metrics.get("engine.prefix_hits")}
                 if self.page_index is not None else {}
+            ),
+            **(
+                {"kvcache": {
+                    "host_mb": round(
+                        self.kvcache.host.bytes_held / (1024 * 1024), 2
+                    ),
+                    "host_entries": len(self.kvcache.host),
+                    "lookups": global_metrics.get("engine.kvcache.lookups"),
+                    "hits": global_metrics.get("engine.kvcache.hits"),
+                    "host_hits": global_metrics.get(
+                        "engine.kvcache.host_hits"
+                    ),
+                    "spills": global_metrics.get("engine.kvcache.spills"),
+                    "restores": global_metrics.get(
+                        "engine.kvcache.restores"
+                    ),
+                    "prefill_tokens_saved": global_metrics.get(
+                        "engine.kvcache.prefill_tokens_saved"
+                    ),
+                }}
+                if self.kvcache is not None and self.kvcache.host is not None
+                else {}
             ),
             "decode_steps": global_metrics.get("engine.decode_steps"),
             "overlap_admission": self.overlap_admission,

@@ -168,6 +168,7 @@ class LLMHandler:
         json_mode: Optional[bool],
         json_schema: Optional[Dict[str, Any]] = None,
         slo_class: Optional[str] = None,
+        session_id: Optional[str] = None,
     ):
         """One request-normalization path for the streaming AND
         non-streaming calls — the two must never drift in default-params
@@ -198,6 +199,10 @@ class LLMHandler:
             # when params carry no class, so an explicit per-request
             # class (the HTTP edge's) always survives.
             params = params.model_copy(update={"slo_class": slo_class})
+        if session_id is not None and params.session_id is None:
+            # KV-cache session lineage (engine/kvcache/): same
+            # fill-don't-override rule as slo_class.
+            params = params.model_copy(update={"session_id": session_id})
         return msgs, specs, params
 
     def _ensure_trace(self, params: GenerationParams) -> GenerationParams:
@@ -274,6 +279,7 @@ class LLMHandler:
         json_mode: Optional[bool] = None,
         json_schema: Optional[Dict[str, Any]] = None,
         slo_class: Optional[str] = None,
+        session_id: Optional[str] = None,
     ) -> LLMResponse:
         """Chat completion with retry/backoff (reference ``llm.py:38-66``).
 
@@ -281,10 +287,13 @@ class LLMHandler:
         sites (rules.yaml prompts demand strict JSON) set it True to get
         grammar-constrained decoding on byte-tokenizer engines.
         ``slo_class`` fills the request's SLO class when params carry
-        none (the orchestrator passes its task-derived class here).
+        none (the orchestrator passes its task-derived class here);
+        ``session_id`` likewise fills the KV-cache session handle so
+        multi-turn callers pin their prefix lineage across turns.
         """
         msgs, specs, params = self._normalize(
-            messages, tools, params, json_mode, json_schema, slo_class
+            messages, tools, params, json_mode, json_schema, slo_class,
+            session_id,
         )
         params = self._ensure_trace(params)
         trace_id, flight_id = params.trace_id, params.flight_id
@@ -487,6 +496,7 @@ class LLMHandler:
         json_mode: Optional[bool] = None,
         json_schema: Optional[Dict[str, Any]] = None,
         slo_class: Optional[str] = None,
+        session_id: Optional[str] = None,
         info: Optional[Dict[str, Any]] = None,
     ):
         """Streaming chat completion: an async generator of text deltas
@@ -503,7 +513,8 @@ class LLMHandler:
         if isinstance(messages, str):
             messages = [messages]
         msgs, specs, params = self._normalize(
-            messages, tools, params, json_mode, json_schema, slo_class
+            messages, tools, params, json_mode, json_schema, slo_class,
+            session_id,
         )
         params = self._ensure_trace(params)
         trace_id, flight_id = params.trace_id, params.flight_id

@@ -1,0 +1,35 @@
+"""Global KV cache tier (ISSUE 10 / ROADMAP item 2).
+
+One radix-indexed prefix cache spanning all traffic — the dense panel
+store and the paged page radix unified behind ``KVCacheIndex`` — with
+cost-aware eviction and a host-RAM cold tier: evicted KV spills to host
+buffers via async D2H started off the hot path, and session resumes /
+repeated preambles restore via async H2D instead of re-prefilling.
+
+Import cost: ``radix`` and ``host_tier`` are jax-free; ``index`` (the
+spill/restore orchestration) imports jax and is pulled in lazily by the
+engine only.
+"""
+
+from pilottai_tpu.engine.kvcache.host_tier import HostEntry, HostTier, SpillCopy
+from pilottai_tpu.engine.kvcache.radix import RadixNode, RadixTree
+
+__all__ = [
+    "HostEntry",
+    "HostTier",
+    "KVCacheIndex",
+    "PendingRestore",
+    "RadixNode",
+    "RadixTree",
+    "SpillCopy",
+]
+
+
+def __getattr__(name):
+    # KVCacheIndex/PendingRestore import jax; load on first touch so
+    # control-plane users of the radix/host tier never pay it.
+    if name in ("KVCacheIndex", "PendingRestore"):
+        from pilottai_tpu.engine.kvcache import index as _index
+
+        return getattr(_index, name)
+    raise AttributeError(name)

@@ -1,0 +1,346 @@
+"""Host-RAM cold tier for evicted KV (the spill side of ISSUE 10).
+
+Device-resident prefix caches are capacity-bound: the dense store holds
+a handful of panel entries, the paged radix a quarter of the page pool —
+and eviction previously threw the K/V away, so a multi-turn agent
+session whose entry aged out re-prefilled its ENTIRE history on the next
+turn. This tier catches evictions instead: the evicted panels/pages copy
+to host RAM via an async D2H started at eviction time (the ``_HostCopy``
+discipline of PERF_NOTES r8 — ``copy_to_host_async`` at spill,
+materialize lazily at restore; no thread ever blocks on a fresh device
+round trip), and a later session resume or preamble hit restores from
+host memory instead of recomputing the prefill FLOPs.
+
+Eviction within the tier is **cost-aware** (``policy="cost"``): the
+score is recency x reconstruction-cost density — prefill FLOPs saved per
+byte held, which for token-keyed entries reduces to
+``true_tokens / padded_rows`` (the model constants cancel within one
+engine) — so a tightly packed preamble outlives an equally old but
+mostly-padding entry. ``policy="lru"`` is plain recency.
+
+**Sessions** pin lineages: ``note_session`` records each session's
+latest prompt prefix, and entries lying on a live session's lineage are
+evicted only when nothing unpinned remains (the tier never wedges).
+The session table itself is a bounded LRU so unbounded client-minted
+session ids cannot leak host memory.
+
+Entries are keyed by token-id prefix in a ``RadixTree`` (O(len) match)
+plus an exact-key dict. Everything here is host-side bookkeeping plus
+async-copy handles — rebuild-proof by construction: an engine-state
+rebuild swaps device pools and clears the device-resident indexes, but
+this tier's numpy payloads and keys survive untouched.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pilottai_tpu.engine.kvcache.policy import (
+    eviction_score,
+    validate_policy,
+)
+from pilottai_tpu.engine.kvcache.radix import RadixTree
+from pilottai_tpu.utils.metrics import global_metrics
+
+
+class SpillCopy:
+    """Handle for device->host reads STARTED at spill time
+    (``copy_to_host_async``) and materialized only when a restore (or a
+    test) asks — by then the transfer has long landed, so ``wait`` is a
+    host-side materialize, not a fresh blocking round trip. Mirrors
+    ``engine/batcher.py:_HostCopy``; the AST tripwire
+    (tests/test_no_blocking_hotpath.py) sanctions exactly this shape."""
+
+    __slots__ = ("_arrays", "_host")
+
+    def __init__(self, arrays) -> None:
+        self._arrays = tuple(arrays)
+        self._host: Optional[List[np.ndarray]] = None
+        for a in self._arrays:
+            try:
+                a.copy_to_host_async()
+            except AttributeError:  # plain numpy in tests
+                pass
+
+    def wait(self) -> List[np.ndarray]:
+        if self._host is None:
+            self._host = [np.asarray(a) for a in self._arrays]
+            self._arrays = ()  # drop device refs once materialized
+        return self._host
+
+
+def _nbytes(arrays) -> int:
+    total = 0
+    for a in arrays:
+        size = 1
+        for d in a.shape:
+            size *= int(d)
+        total += size * np.dtype(a.dtype).itemsize
+    return total
+
+
+class HostEntry:
+    """One spilled prefix: the token key, the (lazy) host payload and
+    the eviction-score bookkeeping."""
+
+    __slots__ = ("key", "copy", "nbytes", "tokens", "rows", "meta",
+                 "kind", "stamp")
+
+    def __init__(self, key, copy, nbytes, tokens, rows, meta, kind):
+        self.key = key          # Tuple[int, ...] — the covered prefix
+        self.copy = copy        # SpillCopy (or pre-materialized arrays)
+        self.nbytes = nbytes
+        self.tokens = tokens    # true tokens the entry reconstructs
+        self.rows = rows        # padded rows held (>= tokens)
+        self.meta = meta        # dense: p_bucket; paged: block index
+        self.kind = kind        # "dense" | "page"
+        self.stamp = 0
+
+
+class HostTier:
+    """Bounded host-RAM store of spilled KV prefixes."""
+
+    def __init__(
+        self,
+        budget_bytes: int,
+        policy: str = "cost",
+        max_sessions: int = 256,
+    ) -> None:
+        self.budget_bytes = max(0, int(budget_bytes))
+        self.policy = validate_policy(policy, "kvcache")
+        self._tree = RadixTree()
+        self._bytes = 0
+        self._clock = 0
+        # session id -> latest prompt prefix (lineage tip). Bounded LRU:
+        # client-minted ids must not grow host state unboundedly.
+        self._sessions: "OrderedDict[str, Tuple[int, ...]]" = OrderedDict()
+        self.max_sessions = max_sessions
+        # One lock: the tier is fed from the device thread (dense export
+        # eviction), the prep thread (admission-pressure page eviction,
+        # restores) and tests.
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._tree)
+
+    @property
+    def bytes_held(self) -> int:
+        return self._bytes
+
+    # ------------------------------------------------------------------ #
+    # Spill (put)
+    # ------------------------------------------------------------------ #
+
+    def put(
+        self,
+        key: Sequence[int],
+        arrays,
+        *,
+        tokens: int,
+        rows: Optional[int] = None,
+        meta: Any = None,
+        kind: str = "dense",
+    ) -> bool:
+        """Accept an evicted entry's device arrays: start the async D2H
+        now (off the hot path — nothing waits on it here), account the
+        bytes, and evict colder host entries past the budget. Returns
+        False (and starts nothing) when the entry alone exceeds the
+        whole budget."""
+        nbytes = _nbytes(arrays)
+        if self.budget_bytes <= 0 or nbytes > self.budget_bytes:
+            return False
+        key = tuple(key)
+        copy = SpillCopy(arrays)
+        with self._lock:
+            old = self._tree.get(key)
+            if old is not None:
+                # Same prefix re-spilled (identical content by
+                # construction — prefix K/V is deterministic): keep the
+                # fresh copy, swap the accounting.
+                self._bytes -= old.nbytes
+            entry = HostEntry(
+                key, copy, nbytes, tokens,
+                rows if rows is not None else tokens, meta, kind,
+            )
+            self._clock += 1
+            entry.stamp = self._clock
+            self._tree.insert(key, entry)
+            self._bytes += nbytes
+            self._evict_over_budget_locked()
+            self._gauges_locked()
+        global_metrics.inc("engine.kvcache.spills")
+        global_metrics.inc("engine.kvcache.spill_bytes", nbytes)
+        return True
+
+    # ------------------------------------------------------------------ #
+    # Lookup / restore (take)
+    # ------------------------------------------------------------------ #
+
+    def match(self, ids: Sequence[int]) -> Optional[HostEntry]:
+        """Longest host entry that is a PROPER prefix of ``ids``
+        (dense-tier hit primitive). Touches the entry."""
+        with self._lock:
+            node = self._tree.longest_payload_prefix(ids, proper=True)
+            if node is None:
+                return None
+            entry = node.payload
+            self._clock += 1
+            entry.stamp = self._clock
+            return entry
+
+    def match_lcp(
+        self, ids: Sequence[int]
+    ) -> Tuple[Optional[HostEntry], int]:
+        """``(entry, lcp)``: the entry sharing the LONGEST common prefix
+        with ``ids`` — not necessarily a whole-entry prefix. Prefix K/V
+        is suffix-independent, so the restore path slices the entry's
+        first ``lcp`` rows: exactly how a stored previous turn serves
+        the next turn of the same transcript, whose prompts share the
+        whole history but diverge at the new user message. ``lcp`` is
+        capped to a PROPER prefix of ``ids``."""
+        with self._lock:
+            node, lcp = self._tree.deepest_common(ids)
+            if node is None:
+                return None, 0
+            entry = node.payload
+            self._clock += 1
+            entry.stamp = self._clock
+            return entry, min(lcp, len(ids) - 1, len(entry.key))
+
+    def extension_blocks(
+        self, ids: Sequence[int], from_block: int, page_size: int,
+        max_blocks: int,
+    ) -> List[HostEntry]:
+        """Paged-tier hit primitive: the contiguous run of spilled page
+        blocks continuing a live chain of ``from_block`` blocks — entry
+        b covers ``ids[:(b+1) * page_size]``. Stops at the first gap, at
+        ``max_blocks`` total blocks, and always leaves at least one tail
+        token unprefilled (proper-prefix contract)."""
+        out: List[HostEntry] = []
+        limit = min(max_blocks, (len(ids) - 1) // page_size)
+        with self._lock:
+            for b in range(from_block, limit):
+                entry = self._tree.get(tuple(ids[: (b + 1) * page_size]))
+                if entry is None or entry.kind != "page":
+                    break
+                self._clock += 1
+                entry.stamp = self._clock
+                out.append(entry)
+        return out
+
+    def take(self, key: Sequence[int]) -> Optional[HostEntry]:
+        """Remove and return an entry (restore moves ownership back to
+        the device-resident tier; a later eviction re-spills it)."""
+        with self._lock:
+            entry = self._tree.remove(tuple(key))
+            if entry is not None:
+                self._bytes -= entry.nbytes
+                self._gauges_locked()
+            return entry
+
+    def get(self, key: Sequence[int]) -> Optional[HostEntry]:
+        with self._lock:
+            return self._tree.get(tuple(key))
+
+    def reinsert(self, entry: HostEntry) -> None:
+        """Hand back an entry a restore consumed but could not complete
+        (its pool was rebuilt mid-flight): the payload is already host
+        numpy, so this is pure bookkeeping — the cold tier stays
+        rebuild-proof."""
+        with self._lock:
+            old = self._tree.get(entry.key)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._clock += 1
+            entry.stamp = self._clock
+            self._tree.insert(entry.key, entry)
+            self._bytes += entry.nbytes
+            self._evict_over_budget_locked()
+            self._gauges_locked()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._tree = RadixTree()
+            self._bytes = 0
+            self._gauges_locked()
+
+    # ------------------------------------------------------------------ #
+    # Sessions
+    # ------------------------------------------------------------------ #
+
+    def note_session(self, session_id: Optional[str],
+                     ids: Sequence[int]) -> None:
+        """Record a session's latest prompt prefix as its lineage tip:
+        host entries prefixing a live lineage are eviction-protected."""
+        if not session_id:
+            return
+        with self._lock:
+            self._sessions[session_id] = tuple(ids)
+            self._sessions.move_to_end(session_id)
+            while len(self._sessions) > self.max_sessions:
+                self._sessions.popitem(last=False)
+            global_metrics.set_gauge(
+                "engine.kvcache.sessions", float(len(self._sessions))
+            )
+
+    def _protected_locked(self, entry: HostEntry) -> bool:
+        k = entry.key
+        n = len(k)
+        for lineage in self._sessions.values():
+            if len(lineage) >= n and lineage[:n] == k:
+                return True
+        return False
+
+    # ------------------------------------------------------------------ #
+    # Eviction
+    # ------------------------------------------------------------------ #
+
+    def _score_locked(self, entry: HostEntry) -> float:
+        return eviction_score(
+            entry.stamp, entry.tokens, entry.rows, self.policy
+        )
+
+    def _evict_over_budget_locked(self) -> None:
+        """One ranked pass per overflow (not per victim — a multi-victim
+        overflow at 'thousands of paged blocks' scale must not rescan
+        every entry × every session lineage per eviction): score and
+        session-protection are computed once per entry, unpinned entries
+        evict coldest-first, and pinned entries only once nothing
+        unpinned remains (bounded memory beats a perfect pin)."""
+        if self._bytes <= self.budget_bytes or len(self._tree) <= 1:
+            return
+        ranked = sorted(
+            ((self._score_locked(e), e) for _, e in self._tree.items()),
+            key=lambda t: t[0],
+        )
+        deferred: List[HostEntry] = []
+        for _s, entry in ranked:
+            if self._bytes <= self.budget_bytes:
+                return
+            if self._protected_locked(entry):
+                deferred.append(entry)
+                continue
+            self._tree.remove(entry.key)
+            self._bytes -= entry.nbytes
+            global_metrics.inc("engine.kvcache.evictions")
+        for entry in deferred:
+            if self._bytes <= self.budget_bytes or len(self._tree) <= 1:
+                return
+            self._tree.remove(entry.key)
+            self._bytes -= entry.nbytes
+            global_metrics.inc("engine.kvcache.evictions")
+
+    def _gauges_locked(self) -> None:
+        global_metrics.set_gauge(
+            "engine.kvcache.host_bytes", float(self._bytes)
+        )
+        global_metrics.set_gauge(
+            "engine.kvcache.host_entries", float(len(self._tree))
+        )
+
+
+__all__ = ["HostTier", "HostEntry", "SpillCopy"]

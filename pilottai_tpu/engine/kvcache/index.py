@@ -1,0 +1,447 @@
+"""KVCacheIndex: one prefix-cache lookup over ALL traffic + spill/restore.
+
+The tentpole facade of ISSUE 10 (ROADMAP item 2). Before it, prefix
+reuse was two disconnected structures the batcher special-cased at every
+call site: the dense panel store (``engine/prefix_cache.py``) and the
+paged page radix (``engine/page_prefix.py``) — and eviction from either
+threw KV away. This index unifies them behind one lookup and threads
+both into the host-RAM cold tier (``kvcache/host_tier.py``):
+
+* **lookup** — ``lookup_dense`` / ``lookup_paged`` are the single entry
+  point the batcher's ``_prefix_hit`` calls: device-resident hit first,
+  then the host tier; a host hit RESTORES (async H2D staged off the
+  device thread) instead of re-prefilling.
+* **spill** — wired as the eviction callbacks of both device-resident
+  structures: an evicted dense entry's panels (or an evicted leaf
+  page's K/V) start their D2H at eviction time (``SpillCopy`` — the
+  ``_HostCopy`` discipline) and land in the host tier.
+* **restore, dense** — materialize the host panels (the spill's copy
+  landed long ago), ``jax.device_put`` them (async H2D, prep thread)
+  and hand the batcher a normal ``PrefixEntry``: the admission path is
+  byte-identical to a device-resident hit.
+* **restore, paged** — take fresh pages from the allocator, register
+  the chain into the live radix, upload the panels, and return a
+  ``PendingRestore`` record: the DEVICE thread scatters it into the
+  page pool (``apply_restores`` — a donated jitted write) before any
+  dispatch can read those pages. The device thread never blocks on the
+  transfer; the prep thread never mutates device state.
+
+Threading contract: lookups and paged spills run under the batcher's
+slot lock (prep or device thread); dense spills under the same lock on
+the device thread; ``apply_restores`` on the device thread only. The
+host tier has its own lock and survives engine-state rebuilds by
+construction (epoch-stamped restore records from a dead pool are
+dropped at apply time; the host entries themselves persist).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pilottai_tpu.engine.kvcache.host_tier import HostTier
+from pilottai_tpu.ops.kvcache import dequantize_kv
+from pilottai_tpu.ops.paged import write_prompts_paged
+from pilottai_tpu.utils.metrics import global_metrics
+
+# Donated pool scatter for restored page chains: same op the paged
+# admission path uses for prompt writes, compiled per (pool shape, chain
+# bucket) — the chain width is power-of-two bucketed by the caller so
+# executables stay bounded.
+_restore_write = jax.jit(write_prompts_paged, donate_argnums=(0,))
+
+
+def _gather_page_fn(cache, page):
+    """Read one page's K/V out of every layer's pool as stacked
+    [L, K, P, H] arrays (int8 pools dequantize — the restore write
+    re-quantizes with identical recomputed scales, a lossless round
+    trip per the dense store's export discipline)."""
+    ks_l, vs_l = [], []
+    for li, (kp, vp) in enumerate(cache.layers):
+        K, _, P, H = kp.shape
+        gk = jax.lax.dynamic_slice(kp, (0, page, 0, 0), (K, 1, P, H))[:, 0]
+        gv = jax.lax.dynamic_slice(vp, (0, page, 0, 0), (K, 1, P, H))[:, 0]
+        if cache.scales is not None:
+            ksc, vsc = cache.scales[li]
+            gsk = jax.lax.dynamic_slice(ksc, (0, page, 0), (K, 1, P))[:, 0]
+            gsv = jax.lax.dynamic_slice(vsc, (0, page, 0), (K, 1, P))[:, 0]
+            gk = dequantize_kv(gk, gsk, jnp.float32)
+            gv = dequantize_kv(gv, gsv, jnp.float32)
+        ks_l.append(gk)
+        vs_l.append(gv)
+    return jnp.stack(ks_l), jnp.stack(vs_l)
+
+
+_gather_page = jax.jit(_gather_page_fn)
+
+
+class PendingRestore:
+    """One restored page chain awaiting its device-thread pool write.
+    ``epoch`` stamps the allocator generation the pages came from: a
+    rebuild makes the record meaningless (fresh pool, index cleared) and
+    ``apply_restores`` drops it — re-inserting the consumed host entries
+    (``entries``) into the cold tier, so a restore caught mid-flight by
+    a PR 8 recovery unwinds cleanly and the KV survives for the
+    re-admission to restore again."""
+
+    __slots__ = ("epoch", "table", "ks", "vs", "lengths", "tokens",
+                 "entries", "pages")
+
+    def __init__(self, epoch, table, ks, vs, lengths, tokens, entries,
+                 pages):
+        self.epoch = epoch
+        self.table = table      # np [1, kb] — restore pages, sentinel pad
+        self.ks = ks            # device [L, 1, kb*P, K, H] (device_put'd)
+        self.vs = vs
+        self.lengths = lengths  # np [1] — true restored tokens
+        self.tokens = tokens
+        self.entries = entries  # the HostEntry list the restore consumed
+        self.pages = pages      # the taken pages awaiting the pool write
+
+
+class KVCacheIndex:
+    """Unified prefix/KV lookup + cost-aware spill/restore tiering."""
+
+    def __init__(
+        self,
+        *,
+        prefix_store: Optional[Any] = None,
+        page_index: Optional[Any] = None,
+        page_size: int = 0,
+        host_bytes: int = 0,
+        policy: str = "cost",
+        get_cache: Optional[Callable[[], Any]] = None,
+    ) -> None:
+        self.prefix_store = prefix_store
+        self.page_index = page_index
+        self.page_size = page_size
+        self._get_cache = get_cache
+        self.host: Optional[HostTier] = (
+            HostTier(host_bytes, policy) if host_bytes > 0 else None
+        )
+        # Pages a PendingRestore has taken but not yet written into the
+        # pool (guarded by the batcher's slot lock, like every other
+        # call into this index): an eviction racing the device-thread
+        # write must NOT spill their never-written contents as valid KV.
+        self._unwritten: set = set()
+        if self.host is not None:
+            if prefix_store is not None:
+                prefix_store.on_evict = self._spill_dense
+            if page_index is not None:
+                page_index.on_evict = self._spill_page
+
+    # ------------------------------------------------------------------ #
+    # Spill (eviction callbacks of the device-resident structures)
+    # ------------------------------------------------------------------ #
+
+    def _spill_dense(self, entry) -> None:
+        """Dense-store eviction: the entry's panels are plain
+        (non-donated) device arrays — start their D2H now and let the
+        host tier own the handle. Nothing blocks here."""
+        self.host.put(
+            entry.ids, (entry.ks, entry.vs),
+            tokens=len(entry.ids), rows=entry.p_bucket,
+            meta=entry.p_bucket, kind="dense",
+        )
+
+    def _spill_page(self, path_ids: Tuple[int, ...], page: int) -> None:
+        """Paged-radix leaf eviction (called under the batcher's slot
+        lock, BEFORE the page is unpinned): enqueue the page gather —
+        registered pages are immutable prompt KV, and the lock orders
+        this dispatch before any re-allocation could overwrite it — and
+        hand the in-flight copy to the host tier."""
+        if page in self._unwritten:
+            # A restored-but-not-yet-written page: its pool contents are
+            # whatever the previous owner left. Spilling that as valid
+            # KV would poison the host tier — drop instead (the KV this
+            # chain held came FROM the host tier moments ago).
+            return
+        for _attempt in range(2):
+            # self.cache is rebound by the DEVICE thread's donated
+            # dispatches outside the slot lock, so the snapshot we read
+            # here can have been consumed already — the jit call then
+            # raises on deleted buffers. Re-read the fresh binding once;
+            # a second failure means the pool is mid-rebuild and the
+            # spill is moot.
+            cache = self._get_cache()
+            try:
+                ks, vs = _gather_page(cache, jnp.int32(page))
+                break
+            except Exception:  # noqa: BLE001 — donated-buffer race
+                continue
+        else:
+            return
+        self.host.put(
+            path_ids, (ks, vs),
+            tokens=self.page_size, rows=self.page_size,
+            meta=len(path_ids) // max(self.page_size, 1) - 1, kind="page",
+        )
+
+    # ------------------------------------------------------------------ #
+    # Lookup (the ONE entry point for all traffic)
+    # ------------------------------------------------------------------ #
+
+    def lookup_dense(
+        self,
+        ids: Sequence[int],
+        *,
+        session_id: Optional[str] = None,
+        fits: Optional[Callable[[int, int], bool]] = None,
+        bucket: Optional[Callable[[int], int]] = None,
+        count: bool = True,
+    ):
+        """Dense-tier lookup: hot store first, host tier second. A host
+        hit restores — panels upload via async ``device_put`` (the
+        admission dispatch consumes them in stream order; this thread
+        never waits on the transfer) and re-enter the hot store so the
+        NEXT hit is device-resident. The host match is LCP-based:
+        prefix K/V is suffix-independent per position, so a stored
+        previous turn serves the next turn of the same transcript by
+        slicing its first ``lcp`` rows, even though the stored prompt
+        diverges past the shared history. Returns a ``PrefixEntry`` or
+        None. ``fits(plen, p_bucket)`` is the caller's geometry check
+        (tail bucket must land inside max_seq); ``bucket`` the caller's
+        prefill-bucket ladder for sliced partial restores."""
+        store = self.prefix_store
+        if store is None:
+            return None
+        if count:
+            # count=False on repeat attempts for the same request (a
+            # page-blocked head re-selects every prep cycle): the
+            # lookups/hits counters mean one lookup per request.
+            global_metrics.inc("engine.kvcache.lookups")
+        if self.host is not None:
+            self.host.note_session(session_id, ids)
+        entry = store.match(ids)
+        if entry is not None and fits is not None and not fits(
+            len(entry.ids), entry.p_bucket
+        ):
+            # Geometry miss (tail bucket would overrun max_seq): this
+            # entry is unusable for THIS prompt — a shorter host entry
+            # may still fit.
+            entry = None
+        h, lcp = (
+            self.host.match_lcp(ids) if self.host is not None
+            else (None, 0)
+        )
+        if h is not None:
+            # Sliced-restore geometry: the usable rows are the shared
+            # prefix at its own bucket rung.
+            p_bucket = (
+                min(bucket(lcp), h.rows) if bucket is not None else h.rows
+            )
+        if (
+            h is None
+            or h.kind != "dense"
+            or lcp < store.min_len
+            # A hot hit at least as long is free — restoring a shorter
+            # (or equal) host prefix would spend a copy to save fewer
+            # tokens.
+            or (entry is not None and lcp <= len(entry.ids))
+            or (fits is not None and not fits(lcp, p_bucket))
+        ):
+            if entry is not None and count:
+                global_metrics.inc("engine.kvcache.hits")
+            return entry
+        t0 = time.perf_counter()
+        key = tuple(h.key[:lcp])
+        # Staging runs under the batcher's slot lock (we are inside its
+        # selection path): wait() is a host materialize of a D2H that
+        # landed at spill time and device_put is an async enqueue, but
+        # for multi-MB entries the memcpy wall is real — it is exactly
+        # what engine.kvcache.restore_ms measures, and it is paid once
+        # per resume-after-eviction, not per token. The device thread
+        # itself never waits on the transfer.
+        ks_h, vs_h = h.copy.wait()  # spill copy landed long ago
+        if lcp < len(h.key) or p_bucket < h.rows:
+            ks_h = ks_h[:, :, :p_bucket]
+            vs_h = vs_h[:, :, :p_bucket]
+        ks_d = jax.device_put(ks_h)
+        vs_d = jax.device_put(vs_h)
+        if lcp == len(h.key):
+            # Whole-entry restore: ownership moves back to the hot
+            # store. A partial (sliced) restore leaves the host entry in
+            # place — its full depth may serve its own session's resume.
+            self.host.take(h.key)
+        # Back into the hot store first (best effort — capacity pressure
+        # may bounce it straight back out through the spill path), and
+        # return the STORE's entry object when it stuck: same-wave
+        # requests sharing the prefix then match identically and group
+        # into one admission dispatch.
+        store.store(key, ks_d, vs_d, p_bucket)
+        restored = store.match(ids)
+        if restored is None or restored.ids != key:
+            from pilottai_tpu.engine.prefix_cache import PrefixEntry
+
+            restored = PrefixEntry(key, ks_d, vs_d, p_bucket)
+        if count:
+            global_metrics.inc("engine.kvcache.hits")
+        global_metrics.inc("engine.kvcache.host_hits")
+        global_metrics.inc("engine.kvcache.restores")
+        global_metrics.inc("engine.kvcache.restored_tokens", lcp)
+        global_metrics.observe(
+            "engine.kvcache.restore_ms", (time.perf_counter() - t0) * 1e3
+        )
+        return restored
+
+    def lookup_paged(
+        self,
+        ids: Sequence[int],
+        *,
+        session_id: Optional[str] = None,
+        alloc: Optional[Any] = None,
+        max_seq_len: int = 0,
+        need_tokens: int = 0,
+        epoch: int = 0,
+        count: bool = True,
+    ):
+        """Paged-tier lookup (batcher slot lock held): live radix chain
+        first, then the host tier's contiguous block extension. A host
+        hit takes fresh pages, registers the extended chain into the
+        live radix (pinned — it outlives the requesting slot) and
+        returns ``(node, PendingRestore)``; the device thread must apply
+        the record before any dispatch reads those pages (the batcher's
+        ``_apply_restores`` drain guarantees it)."""
+        index = self.page_index
+        if index is None:
+            return None, None
+        if count:
+            # count=False on repeat attempts for the same request — one
+            # lookup per request, not per selection cycle.
+            global_metrics.inc("engine.kvcache.lookups")
+        if self.host is not None:
+            self.host.note_session(session_id, ids)
+        node = index.match(ids)
+        depth = node.depth if node is not None else 0
+        if self.host is None or alloc is None:
+            if node is not None and count:
+                global_metrics.inc("engine.kvcache.hits")
+            return node, None
+        P = self.page_size
+        # Restored chain must leave headroom: at least one tail token
+        # inside max_seq (the proper-prefix contract the caller's
+        # depth-vs-max_seq check enforces for live chains).
+        max_blocks = max((max_seq_len - 1) // P, 0)
+        if index.capacity:
+            # A chain longer than the index's pinned-page budget would
+            # register and immediately re-evict its own tail — wasted
+            # copies for KV the next lookup can't see.
+            max_blocks = min(max_blocks, depth + index.capacity)
+        ents = self.host.extension_blocks(ids, depth, P, max_blocks)
+        total_need = alloc.pages_needed(min(need_tokens, max_seq_len))
+        if ents and alloc.free_pages < max(total_need - depth, 0):
+            # The request can't admit on this pool state anyway —
+            # pinning more pages now would only deepen the blockage.
+            ents = []
+        if not ents:
+            if node is not None and count:
+                global_metrics.inc("engine.kvcache.hits")
+            return node, None
+        t0 = time.perf_counter()
+        k = len(ents)
+        pages = alloc.take(k)
+        if pages is None:
+            if node is not None and count:
+                global_metrics.inc("engine.kvcache.hits")
+            return node, None
+        # Chain staging holds the slot lock for the restore_ms wall
+        # (host memcpys of landed spill copies + async H2D enqueues) —
+        # paid once per resume, never per token, and bounded by
+        # max_blocks; the device thread never waits on the transfers
+        # themselves.
+        hosts = [e.copy.wait() for e in ents]  # landed at spill time
+        kb = 1
+        while kb < k:
+            kb *= 2
+        # Blocks concatenate along the token axis, pad to the bucket
+        # (padded positions are masked by lengths -> scratch page), then
+        # transpose to the admission write's [L, A, T, K, H] layout.
+        ks_np = np.concatenate([h[0] for h in hosts], axis=2)
+        vs_np = np.concatenate([h[1] for h in hosts], axis=2)
+        if kb != k:
+            pad = ((0, 0), (0, 0), (0, (kb - k) * P), (0, 0))
+            ks_np = np.pad(ks_np, pad)
+            vs_np = np.pad(vs_np, pad)
+        ks_dev = jax.device_put(
+            np.ascontiguousarray(ks_np.transpose(0, 2, 1, 3)[:, None])
+        )
+        vs_dev = jax.device_put(
+            np.ascontiguousarray(vs_np.transpose(0, 2, 1, 3)[:, None])
+        )
+        table = np.full((1, kb), alloc.sentinel, np.int32)
+        table[0, :k] = pages
+        rec = PendingRestore(
+            epoch, table, ks_dev, vs_dev,
+            np.asarray([k * P], np.int32), k * P, list(ents), list(pages),
+        )
+        # Mark BEFORE registering: the registration's own capacity
+        # eviction may pick these pages, and their pool contents are not
+        # written until the device thread applies the record.
+        self._unwritten.update(pages)
+        chain_pages = (
+            tuple(node.path_pages) if node is not None else ()
+        ) + tuple(pages)
+        # The whole chain is protected from the registration's own
+        # capacity eviction: evicting the restored pages here would
+        # free them while the PendingRestore still targets them AND
+        # after their host entries were consumed — the KV would vanish
+        # from both tiers. Other chains evict (and spill) normally.
+        index.register(
+            ids[: (depth + k) * P], chain_pages, alloc,
+            protect=frozenset(chain_pages),
+        )
+        for p in pages:
+            alloc.unpin(p)  # drop the transient take() ref; index holds
+        for e in ents:
+            self.host.take(e.key)
+        out = index.match(ids)
+        if count:
+            global_metrics.inc("engine.kvcache.hits")
+        global_metrics.inc("engine.kvcache.host_hits")
+        global_metrics.inc("engine.kvcache.restores")
+        global_metrics.inc("engine.kvcache.restored_tokens", k * P)
+        global_metrics.observe(
+            "engine.kvcache.restore_ms", (time.perf_counter() - t0) * 1e3
+        )
+        return out, rec
+
+    # ------------------------------------------------------------------ #
+    # Restore apply (device thread only)
+    # ------------------------------------------------------------------ #
+
+    def apply_restores(self, cache, records: List[PendingRestore],
+                       epoch: int):
+        """Scatter pending restored chains into the page pool (device
+        thread; donated jitted write — enqueued, never awaited).
+        Stale-epoch records died with their pool (the rebuild cleared
+        the live index and replaced the allocator): drop the write and
+        hand the consumed host entries back to the cold tier — the
+        recovered request's re-admission restores them against the
+        fresh pool."""
+        for rec in records:
+            if rec.epoch != epoch:
+                if self.host is not None:
+                    for e in rec.entries:
+                        self.host.reinsert(e)
+                continue
+            cache = _restore_write(
+                cache, jnp.asarray(rec.table), rec.ks, rec.vs,
+                jnp.asarray(rec.lengths),
+            )
+        return cache
+
+    def mark_written(self, records: List[PendingRestore]) -> None:
+        """Lift the unwritten-page spill guard for applied (or dropped
+        stale) records — caller holds the batcher slot lock, pairing
+        every mutation site of ``_unwritten``. Runs AFTER the pool write
+        is enqueued, so device program order guarantees any later spill
+        gather reads the restored contents."""
+        for rec in records:
+            self._unwritten.difference_update(rec.pages)
+
+
+__all__ = ["KVCacheIndex", "PendingRestore"]

@@ -232,6 +232,10 @@ class NativeEngine(LLMBackend):
             json_tables=self._json_tables,
             speculate=self.config.engine_speculate,
             prefix_cache=self.config.engine_prefix_cache,
+            # Global KV cache tier (engine/kvcache/): host-RAM cold tier
+            # budget + cost-aware eviction policy for both tiers.
+            kvcache_host_mb=self.config.engine_kvcache_host_mb,
+            kvcache_policy=self.config.engine_kvcache_policy,
             kv_quantize=self.config.engine_kv_quantize == "int8",
             draft_layers=self.config.engine_draft_layers,
             pipeline_depth=self.config.engine_pipeline,
@@ -332,6 +336,9 @@ class NativeEngine(LLMBackend):
             # lower backlog depth than interactive (and outright at the
             # degradation ladder's last rung).
             slo_class=params.slo_class,
+            # KV-cache session lineage: the batcher's prefix lookup pins
+            # this session's host-tier entries against eviction.
+            session_id=params.session_id,
             # Flight-recorder correlation: the batcher marks admission /
             # token phases against the flight id and emits its span
             # against the trace id.

@@ -72,6 +72,23 @@ class PagePrefixIndex:
         self._root_children: Dict[Tuple[int, ...], PageNode] = {}
         self._nodes: set = set()  # all nodes, for LRU scans
         self._clock = 0
+        # Eviction hook (engine/kvcache/index.py): called with the
+        # victim's full token path and page BEFORE the unpin, while the
+        # page contents are still live — the host tier starts its D2H
+        # spill there instead of losing the KV. None = drop (seed
+        # behavior).
+        self.on_evict = None
+
+    @staticmethod
+    def path_tokens(node: PageNode) -> Tuple[int, ...]:
+        """Full token prefix covered by ``node``'s chain (walks parents;
+        eviction-rate only — nodes don't duplicate their path)."""
+        parts: List[Tuple[int, ...]] = []
+        walk: Optional[PageNode] = node
+        while walk is not None:
+            parts.append(walk.tokens)
+            walk = walk.parent
+        return tuple(t for blk in reversed(parts) for t in blk)
 
     def __len__(self) -> int:
         return len(self._nodes)
@@ -113,13 +130,18 @@ class PagePrefixIndex:
         return node
 
     def register(
-        self, ids: Sequence[int], pages: Sequence[int], alloc
+        self, ids: Sequence[int], pages: Sequence[int], alloc,
+        protect: frozenset = frozenset(),
     ) -> None:
         """Pin the chain of fully-covered prompt blocks. ``ids`` must be
         exactly the covered tokens (``len(ids) == len(pages) *
         page_size``) and ``pages`` the slot's table entries for them.
         Existing nodes are kept (their pages already hold identical K/V);
-        new nodes pin the slot's private pages so they outlive it."""
+        new nodes pin the slot's private pages so they outlive it.
+        ``protect`` exempts pages from the capacity eviction this call
+        may trigger — the KV-cache tier's restore path protects its own
+        freshly restored chain, which would otherwise be the LRU pass's
+        first victim before its pool write even lands."""
         P = self.page_size
         assert len(ids) == len(pages) * P
         node: Optional[PageNode] = None
@@ -135,7 +157,9 @@ class PagePrefixIndex:
             self._touch(child)
             node = child
         if self.capacity and len(self._nodes) > self.capacity:
-            self._evict_lru(len(self._nodes) - self.capacity, alloc)
+            self._evict_lru(
+                len(self._nodes) - self.capacity, alloc, protect
+            )
 
     def evict(
         self, n_pages: int, alloc,
@@ -172,6 +196,14 @@ class PagePrefixIndex:
             for victim in leaves[: n_pages - dropped]:
                 self._children_of(victim.parent).pop(victim.tokens, None)
                 self._nodes.remove(victim)
+                if self.on_evict is not None:
+                    # Spill BEFORE the unpin: the page is still
+                    # referenced, so its contents cannot be overwritten
+                    # until the spill's read is enqueued.
+                    try:
+                        self.on_evict(self.path_tokens(victim), victim.page)
+                    except Exception:  # noqa: BLE001 — spill is optional
+                        pass
                 alloc.unpin(victim.page)
                 dropped += 1
         return dropped

@@ -19,8 +19,15 @@ rules-preamble + varying-task workloads hit without ever seeing the same
 full prompt twice.
 
 Entries are plain (non-donated) device arrays — safe to reuse across
-dispatches and engine-state rebuilds. Host-side bookkeeping is a tiny
-LRU; matching is a linear scan over <= capacity entries.
+dispatches and engine-state rebuilds. Host-side bookkeeping rides the
+shared radix index (``engine/kvcache/radix.py``): ``match``/``has`` are
+one O(len) tree walk instead of the former O(capacity x len) linear
+scan, and eviction removes a single scored victim per overflow instead
+of the O(n²) ``list.remove(min(...))`` loop. Eviction is cost-aware by
+default under the KV cache tier (``policy="cost"``: recency x prefill
+FLOPs saved per byte held) and plain LRU standalone; either way the
+victim is handed to ``on_evict`` so the host tier (ISSUE 10) can spill
+its panels instead of losing the KV.
 
 No reference counterpart (the reference's prompts leave the process over
 HTTPS, ``pilott/engine/llm.py:59``); parity target is the automatic
@@ -29,7 +36,13 @@ prefix caching of production LLM servers.
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from pilottai_tpu.engine.kvcache.policy import (
+    eviction_score,
+    validate_policy,
+)
+from pilottai_tpu.engine.kvcache.radix import RadixTree
 
 
 class PrefixEntry:
@@ -44,18 +57,24 @@ class PrefixEntry:
 
 
 class PrefixStore:
-    """LRU store of cached prompt-prefix K/V panels."""
+    """Radix-indexed store of cached prompt-prefix K/V panels."""
 
     def __init__(self, capacity: int = 8, min_len: int = 64,
-                 max_len: int = 1024) -> None:
+                 max_len: int = 1024, policy: str = "lru",
+                 on_evict: Optional[Callable[[PrefixEntry], None]] = None,
+                 ) -> None:
         self.capacity = capacity
+        self.policy = validate_policy(policy, "prefix-store")
         self.min_len = min_len
         self.max_len = max_len
-        self._entries: List[PrefixEntry] = []
+        # Eviction hook (engine/kvcache/index.py): the host tier spills
+        # the victim's panels instead of dropping the KV on the floor.
+        self.on_evict = on_evict
+        self._tree = RadixTree()
         self._clock = 0
 
     def __len__(self) -> int:
-        return len(self._entries)
+        return len(self._tree)
 
     def _touch(self, e: PrefixEntry) -> None:
         self._clock += 1
@@ -63,54 +82,48 @@ class PrefixStore:
 
     def match(self, ids: Sequence[int]) -> Optional[PrefixEntry]:
         """Longest entry that is a PROPER prefix of ``ids`` (at least one
-        tail token must remain for the first-token logits)."""
-        best = None
-        n = len(ids)
-        for e in self._entries:
-            p = len(e.ids)
-            if p < self.min_len or p >= n:
-                continue
-            if best is not None and p <= len(best.ids):
-                continue
-            if tuple(ids[:p]) == e.ids:
-                best = e
-        if best is not None:
-            self._touch(best)
-        return best
+        tail token must remain for the first-token logits). One O(len)
+        radix walk."""
+        node = self._tree.longest_payload_prefix(ids, proper=True)
+        if node is None:
+            return None
+        entry = node.payload
+        self._touch(entry)
+        return entry
 
     def has(self, ids: Sequence[int]) -> bool:
-        t = tuple(ids)
-        return any(e.ids == t for e in self._entries)
+        return self._tree.has(ids)
 
     def lcp_candidates(self, ids: Sequence[int]) -> List[int]:
         """Lengths of longest-common-prefixes with existing entries that
         are worth storing as derived entries (>= min_len, not already
-        stored, shorter than ids)."""
-        out = set()
-        for e in self._entries:
-            n = min(len(e.ids), len(ids))
-            i = 0
-            while i < n and e.ids[i] == ids[i]:
-                i += 1
-            if i >= self.min_len and i < len(e.ids):
-                out.add(i)
-        return [
-            p for p in sorted(out, reverse=True)
-            if not self.has(tuple(ids[:p]))
-        ]
+        stored, shorter than the entries they were read off) — read off
+        the radix walk's divergence points, no per-entry comparison."""
+        return self._tree.lcp_candidates(ids, self.min_len)
+
+    def _score(self, e: PrefixEntry) -> float:
+        # ONE scoring formula shared with the host tier
+        # (kvcache/policy.py) — the two tiers must never drift.
+        return eviction_score(e.stamp, len(e.ids), e.p_bucket, self.policy)
 
     def store(self, ids: Sequence[int], ks: Any, vs: Any,
               p_bucket: int) -> None:
         ids = tuple(ids)
         if not (self.min_len <= len(ids) <= self.max_len):
             return
-        if self.has(ids):
+        if self._tree.has(ids):
             return
         e = PrefixEntry(ids, ks, vs, p_bucket)
         self._touch(e)
-        self._entries.append(e)
-        while len(self._entries) > self.capacity:
-            self._entries.remove(min(self._entries, key=lambda x: x.stamp))
+        self._tree.insert(ids, e)
+        while len(self._tree) > self.capacity:
+            victim = min(
+                (entry for _, entry in self._tree.items()),
+                key=self._score,
+            )
+            self._tree.remove(victim.ids)
+            if self.on_evict is not None:
+                self.on_evict(victim)
 
     def clear(self) -> None:
-        self._entries.clear()
+        self._tree = RadixTree()

@@ -89,6 +89,22 @@ _gm.declare("engine.recovered_requests", "counter")
 _gm.declare("engine.recovery_failed", "counter")
 _gm.declare("engine.tokens_replayed", "counter")
 _gm.declare("engine.recovery_ms", "histogram")  # snapshot → re-admission
+# Global KV cache tier (engine/kvcache/ + batcher prefix lookup):
+# declared at boot so hit-rate dashboards and the bench's KVCACHE
+# section read a complete surface even before the first lookup.
+_gm.declare("engine.kvcache.lookups", "counter")
+_gm.declare("engine.kvcache.hits", "counter")        # hot + host
+_gm.declare("engine.kvcache.host_hits", "counter")   # restored from host
+_gm.declare("engine.kvcache.spills", "counter")      # evictions caught
+_gm.declare("engine.kvcache.spill_bytes", "counter")
+_gm.declare("engine.kvcache.restores", "counter")
+_gm.declare("engine.kvcache.restored_tokens", "counter")
+_gm.declare("engine.kvcache.evictions", "counter")   # host-tier drops
+_gm.declare("engine.kvcache.prefill_tokens_saved", "counter")
+_gm.declare("engine.kvcache.restore_ms", "histogram")  # host-side staging
+_gm.declare("engine.kvcache.host_bytes", "gauge")
+_gm.declare("engine.kvcache.host_entries", "gauge")
+_gm.declare("engine.kvcache.sessions", "gauge")      # live session pins
 
 __all__ = [
     "AgentOccupancy",

@@ -176,6 +176,19 @@ class PageAllocator:
         self._held[slot] = []
         self.table[slot, :] = self.sentinel
 
+    def take(self, n: int) -> Optional[List[int]]:
+        """Pop ``n`` free pages with a transient ref each (the KV cache
+        tier's restore path: the pages are filled from host RAM, then
+        registered/pinned by the prefix index and the transient ref
+        dropped via ``unpin``). None (and no change) when the pool can't
+        cover it."""
+        if n > len(self.free):
+            return None
+        pages = [self.free.pop() for _ in range(n)]
+        for p in pages:
+            self.refs[p] += 1
+        return pages
+
     def pin(self, page: int) -> None:
         """Add a non-slot ref (prefix index). Caller must hold/know the
         page is live (refs > 0) — pinning a free page is a logic error."""

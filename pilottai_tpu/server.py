@@ -33,7 +33,12 @@ Every request accepts (and every completion/task response echoes) an
 structured logs, phase metrics and black-box dumps across the server →
 handler → batcher boundary (docs/OBSERVABILITY.md). A ``slo_class``
 body field (or ``x-slo-class`` header) assigns the request to an SLO
-service class ("interactive"/"batch"); unknown classes are a 400.
+service class ("interactive"/"batch"); unknown classes are a 400. A
+``session_id`` body field (or ``x-session-id`` header) names the
+client's conversation for the engine's KV cache tier
+(engine/kvcache/): turns sending the same id pin their prefix lineage
+so a resume restores spilled KV from host RAM instead of re-prefilling
+the transcript; malformed ids are a 400.
 
 Implementation is stdlib-asyncio only (``asyncio.start_server`` + a
 minimal HTTP/1.1 parser): SSE needs the event loop the engine's futures
@@ -622,6 +627,28 @@ class APIServer:
         return raw
 
     @staticmethod
+    def _session_id(
+        req: Dict[str, Any], headers: Optional[Dict[str, str]]
+    ) -> Optional[str]:
+        """The request's KV-cache session handle: body ``session_id``
+        beats the ``x-session-id`` header. Sanitized with the same
+        charset as request ids — a malformed id is a 400, not a silent
+        anonymous request (the client asked for lineage pinning and
+        would otherwise re-prefill every turn without any signal
+        why)."""
+        raw = req.get("session_id")
+        if raw is None:
+            raw = (headers or {}).get("x-session-id")
+        if raw is None:
+            return None
+        if not isinstance(raw, str) or not _REQUEST_ID_RE.fullmatch(raw):
+            raise _HttpError(
+                400, "'session_id' must be 1-64 characters of "
+                "[A-Za-z0-9._-]"
+            )
+        return raw
+
+    @staticmethod
     def _trace_id(headers: Optional[Dict[str, str]]) -> str:
         """The request's flight-recorder id: accept the client's
         ``x-request-id`` (sanitized) or mint one. Echoed back as a
@@ -664,6 +691,9 @@ class APIServer:
         slo_class = self._slo_class(req, headers)
         if slo_class is not None:
             params = params.model_copy(update={"slo_class": slo_class})
+        session_id = self._session_id(req, headers or {})
+        if session_id is not None:
+            params = params.model_copy(update={"session_id": session_id})
         model = req.get("model") or getattr(
             getattr(handler, "config", None), "model_name", "default"
         )

@@ -11,12 +11,13 @@ JSON. This tool makes it mechanical:
         --fail-on-regression                            # CI gate mode
 
 It walks the top level, every ``models.<section>`` block, every
-``SLO.classes.<class>`` block and the ``RECOVERY`` block, compares
-numeric metrics whose direction it knows (steps/s, MFU, attainment,
-busy_frac, recovered_frac up = good; p50/p99, host_gap, burn_rate,
-recovery_ms, tokens_replayed, overhead fractions down = good), and prints a
-readable table with deltas, flagging moves beyond ``--threshold``
-(default 10%). ``x/y`` success strings compare as ratios. Keys with no
+``SLO.classes.<class>`` block and the ``RECOVERY`` and ``KVCACHE``
+blocks, compares numeric metrics whose direction it knows (steps/s,
+MFU, attainment, busy_frac, recovered_frac, prefix_hit_rate,
+prefill_tokens_saved up = good; p50/p99, host_gap, burn_rate,
+recovery_ms, restore_ms, tokens_replayed, overhead fractions down =
+good), and prints a readable table with deltas, flagging moves beyond
+``--threshold`` (default 10%). ``x/y`` success strings compare as ratios. Keys with no
 known direction (config echoes, counts) are skipped.
 
 Exit status: 0 unless ``--fail-on-regression`` is set AND at least one
@@ -43,6 +44,9 @@ HIGHER_BETTER = (
     # RECOVERY section (ISSUE 9): fraction of fault-interrupted requests
     # that completed anyway.
     "recovered_frac", "outputs_identical", "fault_fired",
+    # KVCACHE section (ISSUE 10): prefix_hit_rate matches "hit_rate"
+    # above; prefill FLOPs the tier saved are the other up-good axis.
+    "tokens_saved",
 )
 LOWER_BETTER = (
     "overhead_frac", "straggler_frac", "p50", "p90", "p99", "host_gap",
@@ -121,7 +125,7 @@ def _from_tail(tail: str) -> Dict[str, Any]:
     diff only compares keys present in BOTH rounds."""
     doc: Dict[str, Any] = {}
     remainder = tail
-    for block in ("models", "SLO", "phases"):
+    for block in ("models", "SLO", "phases", "KVCACHE"):
         marker = f'"{block}": '
         at = remainder.find(marker)
         if at < 0:
@@ -167,7 +171,7 @@ def _sections(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     doc = _unwrap(doc)
     out: Dict[str, Dict[str, Any]] = {"top": {}}
     for key, value in doc.items():
-        if key in ("models", "SLO", "phases", "RECOVERY"):
+        if key in ("models", "SLO", "phases", "RECOVERY", "KVCACHE"):
             continue
         num = _numeric(value)
         if num is not None:
@@ -176,6 +180,12 @@ def _sections(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     if isinstance(recovery, dict):
         out["recovery"] = {
             k: n for k, v in recovery.items()
+            if (n := _numeric(v)) is not None
+        }
+    kvcache = doc.get("KVCACHE")
+    if isinstance(kvcache, dict):
+        out["kvcache"] = {
+            k: n for k, v in kvcache.items()
             if (n := _numeric(v)) is not None
         }
     for name, block in (doc.get("models") or {}).items():

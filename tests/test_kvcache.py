@@ -1,0 +1,459 @@
+"""Global KV cache tier (engine/kvcache/, ISSUE 10).
+
+The tier's contract mirrors every other admission fast path's: it
+changes WHERE prompt K/V comes from (device hot store → host-RAM cold
+tier → recompute), never WHAT gets generated — greedy output with the
+tier enabled must be byte-identical to a cold engine's, across
+dense/paged caches x speculation on/off, through spill → evict → resume
+cycles and through PR 8 recovery landing mid-restore.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pilottai_tpu.engine.batcher import ContinuousBatcher, GenRequest
+from pilottai_tpu.engine.kvcache import HostTier, RadixTree
+from pilottai_tpu.models.common import init_params
+from pilottai_tpu.models.registry import get_model_config
+from pilottai_tpu.reliability import global_injector
+from pilottai_tpu.utils.metrics import global_metrics
+
+KV = (
+    "lookups", "hits", "host_hits", "spills", "restores",
+    "prefill_tokens_saved", "evictions",
+)
+
+
+def _kv_counters():
+    return {k: global_metrics.get(f"engine.kvcache.{k}") for k in KV}
+
+
+def _kv_delta(before):
+    return {
+        k: global_metrics.get(f"engine.kvcache.{k}") - before[k] for k in KV
+    }
+
+
+# --------------------------------------------------------------------- #
+# Radix tree
+# --------------------------------------------------------------------- #
+
+def test_radix_insert_match_remove():
+    t = RadixTree()
+    a = tuple(range(10, 30))
+    b = tuple(range(10, 25))          # proper prefix of a
+    c = (10, 11, 99, 98)              # diverges at depth 2
+    t.insert(a, "A")
+    t.insert(b, "B")
+    t.insert(c, "C")
+    assert len(t) == 3 and t.has(a) and t.get(b) == "B"
+    # Longest proper prefix wins; exact-length match is rejected.
+    assert t.longest_payload_prefix(list(a) + [1]).payload == "A"
+    assert t.longest_payload_prefix(list(a)).payload == "B"
+    assert t.longest_payload_prefix(list(b)) is None
+    assert t.longest_payload_prefix([10, 11, 99, 98, 5]).payload == "C"
+    assert t.remove(a) == "A"
+    assert not t.has(a) and t.has(b) and t.has(c)
+    assert t.longest_payload_prefix(list(a) + [1]).payload == "B"
+    # Removing everything leaves a clean tree.
+    t.remove(b)
+    t.remove(c)
+    assert len(t) == 0
+    assert t.longest_payload_prefix(list(a) + [1]) is None
+
+
+def test_radix_lcp_candidates():
+    t = RadixTree()
+    base = tuple(range(100, 120))
+    t.insert(base + (1, 2, 3), "k")
+    # A different continuation shares the 20-token base (the dense
+    # store's derived-entry shape).
+    assert t.lcp_candidates(base + (7, 8, 9), min_len=4) == [len(base)]
+    # Below min_len: no candidate.
+    assert t.lcp_candidates((100, 101, 55), min_len=4) == []
+    # Already-stored prefixes are filtered.
+    t.insert(base, "p")
+    assert t.lcp_candidates(base + (7, 8, 9), min_len=4) == []
+
+
+def test_radix_deep_chain_is_compressed():
+    t = RadixTree()
+    long = tuple(range(5, 1005))
+    t.insert(long, "L")
+    node = t.longest_payload_prefix(list(long) + [1])
+    assert node.payload == "L"
+    # Path compression: a single entry must not create a per-token chain.
+    depth = 0
+    while node is not None:
+        depth += 1
+        node = node.parent
+    assert depth <= 3
+
+
+# --------------------------------------------------------------------- #
+# Host tier
+# --------------------------------------------------------------------- #
+
+def _panel(seed, tokens=8, rows=None):
+    rng = np.random.RandomState(seed)
+    rows = rows or tokens
+    return (
+        jnp.asarray(rng.randn(2, 2, rows, 4).astype(np.float32)),
+        jnp.asarray(rng.randn(2, 2, rows, 4).astype(np.float32)),
+    )
+
+
+def test_host_tier_spill_restore_roundtrip():
+    tier = HostTier(1 << 20)
+    key = tuple(range(40, 56))
+    ks, vs = _panel(0, 16)
+    assert tier.put(key, (ks, vs), tokens=16, rows=16, kind="dense")
+    entry = tier.match(list(key) + [1, 2])
+    assert entry is not None and entry.key == key
+    hk, hv = entry.copy.wait()
+    np.testing.assert_array_equal(hk, np.asarray(ks))
+    np.testing.assert_array_equal(hv, np.asarray(vs))
+    # Exact-length query is not a proper prefix.
+    assert tier.match(list(key)) is None
+    assert tier.take(key) is entry and len(tier) == 0
+
+
+def test_host_tier_budget_eviction_and_policy():
+    ks, vs = _panel(1, 16)
+    per_entry = np.asarray(ks).nbytes + np.asarray(vs).nbytes
+    # The discriminating shape: a is nearly all padding (1 true token in
+    # 16 rows) but touched most recently; b is dense and older. Plain
+    # LRU protects a; the cost score (recency x FLOPs-saved-per-byte)
+    # lets the dense entry outlive the padded one.
+    a, b, c = (tuple(range(s, s + 16)) for s in (10, 40, 70))
+
+    def fill(policy):
+        tier = HostTier(2 * per_entry, policy=policy)
+        tier.put(a, (ks, vs), tokens=1, rows=16, kind="dense")
+        tier.put(b, (ks, vs), tokens=16, rows=16, kind="dense")
+        assert tier.match(list(a) + [1, 2]) is not None  # touch a
+        tier.put(c, (ks, vs), tokens=16, rows=16, kind="dense")
+        return tier
+
+    before = global_metrics.get("engine.kvcache.evictions")
+    cost = fill("cost")
+    assert global_metrics.get("engine.kvcache.evictions") == before + 1
+    assert cost.get(b) is not None and cost.get(a) is None
+
+    lru = fill("lru")
+    assert lru.get(a) is not None and lru.get(b) is None
+
+
+def test_host_tier_session_pins_lineage():
+    ks, vs = _panel(2, 16)
+    per_entry = np.asarray(ks).nbytes + np.asarray(vs).nbytes
+    tier = HostTier(2 * per_entry, policy="lru")
+    a, b, c = (tuple(range(s, s + 16)) for s in (10, 40, 70))
+    tier.put(a, (ks, vs), tokens=16, rows=16, kind="dense")
+    tier.note_session("sess-1", list(a) + [1, 2, 3])  # a is on the lineage
+    tier.put(b, (ks, vs), tokens=16, rows=16, kind="dense")
+    tier.put(c, (ks, vs), tokens=16, rows=16, kind="dense")
+    # LRU would evict a; the session pin redirects eviction to b.
+    assert tier.get(a) is not None and tier.get(b) is None
+
+
+def test_host_tier_extension_blocks_contiguity():
+    tier = HostTier(1 << 22)
+    P = 4
+    ids = list(range(30, 60))
+    ks, vs = _panel(3, P)
+    # Blocks 1 and 3 spilled, block 2 missing: an extension from block 1
+    # must stop at the gap.
+    tier.put(tuple(ids[: 2 * P]), (ks, vs), tokens=P, rows=P, kind="page")
+    tier.put(tuple(ids[: 4 * P]), (ks, vs), tokens=P, rows=P, kind="page")
+    ents = tier.extension_blocks(ids, 1, P, 16)
+    assert [len(e.key) for e in ents] == [2 * P]
+    # With block 2 present the run extends to block 3.
+    tier.put(tuple(ids[: 3 * P]), (ks, vs), tokens=P, rows=P, kind="page")
+    ents = tier.extension_blocks(ids, 1, P, 16)
+    assert [len(e.key) for e in ents] == [2 * P, 3 * P, 4 * P]
+
+
+def test_prefix_store_single_victim_eviction_and_spill_hook():
+    from pilottai_tpu.engine.prefix_cache import PrefixStore
+
+    evicted = []
+    s = PrefixStore(capacity=2, min_len=4, max_len=64,
+                    on_evict=evicted.append)
+    a = tuple(range(10, 30))
+    b = tuple(range(40, 56))
+    s.store(a, "ka", "va", 32)
+    s.store(b, "kb", "vb", 16)
+    s.match(list(a) + [1])  # touch a
+    s.store(tuple(range(70, 90)), "kc", "vc", 32)
+    assert [e.ids for e in evicted] == [b]
+    assert s.has(a) and not s.has(b) and len(s) == 2
+
+
+# --------------------------------------------------------------------- #
+# Engine parity: tier on/off, dense/paged x speculation on/off
+# --------------------------------------------------------------------- #
+
+# Three lineages with multi-turn resumes; staggered budgets finish slots
+# mid-chunk. Submitted sequentially so eviction pressure (hot capacity
+# 1-2 entries / 2 pinned pages) forces spill -> restore between turns.
+_S1 = [(i % 90) + 5 for i in range(70)]
+_S2 = [(i % 70) + 11 for i in range(70)]
+_S3 = [(i % 50) + 23 for i in range(70)]
+SEQ = (
+    (_S1, 6), (_S2, 8), (_S1 + [7, 9, 11], 6), (_S3, 4),
+    (_S2 + [17, 18, 19], 8), (_S1 + [7, 9, 11, 13, 15], 5),
+)
+
+
+def _run_seq(*, prefix_cache, host_mb, paged, speculate, page_cap=None,
+             session=True):
+    cfg = get_model_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    kwargs = dict(
+        n_slots=2, max_seq_len=256, cache_dtype=jnp.float32, chunk_size=4,
+        speculate=speculate, prefix_cache=prefix_cache,
+        kvcache_host_mb=host_mb, use_pallas=False,
+    )
+    if paged:
+        kwargs.update(paged=True, page_size=16)
+    b = ContinuousBatcher(cfg, params, **kwargs)
+    if page_cap is not None and b.page_index is not None:
+        b.page_index.capacity = page_cap
+    b.start()
+    try:
+        outs = []
+        for i, (prompt, mnt) in enumerate(SEQ):
+            req = GenRequest(
+                prompt_ids=list(prompt), max_new_tokens=mnt,
+                session_id=f"sess-{i % 3}" if session else None,
+            )
+            outs.append(b.submit(req).result(timeout=600))
+        return outs
+    finally:
+        b.stop()
+
+
+@pytest.mark.parametrize(
+    "paged,speculate",
+    [(False, 0), (False, 2), (True, 0), (True, 2)],
+    ids=["dense", "dense-spec", "paged", "paged-spec"],
+)
+def test_tier_on_off_greedy_parity(paged, speculate):
+    """The acceptance bar: greedy output byte-identical with the tier
+    enabled (tiny hot capacity -> spills and restores actually happen)
+    vs disabled entirely."""
+    cold = _run_seq(prefix_cache=0, host_mb=0, paged=paged,
+                    speculate=speculate, session=False)
+    before = _kv_counters()
+    warm = _run_seq(prefix_cache=1 if not paged else 4, host_mb=64,
+                    paged=paged, speculate=speculate,
+                    page_cap=2 if paged else None)
+    delta = _kv_delta(before)
+    assert warm == cold, (
+        f"KV cache tier changed greedy output (paged={paged}, "
+        f"speculate={speculate})"
+    )
+    assert delta["spills"] >= 1, "eviction never spilled — tier untested"
+    assert delta["restores"] >= 1, "resume never restored — tier untested"
+    assert all(len(o) >= 1 for o in cold)  # non-vacuous
+
+
+# --------------------------------------------------------------------- #
+# Resume without re-prefill (the prefill-token counter is the pin)
+# --------------------------------------------------------------------- #
+
+def _resume_engine(paged):
+    cfg = get_model_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    kwargs = dict(
+        n_slots=2, max_seq_len=256, cache_dtype=jnp.float32, chunk_size=4,
+        prefix_cache=1 if not paged else 4, kvcache_host_mb=64,
+        use_pallas=False,
+    )
+    if paged:
+        kwargs.update(paged=True, page_size=16)
+    b = ContinuousBatcher(cfg, params, **kwargs)
+    if paged and b.page_index is not None:
+        b.page_index.capacity = 2
+    return b
+
+
+@pytest.mark.parametrize("paged", [False, True], ids=["dense", "paged"])
+def test_spill_evict_resume_skips_reprefill(paged):
+    """Session turn 1 caches; unrelated traffic evicts (spill); the
+    resume must restore from host RAM and prefill ONLY the new tail —
+    pinned by the engine's prefilled-token counter
+    (``engine.prefill_tokens``, fed from the admission's true AI_LEN
+    rows), not just by the hit counters. 80-token bases clear the dense
+    store's 64-token entry floor (entries cache the prompt minus its
+    last token)."""
+    base = [(i % 90) + 5 for i in range(80)]
+    other = [(i % 70) + 11 for i in range(80)]
+    resume = base + [7, 9, 11, 13]
+    b = _resume_engine(paged)
+    b.start()
+    try:
+        # Submit serially so eviction ordering is deterministic.
+        r1 = GenRequest(prompt_ids=list(base), max_new_tokens=6,
+                        session_id="s-res")
+        b.submit(r1).result(timeout=600)
+        r2 = GenRequest(prompt_ids=list(other), max_new_tokens=6)
+        b.submit(r2).result(timeout=600)
+        if paged:
+            # The tiny capacity existed to force the eviction; lift it
+            # before the resume so the restored chain isn't immediately
+            # re-evicted by its own registration (the production
+            # quarter-pool default comfortably holds one chain).
+            b.page_index.capacity = 16
+        before = _kv_counters()
+        pf_before = global_metrics.get("engine.prefill_tokens")
+        r3 = GenRequest(prompt_ids=list(resume), max_new_tokens=6,
+                        session_id="s-res")
+        out = b.submit(r3).result(timeout=600)
+        delta = _kv_delta(before)
+        prefilled = global_metrics.get("engine.prefill_tokens") - pf_before
+    finally:
+        b.stop()
+    assert delta["restores"] >= 1 and delta["host_hits"] >= 1
+    assert delta["prefill_tokens_saved"] > 0
+    # The pin: the resume prefilled strictly less than half its prompt
+    # (dense restores all but the last token; paged all full blocks).
+    assert 0 < prefilled < len(resume) // 2, (
+        f"resume re-prefilled {prefilled} of {len(resume)} tokens"
+    )
+    assert len(out) >= 1
+
+
+# --------------------------------------------------------------------- #
+# Chaos: restores vs the PR 8 fault domain
+# --------------------------------------------------------------------- #
+
+def test_restore_in_flight_unwinds_across_rebuild():
+    """A staged (not yet applied) restore whose pool is rebuilt must
+    unwind cleanly: the stale record is dropped, nothing scatters into
+    the fresh pool, and the consumed host entries RETURN to the cold
+    tier so the recovered re-admission can restore them again — the
+    host tier is rebuild-proof by construction."""
+    b = _resume_engine(paged=True)  # not started: device thread is ours
+    P = b.page_size
+    ids = list(range(40, 40 + 3 * P + 2))
+    # Seed the cold tier with the first two blocks directly.
+    L, K, H = b.cfg.n_layers, b.cfg.n_kv_heads, b.cfg.head_dim
+    for blk in range(2):
+        panel = (
+            jnp.ones((L, K, P, H), jnp.float32) * (blk + 1),
+            jnp.ones((L, K, P, H), jnp.float32) * (blk + 101),
+        )
+        assert b.kvcache.host.put(
+            tuple(ids[: (blk + 1) * P]), panel,
+            tokens=P, rows=P, kind="page",
+        )
+    with b._lock:
+        req = GenRequest(prompt_ids=ids, max_new_tokens=4)
+        node = b._prefix_hit(req)
+    assert node is not None and node.depth == 2
+    assert len(b._pending_restores) == 1
+    assert len(b.kvcache.host) == 0      # consumed by the restore
+    free_before = b.alloc.free_pages
+    # PR 8 recovery path: the pool is rebuilt while the restore is
+    # still pending.
+    b._rebuild_device_state(reason="test_mid_restore")
+    b._apply_restores()
+    assert b._pending_restores == []
+    assert len(b.kvcache.host) == 2, "host entries lost in the unwind"
+    assert len(b.page_index) == 0        # live index died with the pool
+    # Fresh allocator: nothing leaked from the old epoch.
+    assert b.alloc.free_pages >= free_before
+    # And the re-admission path can restore again against the new pool.
+    with b._lock:
+        node2 = b._prefix_hit(GenRequest(prompt_ids=ids, max_new_tokens=4))
+    assert node2 is not None and node2.depth == 2
+    assert len(b._pending_restores) == 1
+
+
+@pytest.mark.parametrize("paged", [False, True], ids=["dense", "paged"])
+def test_resume_recovers_from_prefill_fault_mid_restore(paged):
+    """engine.rebuild-style chaos (ISSUE 10 satellite): the admission
+    dispatch CARRYING a host restore fails with an injected device
+    fault. PR 8 semantics must hold — the request re-admits (bounded
+    strikes) and completes with output byte-identical to an uninjected
+    engine's."""
+    base = [(i % 90) + 5 for i in range(80)]
+    other = [(i % 70) + 11 for i in range(80)]
+    resume = base + [7, 9, 11]
+
+    cfg = get_model_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    want = None
+    for inject in (False, True):
+        b = _resume_engine(paged)
+        b.start()
+        try:
+            b.submit(GenRequest(prompt_ids=list(base), max_new_tokens=6,
+                                session_id="s-c")).result(timeout=600)
+            b.submit(GenRequest(prompt_ids=list(other),
+                                max_new_tokens=6)).result(timeout=600)
+            before = _kv_counters()
+            rec_before = global_metrics.get("engine.recovery_requeued")
+            if inject:
+                global_injector.arm(
+                    "engine.prefill",
+                    RuntimeError("injected fault mid-restore"), times=1,
+                )
+            try:
+                out = b.submit(GenRequest(
+                    prompt_ids=list(resume), max_new_tokens=6,
+                    session_id="s-c",
+                )).result(timeout=600)
+            finally:
+                global_injector.disarm("engine.prefill")
+            delta = _kv_delta(before)
+        finally:
+            b.stop()
+        assert delta["restores"] >= 1, "scenario never exercised a restore"
+        if not inject:
+            want = out
+        else:
+            assert global_injector.fired("engine.prefill") >= 1
+            assert (
+                global_metrics.get("engine.recovery_requeued") > rec_before
+            ), "fault did not route through PR 8 recovery"
+            assert out == want, "recovery after mid-restore fault changed output"
+
+
+# --------------------------------------------------------------------- #
+# Session threading (HTTP edge -> params -> engine)
+# --------------------------------------------------------------------- #
+
+def test_server_session_id_parsing():
+    from pilottai_tpu.server import APIServer, _HttpError
+
+    sid = APIServer._session_id
+    assert sid({"session_id": "abc-123"}, {}) == "abc-123"
+    assert sid({}, {"x-session-id": "s.9"}) == "s.9"
+    assert sid({"session_id": "body"}, {"x-session-id": "hdr"}) == "body"
+    assert sid({}, {}) is None
+    with pytest.raises(_HttpError):
+        sid({"session_id": "bad session!"}, {})
+    with pytest.raises(_HttpError):
+        sid({"session_id": "x" * 65}, {})
+
+
+def test_handler_threads_session_id_into_params():
+    from pilottai_tpu.core.config import LLMConfig
+    from pilottai_tpu.engine.handler import LLMHandler
+    from pilottai_tpu.engine.types import GenerationParams
+
+    h = LLMHandler(LLMConfig(provider="mock"))
+    _, _, p = h._normalize(
+        ["hi"], None, None, None, session_id="sess-42"
+    )
+    assert p.session_id == "sess-42"
+    # Explicit params win over the caller-level default.
+    explicit = GenerationParams(session_id="explicit")
+    _, _, p2 = h._normalize(["hi"], None, explicit, None,
+                            session_id="sess-42")
+    assert p2.session_id == "explicit"

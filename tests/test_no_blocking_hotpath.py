@@ -31,13 +31,24 @@ HOT_PATH = {
     "_dispatch_chunk", "_advance_segment", "_requeue_prepared",
     "_expire_deadlines", "_schema_tables", "_maybe_register",
     "_maybe_export", "_pick_chunk_blocks", "_chunk_useful",
+    "_apply_restores",
     # admission-prep thread
     "_prep_loop", "_select_groups", "_prepare_prefill", "_drain_pending",
+    "_prefix_hit",
     # reader thread
     "_read_loop", "_process_chunk", "_drain_first_reads",
     "_fold_first_tokens", "_check_finished", "_fire_stream",
     "_fail_group", "_fail_occupied_slots", "_release_pages_locked",
 }
+
+# KV cache tier (engine/kvcache/, ISSUE 10): the spill path runs at
+# eviction time on the device/prep threads and the restore path on the
+# prep thread under the slot lock — a blocking device read in either
+# would re-serialize host and device exactly like one in the batcher.
+# The whole package is scanned; the only sanctioned wait is
+# ``SpillCopy.wait`` (materializes a copy STARTED at spill time — the
+# _HostCopy discipline), so np.asarray is allowed only inside ``wait``.
+KV_ASARRAY_ALLOWED_FUNCS = {"wait"}
 
 # Attribute calls that block the calling thread on the device, in any
 # spelling (``jax.device_get(x)`` and ``x.block_until_ready()`` are both
@@ -102,6 +113,80 @@ def test_no_blocking_calls_on_dispatch_or_fold_path():
         "blocking device reads reintroduced on the device-feed hot path "
         f"(use _HostCopy started at dispatch time instead): {violations}"
     )
+
+
+def _kvcache_violations():
+    """Banned blocking calls anywhere in the KV cache tier package —
+    spill starts async D2H at eviction, restore stages async H2D on the
+    prep thread; neither may ever block on the device. np.asarray is
+    legal only inside ``wait`` (the sanctioned materialize of a copy
+    already in flight) or on literal host data."""
+    import pilottai_tpu.engine.kvcache.host_tier as host_mod
+    import pilottai_tpu.engine.kvcache.index as index_mod
+    import pilottai_tpu.engine.kvcache.radix as radix_mod
+
+    out = []
+    for mod in (host_mod, index_mod, radix_mod):
+        tree = ast.parse(inspect.getsource(mod))
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                fn = call.func
+                if isinstance(fn, ast.Attribute) and fn.attr in BANNED_ATTRS:
+                    out.append((mod.__name__, node.name, call.lineno,
+                                ast.unparse(fn)))
+                elif (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr == "asarray"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in ("np", "numpy")
+                    and node.name not in KV_ASARRAY_ALLOWED_FUNCS
+                    # Literal host data (list/tuple/constant) never
+                    # blocks on a device transfer.
+                    and not (call.args and isinstance(
+                        call.args[0],
+                        (ast.List, ast.Tuple, ast.Constant),
+                    ))
+                ):
+                    out.append((mod.__name__, node.name, call.lineno,
+                                f"np.asarray({ast.unparse(call.args[0])})"
+                                if call.args else "np.asarray(...)"))
+                elif isinstance(fn, ast.Name) and fn.id in BANNED_ATTRS:
+                    out.append((mod.__name__, node.name, call.lineno, fn.id))
+    return out
+
+
+def test_no_blocking_calls_in_kvcache_tier():
+    violations = _kvcache_violations()
+    assert not violations, (
+        "blocking device reads in the KV cache tier's spill/restore "
+        f"path (use SpillCopy started at spill time instead): {violations}"
+    )
+
+
+def test_kvcache_spill_copy_is_the_sanctioned_wait():
+    """SpillCopy must start its copies at construction (spill time) and
+    expose only a wait() that materializes them — the structure the
+    kvcache scan's allowlist assumes. The restore paths must route
+    through it."""
+    from pilottai_tpu.engine.kvcache.host_tier import SpillCopy
+    from pilottai_tpu.engine.kvcache.index import KVCacheIndex
+
+    src = inspect.getsource(SpillCopy)
+    tree = ast.parse(textwrap.dedent(src))
+    init_src = ""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "__init__":
+            init_src = ast.unparse(node)
+    assert "copy_to_host_async" in init_src, (
+        "SpillCopy.__init__ no longer starts the async copy — restores "
+        "would pay a full blocking round trip"
+    )
+    assert ".wait()" in inspect.getsource(KVCacheIndex.lookup_dense)
+    assert ".wait()" in inspect.getsource(KVCacheIndex.lookup_paged)
 
 
 def test_tripwire_detects_reintroduced_device_get():
