@@ -1,19 +1,54 @@
-"""Cross-host orchestrator↔agent control plane (SURVEY §2.14).
+"""Cross-host serving & orchestration plane.
 
-The reference declared networking intent it never built (websockets dep,
-``pilott/pyproject.toml:19``; dead websocket config fields,
-``pilott/core/config.py:153-156``). Here it exists: ``ServeEndpoint``
-attaches a TCP listener to a :class:`~pilottai_tpu.serve.Serve`,
-``AgentWorker`` hosts real agents in other processes/hosts (each with its
-own TPU engine), and :class:`RemoteAgent` proxies make remote agents
-first-class citizens of routing, fault tolerance and retry.
+Two layers live here:
+
+* **Control plane** (SURVEY §2.14): ``ServeEndpoint`` attaches a TCP
+  listener to a :class:`~pilottai_tpu.serve.Serve`, ``AgentWorker``
+  hosts real agents in other processes/hosts (each with its own TPU
+  engine), and :class:`RemoteAgent` proxies make remote agents
+  first-class citizens of routing, fault tolerance and retry. Worker
+  heartbeats carry the replica routing signals (SLO burn, degrade
+  rung, queue depth) so remote engines are routable by the same policy
+  as in-process ones.
+* **Serving cell** (ISSUE 11 / ROADMAP item 2): :class:`ServingCell`
+  fronts N engine replicas with a KV-affinity router
+  (:class:`ReplicaRouter` over a radix :class:`RoutingTable`),
+  SLO-aware cell-boundary shedding, cross-replica session migration in
+  the host tier's transfer format, and zero-downtime replica drain.
 """
 
+from pilottai_tpu.distributed.cell import (
+    CellReplica,
+    ServingCell,
+    session_kv_from_wire,
+    session_kv_to_wire,
+)
 from pilottai_tpu.distributed.control_plane import (
     AgentWorker,
     FrameAuth,
     RemoteAgent,
     ServeEndpoint,
 )
+from pilottai_tpu.distributed.router import (
+    CellOverloaded,
+    ReplicaRouter,
+    ReplicaSignals,
+    RoutingTable,
+    route_key,
+)
 
-__all__ = ["AgentWorker", "FrameAuth", "RemoteAgent", "ServeEndpoint"]
+__all__ = [
+    "AgentWorker",
+    "CellOverloaded",
+    "CellReplica",
+    "FrameAuth",
+    "RemoteAgent",
+    "ReplicaRouter",
+    "ReplicaSignals",
+    "RoutingTable",
+    "ServeEndpoint",
+    "ServingCell",
+    "route_key",
+    "session_kv_from_wire",
+    "session_kv_to_wire",
+]

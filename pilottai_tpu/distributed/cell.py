@@ -1,0 +1,807 @@
+"""Serving cell: N engine replicas behind one KV-affinity front door.
+
+ISSUE 11 / ROADMAP item 2 — the million-user shape is many engine
+replicas behind one admission point, not one bigger engine. A
+:class:`ServingCell` hosts N replicas in one process (each its own
+``LLMHandler`` + batcher + per-replica SLO registry, so tests and bench
+run a realistic cell without N processes) and routes every request with
+:class:`~pilottai_tpu.distributed.router.ReplicaRouter`:
+
+* **KV affinity** — a cell-level radix routing table (prompt byte
+  prefixes → last-serving replica) plus sticky session pins, so a
+  session's next turn lands where its KV already lives (a restore or a
+  hot prefix hit instead of a full re-prefill).
+* **SLO headroom** — each replica carries its own
+  :class:`~pilottai_tpu.obs.SLOTracker` (own ``MetricsRegistry``); the
+  router reads per-class burn rate per replica, and the cell sheds a
+  class at the boundary once *every* routable replica is past that
+  class's admission threshold — before any replica's own queue shed.
+* **Fault routing** — a watchdog-stalled, breaker-open or draining
+  replica never receives new work; a replica-level failure re-routes
+  the request to a sibling (bounded attempts), so one dying replica
+  reads as latency, not errors, at the cell boundary.
+
+The creative rung: the host cold tier's spill format is also the
+**transfer** format. ``migrate_session`` exports a session's KV lineage
+from its owner (host entries move, device-resident panels/pages copy to
+host numpy) and imports it into another replica's host tier — the
+session's next turn restores there, byte-identical by the tier's parity
+contract (same weights across replicas by construction). ``drain``
+composes that with request re-admission for zero-downtime replica
+removal: new work routes away instantly, pinned sessions migrate, and
+in-flight unary requests past the grace window are cancelled and
+re-admitted on a sibling (full greedy re-execution — the cell-level
+analogue of PR 8's snapshot + re-admit). Mid-stream requests are the
+non-migratable shape (their deltas are already on the wire; the drain
+waits for them within grace), same boundary as PR 8's mid-stream
+json/schema recovery rule — see docs/SERVING.md "Serving cell".
+
+The cell duck-types ``LLMHandler`` (``generate_response`` / ``astream``
+/ ``apredict`` / ``config`` / ``get_metrics``), so ``APIServer`` serves
+a cell exactly like a single engine; ``/healthz`` and ``/slo.json``
+aggregate across replicas via ``health_snapshot`` / ``slo_snapshot``.
+
+Import cost: stdlib + numpy + handler/obs/reliability — no jax at
+import time (the engines themselves import it lazily when they boot).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import time
+from collections import OrderedDict
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from pilottai_tpu.distributed.router import (
+    CellOverloaded,
+    ReplicaRouter,
+    ReplicaSignals,
+    RoutingTable,
+    route_key,
+)
+from pilottai_tpu.engine.handler import LLMHandler
+from pilottai_tpu.obs import DEFAULT_CLASS, SLOTracker
+from pilottai_tpu.reliability import (
+    CircuitOpenError,
+    DeadlineExceeded,
+    EngineOverloaded,
+    global_engine_health,
+)
+from pilottai_tpu.utils.logging import get_logger
+from pilottai_tpu.utils.metrics import MetricsRegistry, global_metrics
+
+
+class CellReplica:
+    """One replica: an ``LLMHandler`` plus the cell-side bookkeeping the
+    router reads (per-replica SLO tracker on its own registry, in-flight
+    count, draining flag)."""
+
+    def __init__(
+        self,
+        replica_id: str,
+        handler: LLMHandler,
+        slo_classes=None,
+        soft_inflight: Optional[int] = None,
+    ) -> None:
+        self.replica_id = replica_id
+        self.handler = handler
+        #: Per-replica obs registry: the replica's SLO series live here,
+        #: namespaced by object instead of by string prefix — N replicas
+        #: in one process can't collide on ``slo.interactive.*``.
+        self.registry = MetricsRegistry()
+        self.slo = SLOTracker(classes=slo_classes, registry=self.registry)
+        self.draining = False
+        self.inflight = 0
+        #: Soft in-flight norm for queue_frac when the backend exposes no
+        #: engine queue (mock replicas, engine not yet booted).
+        self.soft_inflight = soft_inflight or max(
+            getattr(handler.config, "max_concurrent_requests", 8) or 8, 1
+        )
+        self._calls: set = set()
+        #: Tasks the DRAIN cancelled (vs the caller): the execute loop
+        #: re-admits exactly these — inferring from the draining flag
+        #: would misread a client disconnect racing a drain as a
+        #: re-admission and resurrect an abandoned request.
+        self._drain_cancelled: set = set()
+
+    @property
+    def health_source(self) -> Optional[str]:
+        """This replica's ``EngineHealth`` source (the engine watchdog's
+        name when it has one, else a cell-scoped name tests can trip)."""
+        batcher = getattr(self.handler.backend, "batcher", None)
+        src = getattr(batcher, "watchdog_source", None)
+        return src if src is not None else f"cell:{self.replica_id}"
+
+    def signals(self) -> ReplicaSignals:
+        """The router's view of this replica, combining engine-side
+        signals (queue/degrade/watchdog, when an engine is up) with
+        cell-side ones (in-flight count, per-class burn, breaker,
+        draining)."""
+        raw = getattr(self.handler.backend, "routing_signals", None)
+        sig = raw() if callable(raw) else {}
+        depth = int(sig.get("queue_depth", 0)) + self.inflight
+        queue_frac = max(
+            float(sig.get("queue_frac", 0.0)),
+            self.inflight / self.soft_inflight,
+        )
+        self.slo.refresh_gauges()
+        burn = {
+            cls: self.registry.get(f"slo.{cls}.burn_rate")
+            for cls in self.slo.classes
+        }
+        breaker = self.handler.breaker
+        breaker_open = breaker is not None and breaker.state == "open"
+        healthy = bool(
+            sig.get("healthy", True)
+        ) and global_engine_health.source_healthy(self.health_source)
+        return ReplicaSignals(
+            replica_id=self.replica_id,
+            queue_depth=depth,
+            queue_frac=queue_frac,
+            degrade_level=int(sig.get("degrade_level", 0)),
+            burn_rate=burn,
+            healthy=healthy,
+            breaker_open=breaker_open,
+            draining=self.draining,
+        )
+
+
+class ServingCell:
+    """The cell front door (see module docstring)."""
+
+    def __init__(
+        self,
+        replicas: Iterable[CellReplica | LLMHandler],
+        router: Optional[ReplicaRouter] = None,
+        *,
+        slo_classes=None,
+        reroute_attempts: int = 2,
+        table_capacity: int = 4096,
+        max_sessions: int = 4096,
+    ) -> None:
+        self.replicas: Dict[str, CellReplica] = {}
+        for i, rep in enumerate(replicas):
+            if isinstance(rep, LLMHandler):
+                rep = CellReplica(f"r{i}", rep, slo_classes=slo_classes)
+            self.replicas[rep.replica_id] = rep
+        if not self.replicas:
+            raise ValueError("a serving cell needs at least one replica")
+        self.router = router if router is not None else ReplicaRouter(
+            RoutingTable(capacity=table_capacity)
+        )
+        self.reroute_attempts = max(0, int(reroute_attempts))
+        #: session id → owning replica id (sticky affinity pins).
+        #: Bounded LRU, same rationale as ``HostTier``'s session table:
+        #: client-minted ids must not grow cell state without bound.
+        self.sessions: "OrderedDict[str, str]" = OrderedDict()
+        self.max_sessions = max(1, int(max_sessions))
+        first = next(iter(self.replicas.values()))
+        self._classes = set(first.slo.classes)
+        for cls in self._classes:
+            # Non-default classes: the cell's per-class counters must
+            # exist in the exported surface too (obs/__init__ declares
+            # the default interactive/batch pair at import).
+            global_metrics.declare(f"cell.routed.{cls}", "counter")
+            global_metrics.declare(f"cell.shed.{cls}", "counter")
+        self._log = get_logger("cell")
+        self._started = False
+        global_metrics.set_gauge("cell.replicas", float(len(self.replicas)))
+
+    # ------------------------------------------------------------------ #
+    # LLMHandler duck-type surface (APIServer compatibility)
+    # ------------------------------------------------------------------ #
+
+    @property
+    def config(self):
+        return next(iter(self.replicas.values())).handler.config
+
+    @property
+    def backend(self):
+        """First replica's backend — replicas are identical by
+        construction, so schema-support checks hold cell-wide."""
+        return next(iter(self.replicas.values())).handler.backend
+
+    # ------------------------------------------------------------------ #
+    # Lifecycle
+    # ------------------------------------------------------------------ #
+
+    async def start(self) -> None:
+        if self._started:
+            return
+        for rep in self.replicas.values():
+            await rep.handler.start()
+            self._wire_eviction_decay(rep)
+            if rep.handler.breaker is not None:
+                # Scope the breaker's stall subscription to THIS
+                # replica's engine: a sibling's watchdog stall must not
+                # force-open every breaker in the process (one hung
+                # replica would ground the whole cell).
+                rep.handler.breaker.health_sources = {rep.health_source}
+        self._started = True
+        self._refresh_gauges()
+
+    async def stop(self) -> None:
+        for rep in self.replicas.values():
+            await rep.handler.stop()
+        self._started = False
+
+    def _wire_eviction_decay(self, rep: CellReplica) -> None:
+        """Affinity must not outlive the KV it points at: when a
+        replica's host tier drops an entry for good (budget eviction —
+        the KV is gone from BOTH tiers), ``HostTier.on_evict`` offers
+        the evicted key to the routing table. The decay is EXACT when
+        the table is keyed by the same token ids the engine caches
+        (token-level router deployments; pinned by the unit test). The
+        cell's own table keys are rendered-prompt bytes, which the
+        engine's tokenization/chat rendering generally shifts — there
+        the forget is a best-effort no-op and the table's LRU bound +
+        ``forget_replica`` on drain/death are the decay that holds."""
+        batcher = getattr(rep.handler.backend, "batcher", None)
+        kvcache = getattr(batcher, "kvcache", None)
+        host = getattr(kvcache, "host", None)
+        if host is not None:
+            # Ownership-checked: replica A evicting its copy of a shared
+            # preamble must not decay an entry pointing at replica B,
+            # whose copy is still live.
+            rid = rep.replica_id
+            host.on_evict = (
+                lambda key, _rid=rid: self.router.table.forget_owned(
+                    key, _rid
+                )
+            )
+
+    # ------------------------------------------------------------------ #
+    # Routing
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def _route_text(messages) -> str:
+        if isinstance(messages, str):
+            return messages
+        parts = []
+        for m in messages:
+            if isinstance(m, str):
+                parts.append(m)
+            elif isinstance(m, dict):
+                parts.append(str(m.get("content", "")))
+            else:
+                parts.append(str(getattr(m, "content", "")))
+        return "\n".join(parts)
+
+    def _classify(self, slo_class: Optional[str]) -> str:
+        return slo_class if slo_class in self._classes else DEFAULT_CLASS
+
+    def signals(self) -> List[ReplicaSignals]:
+        return [rep.signals() for rep in self.replicas.values()]
+
+    def _refresh_gauges(
+        self, sigs: Optional[List[ReplicaSignals]] = None
+    ) -> None:
+        # Callers on the routing hot path pass the sweep they already
+        # computed — per-replica signals (SLO window refresh, health
+        # lock, engine probe) are not free twice per request.
+        if sigs is None:
+            sigs = self.signals()
+        global_metrics.set_gauge("cell.replicas", float(len(sigs)))
+        global_metrics.set_gauge(
+            "cell.replicas_routable",
+            float(sum(s.routable() for s in sigs)),
+        )
+        global_metrics.set_gauge("cell.sessions", float(len(self.sessions)))
+        lookups = global_metrics.get("cell.affinity_lookups")
+        if lookups:
+            global_metrics.set_gauge(
+                "cell.affinity_hit_rate",
+                global_metrics.get("cell.affinity_hits") / lookups,
+            )
+
+    def _route(
+        self,
+        key: Sequence[int],
+        cls: str,
+        session_id: Optional[str],
+        exclude: List[str],
+    ) -> tuple:
+        pinned = self.sessions.get(session_id) if session_id else None
+        sigs = self.signals()
+        try:
+            rid, lcp = self.router.pick(
+                key, sigs, slo_class=cls, pinned=pinned, exclude=exclude,
+            )
+        except CellOverloaded as exc:
+            global_metrics.inc(f"cell.shed.{cls}")
+            self._refresh_gauges(sigs)
+            raise EngineOverloaded(str(exc)) from exc
+        global_metrics.inc(f"cell.routed.{cls}")
+        global_metrics.inc("cell.affinity_lookups")
+        if lcp > 0 or (pinned is not None and pinned == rid):
+            global_metrics.inc("cell.affinity_hits")
+        self._refresh_gauges(sigs)
+        return rid, lcp
+
+    def _after_success(
+        self, rid: str, key: Sequence[int], session_id: Optional[str]
+    ) -> None:
+        self.router.table.note(key, rid)
+        if not session_id:
+            return
+        rep = self.replicas.get(rid)
+        if rep is None or rep.draining:
+            # Never (re-)pin to a draining/detached replica — a request
+            # finishing inside the drain's grace window must not undo
+            # the drain's migration.
+            return
+        cur = self.sessions.get(session_id)
+        if cur is not None and cur != rid:
+            cur_rep = self.replicas.get(cur)
+            if cur_rep is not None and not cur_rep.draining:
+                # The pin moved (migration/rebalance) while this request
+                # was in flight: the newer LIVE pin owns the session's
+                # KV now — a stale completion must not re-pin the old
+                # owner and strand the migrated KV. (A dead/draining
+                # current pin DOES yield: failover re-pins here.)
+                return
+        self.sessions[session_id] = rid
+        self.sessions.move_to_end(session_id)
+        while len(self.sessions) > self.max_sessions:
+            self.sessions.popitem(last=False)
+
+    # ------------------------------------------------------------------ #
+    # Request execution
+    # ------------------------------------------------------------------ #
+
+    async def generate_response(
+        self,
+        messages,
+        tools=None,
+        params=None,
+        json_mode=None,
+        json_schema=None,
+        slo_class: Optional[str] = None,
+        session_id: Optional[str] = None,
+    ):
+        """Route-and-execute with bounded re-routing: replica faults
+        (including a drain cancelling the in-flight call) re-admit on a
+        sibling; client-semantic failures (deadline, cell shed) do not."""
+        cls = self._classify(
+            slo_class or getattr(params, "slo_class", None)
+        )
+        sid = session_id or getattr(params, "session_id", None)
+        key = route_key(self._route_text(messages))
+        excluded: List[str] = []
+        attempts = 0
+        # Client-observed clock: started ONCE, before any attempt — a
+        # rerouted request's recorded e2e must include the failed
+        # attempts the client also waited through, charged to the
+        # replica that finally served it.
+        t0 = time.perf_counter()
+        while True:
+            rid, _lcp = self._route(key, cls, sid, excluded)
+            rep = self.replicas[rid]
+            rep.inflight += 1
+            task = asyncio.ensure_future(rep.handler.generate_response(
+                messages, tools=tools, params=params, json_mode=json_mode,
+                json_schema=json_schema, slo_class=cls, session_id=sid,
+            ))
+            rep._calls.add(task)
+            try:
+                response = await task
+            except asyncio.CancelledError:
+                was_drain = task in rep._drain_cancelled
+                rep._drain_cancelled.discard(task)
+                if task.cancelled() and was_drain:
+                    # Drain re-admission: the DRAIN cancelled this task
+                    # (explicit marker — a client disconnect racing the
+                    # drain must keep propagating as a cancel, not
+                    # resurrect the request on a sibling). Re-route the
+                    # whole request: pure re-execution, byte-identical
+                    # greedy output on an identical sibling. Routine
+                    # operation — no SLO miss recorded.
+                    global_metrics.inc("cell.rerouted")
+                    excluded.append(rid)
+                    continue
+                task.cancel()
+                raise
+            except DeadlineExceeded:
+                # Terminal client outcome: the budget is gone wherever
+                # we'd route next.
+                rep.slo.record(cls, ok=False)
+                raise
+            except (EngineOverloaded, CircuitOpenError):
+                # Backpressure / fast-fail below the cell's threshold
+                # (racy burst, breaker race): try a sibling. The queue
+                # and breaker signals already carry this state — a miss
+                # is recorded only when the request terminally fails,
+                # else a retried-then-served request would count twice
+                # (once as a phantom miss) and sink reported attainment
+                # below what clients actually observed.
+                excluded.append(rid)
+                attempts += 1
+                if attempts <= self.reroute_attempts:
+                    global_metrics.inc("cell.rerouted")
+                    continue
+                rep.slo.record(cls, ok=False)
+                raise
+            except Exception:
+                # Replica fault: burn THIS replica's budget (the router
+                # reads it) and re-route, bounded.
+                rep.slo.record(cls, ok=False)
+                excluded.append(rid)
+                attempts += 1
+                if attempts <= self.reroute_attempts:
+                    global_metrics.inc("cell.rerouted")
+                    continue
+                raise
+            finally:
+                rep.inflight -= 1
+                rep._calls.discard(task)
+            rep.slo.record(
+                cls, e2e_s=time.perf_counter() - t0, ok=True
+            )
+            self._after_success(rid, key, sid)
+            return response
+
+    async def apredict(self, prompt: str, **kwargs: Any) -> str:
+        response = await self.generate_response([prompt], **kwargs)
+        return response.content
+
+    async def astream(
+        self,
+        messages,
+        tools=None,
+        params=None,
+        json_mode=None,
+        json_schema=None,
+        slo_class: Optional[str] = None,
+        session_id: Optional[str] = None,
+        info: Optional[Dict[str, Any]] = None,
+    ):
+        """Streaming path: routed once — a stream whose deltas reached
+        the consumer is the non-migratable shape (drain waits for it
+        within grace; docs/SERVING.md), so no mid-stream re-route."""
+        cls = self._classify(
+            slo_class or getattr(params, "slo_class", None)
+        )
+        sid = session_id or getattr(params, "session_id", None)
+        key = route_key(self._route_text(messages))
+        rid, _lcp = self._route(key, cls, sid, [])
+        rep = self.replicas[rid]
+        t0 = time.perf_counter()
+        rep.inflight += 1
+        ok = False
+        abandoned = False
+        try:
+            async for delta in rep.handler.astream(
+                messages, tools=tools, params=params, json_mode=json_mode,
+                json_schema=json_schema, slo_class=cls, session_id=sid,
+                info=info,
+            ):
+                yield delta
+            ok = True
+        except (GeneratorExit, asyncio.CancelledError):
+            # Consumer walked away — not the replica's failure. Charging
+            # it as a miss would raise this replica's burn rate and
+            # steer the router away from a healthy replica that merely
+            # served flaky clients.
+            abandoned = True
+            raise
+        finally:
+            rep.inflight -= 1
+            if not abandoned:
+                rep.slo.record(
+                    cls, e2e_s=time.perf_counter() - t0, ok=ok
+                )
+            if ok:
+                self._after_success(rid, key, sid)
+
+    # ------------------------------------------------------------------ #
+    # Session migration + drain (the transfer-format rung)
+    # ------------------------------------------------------------------ #
+
+    def _pick_target(self, exclude: Sequence[str]) -> str:
+        """Migration target: the least-loaded ROUTABLE sibling. This is
+        a control-plane move, not an admission — class shed thresholds
+        don't apply (a saturated-but-healthy sibling still accepts a
+        session's KV; it just serves the next turn slower)."""
+        excluded = set(exclude)
+        candidates = [
+            s for s in self.signals()
+            if s.routable() and s.replica_id not in excluded
+        ]
+        if not candidates:
+            raise CellOverloaded(
+                "no routable replica to migrate the session to"
+            )
+        return min(
+            candidates, key=lambda s: (s.queue_frac, s.replica_id)
+        ).replica_id
+
+    async def migrate_session(
+        self, session_id: str, target_id: Optional[str] = None
+    ) -> Dict[str, Any]:
+        """Move a session's KV lineage (and its affinity pin) to another
+        replica via the host tier's transfer format. Safe to call on a
+        backend without the KV tier — only the pin moves and the target
+        re-prefills (correct, just slower)."""
+        src_id = self.sessions.get(session_id)
+        if src_id is None:
+            raise ValueError(f"unknown session {session_id!r}")
+        if target_id is None:
+            target_id = self._pick_target(exclude=[src_id])
+        if target_id == src_id:
+            raise ValueError("migration target is the session's owner")
+        src = self.replicas[src_id]
+        dst = self.replicas[target_id]
+        t0 = time.perf_counter()
+        loop = asyncio.get_running_loop()
+        export = None
+        exporter = getattr(src.handler.backend, "export_session_kv", None)
+        if callable(exporter):
+            # Blocking device→host gathers: off the event loop.
+            export = await loop.run_in_executor(None, exporter, session_id)
+        accepted = 0
+        tokens = 0
+        if export:
+            importer = getattr(dst.handler.backend, "import_session_kv", None)
+            if callable(importer):
+                landed = await loop.run_in_executor(None, importer, export)
+                accepted = int(landed.get("accepted", 0))
+                # Only KV that actually LANDED on the target counts as
+                # migrated — budget-rejected entries stay source-side
+                # copies and will re-prefill, and the metric must not
+                # claim otherwise.
+                tokens = int(landed.get("tokens", 0))
+        self.sessions[session_id] = target_id
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        global_metrics.inc("cell.migrations")
+        global_metrics.inc("cell.migrated_entries", accepted)
+        global_metrics.inc("cell.migrated_tokens", tokens)
+        global_metrics.observe("cell.migration_ms", wall_ms)
+        self._log.info(
+            "migrated session %s: %s -> %s (%d/%d entries, %d tokens, "
+            "%.1f ms)",
+            session_id, src_id, target_id, accepted,
+            len(export["entries"]) if export else 0, tokens, wall_ms,
+        )
+        return {
+            "session_id": session_id,
+            "from": src_id,
+            "to": target_id,
+            "entries": len(export["entries"]) if export else 0,
+            "accepted": accepted,
+            "tokens": tokens,
+            "migration_ms": round(wall_ms, 3),
+        }
+
+    async def drain(
+        self, replica_id: str, grace_s: float = 5.0,
+    ) -> Dict[str, Any]:
+        """Zero-downtime replica drain: stop routing to it immediately,
+        migrate its pinned sessions, give in-flight work ``grace_s`` to
+        finish, then cancel the stragglers — the cell's execute loop
+        re-admits each cancelled unary request on a sibling (snapshot +
+        re-admit at request granularity). The replica stays registered
+        (and stopped-routable) until ``undrain`` or ``remove_replica``."""
+        rep = self.replicas[replica_id]
+        t0 = time.perf_counter()
+        rep.draining = True
+        self._refresh_gauges()
+        migrated = []
+        others = [r for r in self.replicas if r != replica_id]
+        if others:
+            for sid, owner in list(self.sessions.items()):
+                if owner != replica_id:
+                    continue
+                try:
+                    migrated.append(await self.migrate_session(sid))
+                except Exception as exc:  # noqa: BLE001 — drain proceeds
+                    # No routable target / export race: drop the pin so
+                    # the session's next turn routes fresh (it
+                    # re-prefills — correct, just slower) instead of
+                    # sticking to a draining replica.
+                    self.sessions.pop(sid, None)
+                    self._log.warning(
+                        "session %s could not migrate during drain of "
+                        "%s: %s", sid, replica_id, exc,
+                    )
+        deadline = time.monotonic() + max(grace_s, 0.0)
+        while rep.inflight and time.monotonic() < deadline:
+            await asyncio.sleep(0.01)
+        readmitted = 0
+        for task in list(rep._calls):
+            if not task.done():
+                # Mark BEFORE cancelling: the execute loop re-admits
+                # exactly the tasks the drain cancelled.
+                rep._drain_cancelled.add(task)
+                task.cancel()
+                readmitted += 1
+        # Let the re-admissions detach before reporting — bounded: a
+        # straggler stuck in a non-cancellable section must not wedge
+        # the drain (it finishes or fails on its own; routing to this
+        # replica is already off either way).
+        cancel_deadline = time.monotonic() + 30.0
+        while rep.inflight and time.monotonic() < cancel_deadline:
+            await asyncio.sleep(0.01)
+        self.router.table.forget_replica(replica_id)
+        wall = time.perf_counter() - t0
+        global_metrics.inc("cell.drains")
+        global_metrics.observe("cell.drain_s", wall)
+        self._refresh_gauges()
+        self._log.info(
+            "drained %s in %.2fs (%d sessions migrated, %d re-admitted)",
+            replica_id, wall, len(migrated), readmitted,
+        )
+        return {
+            "replica_id": replica_id,
+            "drain_s": round(wall, 3),
+            "migrated_sessions": len(migrated),
+            "migrations": migrated,
+            "readmitted": readmitted,
+        }
+
+    def undrain(self, replica_id: str) -> None:
+        self.replicas[replica_id].draining = False
+        self._refresh_gauges()
+
+    async def remove_replica(self, replica_id: str) -> Dict[str, Any]:
+        """Drain then detach and stop a replica (rolling rebuild)."""
+        report = await self.drain(replica_id)
+        rep = self.replicas.pop(replica_id)
+        await rep.handler.stop()
+        self._refresh_gauges()
+        return report
+
+    # ------------------------------------------------------------------ #
+    # Aggregated health / SLO / metrics surfaces
+    # ------------------------------------------------------------------ #
+
+    def health_snapshot(self) -> Dict[str, Any]:
+        """The cell ``/healthz`` shape: ok while at least one replica is
+        routable; per-replica verdicts attached so an operator sees
+        WHICH replica grounded."""
+        sigs = self.signals()
+        routable = [s for s in sigs if s.routable()]
+        # PR 8 503 contract: a grounded cell still hints when to come
+        # back (the largest retry_after across stalled engine sources;
+        # breakers' own recovery_timeout is the same order).
+        health = global_engine_health.snapshot()
+        return {
+            "ok": bool(routable),
+            "replicas": len(sigs),
+            "routable": len(routable),
+            "retry_after": health.get("retry_after", 0.0),
+            "draining": sorted(
+                s.replica_id for s in sigs if s.draining
+            ),
+            "stalled": sorted(
+                s.replica_id for s in sigs if not s.healthy
+            ),
+            "per_replica": {s.replica_id: s.to_payload() for s in sigs},
+        }
+
+    def slo_snapshot(self) -> Dict[str, Any]:
+        """The cell ``/slo.json`` shape: per-class aggregate (request-
+        weighted attainment/burn, worst-replica p99) plus each replica's
+        own tracker snapshot."""
+        per: Dict[str, Any] = {
+            rid: rep.slo.snapshot() for rid, rep in self.replicas.items()
+        }
+        agg: Dict[str, Any] = {}
+        for cls in sorted(self._classes):
+            entries = [
+                snap[cls] for snap in per.values() if cls in snap
+            ]
+            if not entries:
+                continue
+            requests = sum(e["requests"] for e in entries)
+            missed = sum(e["missed"] for e in entries)
+            windows = sum(e["window"] for e in entries)
+            # No traffic = no misses: an idle cell reports attainment
+            # 1.0 / burn 0.0, matching the single-engine surface (a
+            # zero-filled aggregate would fire attainment alerts on
+            # every fresh boot).
+            agg[cls] = {
+                "requests": requests,
+                "missed": missed,
+                "attainment": round(sum(
+                    e["attainment"] * e["window"] for e in entries
+                ) / windows, 4) if windows else 1.0,
+                "burn_rate": round(sum(
+                    e["burn_rate"] * e["window"] for e in entries
+                ) / windows, 4) if windows else 0.0,
+                "ttft_p99_s": max(
+                    (e["ttft_p99_s"] for e in entries
+                     if e.get("ttft_p99_s") is not None), default=None,
+                ),
+                "e2e_p99_s": max(
+                    (e["e2e_p99_s"] for e in entries
+                     if e.get("e2e_p99_s") is not None), default=None,
+                ),
+                "targets": entries[0]["targets"],
+            }
+        return {"aggregate": True, "classes": agg, "replicas": per}
+
+    def get_metrics(self) -> Dict[str, Any]:
+        self._refresh_gauges()
+        cell = {
+            name.split("cell.", 1)[1]: global_metrics.get(name)
+            for name in (
+                "cell.affinity_lookups", "cell.affinity_hits",
+                "cell.affinity_hit_rate", "cell.rerouted",
+                "cell.migrations", "cell.migrated_tokens", "cell.drains",
+            )
+        }
+        for cls in sorted(self._classes):
+            cell[f"routed.{cls}"] = global_metrics.get(f"cell.routed.{cls}")
+            cell[f"shed.{cls}"] = global_metrics.get(f"cell.shed.{cls}")
+        return {
+            "cell": cell,
+            "sessions": len(self.sessions),
+            "replicas": {
+                rid: rep.handler.get_metrics()
+                for rid, rep in self.replicas.items()
+            },
+        }
+
+
+# --------------------------------------------------------------------- #
+# Wire form of the transfer format (control-plane ready)
+# --------------------------------------------------------------------- #
+
+def session_kv_to_wire(export: Dict[str, Any]) -> Dict[str, Any]:
+    """JSON-safe form of ``export_session_kv``'s record: arrays as
+    base64 + dtype + shape — the shape a control-plane frame can carry
+    to a remote worker's ``import_session_kv``."""
+    def pack(a: np.ndarray) -> Dict[str, Any]:
+        a = np.ascontiguousarray(a)
+        return {
+            "dtype": str(a.dtype),
+            "shape": list(a.shape),
+            "data": base64.b64encode(a.tobytes()).decode("ascii"),
+        }
+
+    return {
+        "session_id": export["session_id"],
+        "ids": list(export["ids"]),
+        "entries": [
+            {
+                "key": list(e["key"]),
+                "tokens": e["tokens"], "rows": e["rows"],
+                "meta": e["meta"], "kind": e["kind"],
+                "k": pack(e["k"]), "v": pack(e["v"]),
+            }
+            for e in export["entries"]
+        ],
+    }
+
+
+def session_kv_from_wire(payload: Dict[str, Any]) -> Dict[str, Any]:
+    def unpack(p: Dict[str, Any]) -> np.ndarray:
+        return np.frombuffer(
+            base64.b64decode(p["data"]), dtype=np.dtype(p["dtype"])
+        ).reshape(p["shape"])
+
+    return {
+        "session_id": payload["session_id"],
+        "ids": list(payload["ids"]),
+        "entries": [
+            {
+                "key": list(e["key"]),
+                "tokens": e["tokens"], "rows": e["rows"],
+                "meta": e["meta"], "kind": e["kind"],
+                "k": unpack(e["k"]), "v": unpack(e["v"]),
+            }
+            for e in payload["entries"]
+        ],
+    }
+
+
+__all__ = [
+    "CellReplica",
+    "ServingCell",
+    "session_kv_from_wire",
+    "session_kv_to_wire",
+]

@@ -47,6 +47,8 @@ from pilottai_tpu.core.agent import BaseAgent
 from pilottai_tpu.core.config import AgentConfig
 from pilottai_tpu.core.status import AgentStatus
 from pilottai_tpu.core.task import Task, TaskResult
+from pilottai_tpu.obs import global_slo
+from pilottai_tpu.reliability import global_engine_health
 from pilottai_tpu.utils.logging import get_logger
 from pilottai_tpu.utils.metrics import global_metrics
 
@@ -174,6 +176,11 @@ class RemoteAgent:
         }
         self._inflight = 0
         self._last_heartbeat = time.time()
+        # Replica routing signals (ISSUE 11): the worker's heartbeat
+        # ships its host's SLO burn / degrade / queue / health snapshot
+        # so a cell-style router can rank this worker's engine by the
+        # same policy as an in-process replica.
+        self.signals: Dict[str, Any] = {}
         self._log = get_logger(
             "remote_agent", agent_id=self.id[:8], role=self.role
         )
@@ -296,6 +303,24 @@ class RemoteAgent:
             "current_tasks": self._inflight,
         }
 
+    def routing_signals(self) -> Dict[str, Any]:
+        """The heartbeat-fed signals in ``ReplicaSignals.from_payload``
+        shape — remote engines rank on the same scale as in-process
+        cell replicas (``distributed/router.py``)."""
+        eng = self.signals.get("engine") or {}
+        slo = self.signals.get("slo") or {}
+        return {
+            "replica_id": self.id,
+            "queue_depth": int(eng.get("queue_depth", 0) or 0),
+            "queue_frac": float(eng.get("queue_frac", 0.0) or 0.0),
+            "degrade_level": int(eng.get("degrade_level", 0) or 0),
+            "healthy": bool(eng.get("healthy", True)),
+            "burn_rate": {
+                cls: float((v or {}).get("burn_rate", 0.0))
+                for cls, v in slo.items()
+            },
+        }
+
     def get_metrics(self) -> Dict[str, Any]:
         return {
             "agent_id": self.id,
@@ -324,6 +349,9 @@ class ServeEndpoint:
         self._writers: Dict[str, asyncio.StreamWriter] = {}
         self._proxies: Dict[str, List[RemoteAgent]] = {}
         self._pending: Dict[str, asyncio.Future] = {}
+        #: worker_id -> latest heartbeat routing-signal snapshot (SLO
+        #: burn per class, degrade level, queue depth, engine health).
+        self.worker_signals: Dict[str, Dict[str, Any]] = {}
         self._log = get_logger("serve_endpoint")
 
     async def start(self) -> None:
@@ -387,8 +415,13 @@ class ServeEndpoint:
                 if kind == "heartbeat":
                     now = time.time()
                     stats = msg.get("agents", {})
+                    signals = msg.get("signals")
+                    if isinstance(signals, dict):
+                        self.worker_signals[worker_id] = signals
                     for proxy in proxies:
                         proxy._last_heartbeat = now
+                        if isinstance(signals, dict):
+                            proxy.signals = signals
                         s = stats.get(proxy.id)
                         if s:
                             proxy._stats.update({
@@ -435,6 +468,7 @@ class ServeEndpoint:
                     pass
 
     async def _drop_worker(self, worker_id: str, reason: str) -> None:
+        self.worker_signals.pop(worker_id, None)
         writer = self._writers.pop(worker_id, None)
         if writer is not None:
             try:
@@ -624,6 +658,43 @@ class AgentWorker:
             hb.cancel()
             self._writer = None
 
+    def _routing_signals(self) -> Dict[str, Any]:
+        """This host's replica routing signals (ISSUE 11): per-class SLO
+        burn rate / attainment, the engine's degrade rung and queue
+        depth, and the watchdog health verdict — the same surface an
+        in-process cell replica exposes, so the orchestrator side can
+        rank remote engines with the identical policy. Reads only
+        process-global gauges (cheap; no engine lock)."""
+        global_slo.refresh_gauges()
+        depth = global_metrics.get("engine.queue_depth")
+        limit = global_metrics.get("engine.max_queue_depth")
+        return {
+            "slo": {
+                cls: {
+                    "burn_rate": round(
+                        global_metrics.get(f"slo.{cls}.burn_rate"), 4
+                    ),
+                    "attainment": round(
+                        global_metrics.get(f"slo.{cls}.attainment"), 4
+                    ),
+                }
+                for cls in global_slo.classes
+            },
+            "engine": {
+                "degrade_level": global_metrics.get("engine.degrade_level"),
+                "queue_depth": depth,
+                # The router's shed thresholds read queue_frac, so the
+                # wire must carry it — a depth alone would parse as
+                # frac 0.0 and a saturated remote would rank as empty.
+                # Without admission control (no max_queue_depth gauge)
+                # the same 64-deep soft norm as the in-process default.
+                "queue_frac": round(
+                    depth / limit if limit else min(depth / 64.0, 2.0), 4
+                ),
+                "healthy": global_engine_health.healthy(),
+            },
+        }
+
     async def _heartbeat_loop(self, writer: asyncio.StreamWriter) -> None:
         while True:
             stats = {}
@@ -639,6 +710,11 @@ class AgentWorker:
                     "type": "heartbeat",
                     "worker_id": self.worker_id,
                     "agents": stats,
+                    # Replica routing signals ride every heartbeat: the
+                    # endpoint keeps the latest per worker, so remote
+                    # engines are routable by burn rate / degrade level
+                    # exactly like in-process cell replicas.
+                    "signals": self._routing_signals(),
                 }, self._auth)
             except ConnectionError:
                 return

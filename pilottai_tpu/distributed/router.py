@@ -1,0 +1,338 @@
+"""KV-affinity replica router: the serving cell's placement brain.
+
+The million-user shape (ROADMAP item 2) is many engine replicas behind
+one front door. A blind load balancer wastes the two things the
+substrate PRs made visible: *KV locality* (PR 9's radix prefix index —
+routing a session's next turn to the replica already holding its KV
+turns a full re-prefill into a restore or a hot hit) and *SLO state*
+(PR 6's per-class burn rate, PR 8's degrade ladder and watchdog). This
+module is the policy layer that reads both:
+
+* :class:`RoutingTable` — a cell-level radix table (reusing
+  ``engine/kvcache/radix.py``) mapping prompt-prefix byte keys to the
+  replica that last served them, bounded LRU, decayed when the owning
+  replica evicts the underlying KV (``HostTier.on_evict``) or leaves
+  the cell. Lookup returns the replica holding the *longest live*
+  prefix — dead/draining replicas' entries are skipped, not returned.
+* :class:`ReplicaSignals` — one replica's routable state: queue
+  depth/fraction, degrade rung, per-class SLO burn rate, watchdog
+  health, breaker state, draining flag. In-process replicas read these
+  live; remote workers ship the same dict in their control-plane
+  heartbeats (``distributed/control_plane.py``).
+* :class:`ReplicaRouter` — scores candidates by (a) prefix/session
+  affinity, (b) per-class SLO headroom (1/(1+burn)), (c) queue depth
+  and degrade rung, and *sheds at the cell boundary* before any
+  replica saturates: batch-class traffic sheds once every candidate is
+  past ``batch_shed_frac`` of its queue (or degraded to its own
+  shed-batch rung), interactive only when every candidate is full.
+
+Hard exclusions are absolute: a draining, watchdog-stalled,
+breaker-open or dead replica never receives new work, whatever its
+affinity score (acceptance bar of ISSUE 11).
+
+Import cost: stdlib + utils + the (jax-free) radix tree — control-plane
+safe, same constraint as the rest of ``distributed/``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from pilottai_tpu.engine.kvcache.radix import RadixTree
+from pilottai_tpu.utils.logging import get_logger
+
+
+class CellOverloaded(Exception):
+    """Cell-boundary shed: no replica can take this class right now.
+    Mapped by callers onto the engine's ``EngineOverloaded`` semantics
+    (HTTP 429) — the cell sheds *before* any replica's own queue does."""
+
+
+@dataclass
+class ReplicaSignals:
+    """One replica's routable state, normalized so in-process replicas
+    and control-plane workers rank on the same scale."""
+
+    replica_id: str
+    queue_depth: int = 0
+    #: queue_depth / the replica's shed limit; >= 1.0 means its own
+    #: admission control would shed interactive traffic.
+    queue_frac: float = 0.0
+    degrade_level: int = 0
+    #: per-class error-budget burn rate (PR 6); missing classes read 0.
+    burn_rate: Dict[str, float] = field(default_factory=dict)
+    healthy: bool = True          # watchdog / EngineHealth verdict
+    breaker_open: bool = False
+    draining: bool = False
+
+    def routable(self) -> bool:
+        return self.healthy and not self.draining and not self.breaker_open
+
+    def to_payload(self) -> Dict[str, object]:
+        """JSON-safe dict (control-plane heartbeat shape)."""
+        return {
+            "replica_id": self.replica_id,
+            "queue_depth": self.queue_depth,
+            "queue_frac": round(self.queue_frac, 4),
+            "degrade_level": self.degrade_level,
+            "burn_rate": {k: round(v, 4) for k, v in self.burn_rate.items()},
+            "healthy": self.healthy,
+            "breaker_open": self.breaker_open,
+            "draining": self.draining,
+        }
+
+    @classmethod
+    def from_payload(cls, payload: Dict[str, object]) -> "ReplicaSignals":
+        return cls(
+            replica_id=str(payload.get("replica_id", "")),
+            queue_depth=int(payload.get("queue_depth", 0) or 0),
+            queue_frac=float(payload.get("queue_frac", 0.0) or 0.0),
+            degrade_level=int(payload.get("degrade_level", 0) or 0),
+            burn_rate={
+                str(k): float(v)
+                for k, v in (payload.get("burn_rate") or {}).items()
+            },
+            healthy=bool(payload.get("healthy", True)),
+            breaker_open=bool(payload.get("breaker_open", False)),
+            draining=bool(payload.get("draining", False)),
+        )
+
+
+def route_key(text: str, max_bytes: int = 2048) -> Tuple[int, ...]:
+    """The routing table's key for a prompt: its UTF-8 bytes, capped.
+    Byte keys are tokenizer-independent (for the byte tokenizer they ARE
+    the prompt ids) and prefix-of-text == prefix-of-key, which is the
+    only property affinity needs."""
+    return tuple(text.encode("utf-8")[:max_bytes])
+
+
+class RoutingTable:
+    """Bounded prefix → replica affinity map over a radix tree.
+
+    ``note`` records that a replica served (and therefore likely caches)
+    a prefix; ``lookup`` walks the query once and returns the replica
+    holding the longest prefix among replicas the caller considers
+    live. Entries decay three ways: LRU past ``capacity``, explicit
+    ``forget`` when the owning replica reports the KV evicted
+    (``HostTier.on_evict`` → the cell's decay hook), and wholesale
+    ``forget_replica`` on drain/death. Thread-safe — the cell routes
+    from the event loop while eviction callbacks fire from engine
+    threads."""
+
+    def __init__(self, capacity: int = 4096) -> None:
+        self.capacity = max(1, int(capacity))
+        self._tree = RadixTree()
+        # key -> replica_id, LRU-ordered (the tree holds the same
+        # payload; this dict is the eviction order + per-key owner).
+        self._lru: "OrderedDict[Tuple[int, ...], str]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def note(self, ids: Sequence[int], replica_id: str) -> None:
+        """Record ``replica_id`` as the holder of prefix ``ids``."""
+        key = tuple(ids)
+        if not key:
+            return
+        with self._lock:
+            self._tree.insert(key, replica_id)
+            self._lru[key] = replica_id
+            self._lru.move_to_end(key)
+            while len(self._lru) > self.capacity:
+                old, _ = self._lru.popitem(last=False)
+                self._tree.remove(old)
+
+    def forget(self, ids: Sequence[int]) -> None:
+        """Decay one entry (replica-side eviction of the backing KV)."""
+        key = tuple(ids)
+        with self._lock:
+            if self._lru.pop(key, None) is not None:
+                self._tree.remove(key)
+
+    def forget_owned(self, ids: Sequence[int], replica_id: str) -> None:
+        """Ownership-checked decay: forget the entry only when
+        ``replica_id`` owns it. The per-replica eviction hook must not
+        drop an entry pointing at a DIFFERENT replica whose copy of the
+        KV is still live (two replicas caching a shared preamble is the
+        normal state, not a conflict)."""
+        key = tuple(ids)
+        with self._lock:
+            if self._lru.get(key) == replica_id:
+                del self._lru[key]
+                self._tree.remove(key)
+
+    def forget_replica(self, replica_id: str) -> int:
+        """Drop every entry owned by ``replica_id`` (drain / death)."""
+        with self._lock:
+            victims = [
+                k for k, rid in self._lru.items() if rid == replica_id
+            ]
+            for key in victims:
+                del self._lru[key]
+                self._tree.remove(key)
+            return len(victims)
+
+    def lookup(
+        self,
+        ids: Sequence[int],
+        alive: Optional[Sequence[str]] = None,
+    ) -> Tuple[Optional[str], int]:
+        """``(replica_id, lcp)`` for the longest stored prefix of
+        ``ids`` whose owner is in ``alive`` (None = any owner). One
+        radix walk collects every payload node on the path; the deepest
+        live owner wins — a dead replica's deeper entry must not shadow
+        a live replica's shallower one."""
+        live = set(alive) if alive is not None else None
+        key = tuple(ids)
+        with self._lock:
+            for node in reversed(self._tree.payload_prefixes(key)):
+                if live is None or node.payload in live:
+                    self._lru.move_to_end(key[: node.key_len])
+                    return node.payload, node.key_len
+            return None, 0
+
+    def owners(self) -> Dict[str, int]:
+        """replica_id -> entry count (metrics / drain bookkeeping)."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for rid in self._lru.values():
+                out[rid] = out.get(rid, 0) + 1
+            return out
+
+
+class ReplicaRouter:
+    """Scoring policy over :class:`ReplicaSignals` + the routing table.
+
+    ``pick`` never returns an unroutable replica; it raises
+    :class:`CellOverloaded` when the class must shed at the cell
+    boundary. Weights are deliberately simple and documented in
+    docs/SERVING.md — the router's job is to be *predictable* under
+    incident, not optimal in steady state."""
+
+    def __init__(
+        self,
+        table: Optional[RoutingTable] = None,
+        *,
+        affinity_weight: float = 1.0,
+        slo_weight: float = 1.0,
+        queue_weight: float = 1.0,
+        degrade_weight: float = 0.5,
+        batch_shed_frac: float = 0.75,
+        #: degrade rung at or past which a replica sheds batch traffic
+        #: itself (reliability/degrade.py SHED_BATCH) — the router skips
+        #: it for batch-class work instead of bouncing off its 429.
+        batch_shed_level: int = 4,
+    ) -> None:
+        self.table = table if table is not None else RoutingTable()
+        self.affinity_weight = affinity_weight
+        self.slo_weight = slo_weight
+        self.queue_weight = queue_weight
+        self.degrade_weight = degrade_weight
+        self.batch_shed_frac = batch_shed_frac
+        self.batch_shed_level = batch_shed_level
+        self._rr = 0  # tiebreak rotation
+        self._log = get_logger("cell.router")
+
+    # ------------------------------------------------------------------ #
+
+    def _class_candidates(
+        self, signals: List[ReplicaSignals], slo_class: str
+    ) -> List[ReplicaSignals]:
+        """Routable replicas that may still admit ``slo_class`` work —
+        the per-class cell-boundary shed policy. Mirrors the engine's
+        own ``_shed_reason`` thresholds so the cell sheds *first*:
+        batch-class work stops at ``batch_shed_frac`` of a replica's
+        queue (or once it degraded to its shed-batch rung); interactive
+        only at a full queue."""
+        out = []
+        for s in signals:
+            if not s.routable():
+                continue
+            if slo_class == "batch":
+                if s.queue_frac >= self.batch_shed_frac:
+                    continue
+                if s.degrade_level >= self.batch_shed_level:
+                    continue
+            elif s.queue_frac >= 1.0:
+                continue
+            out.append(s)
+        return out
+
+    def score(
+        self,
+        s: ReplicaSignals,
+        slo_class: str,
+        affinity_tokens: int,
+        key_len: int,
+    ) -> float:
+        """One replica's desirability for one request. Affinity is the
+        matched-prefix fraction of the key; SLO headroom shrinks as the
+        class's error budget burns; queue and degrade subtract."""
+        affinity = affinity_tokens / max(key_len, 1)
+        burn = s.burn_rate.get(slo_class, 0.0)
+        headroom = 1.0 / (1.0 + max(burn, 0.0))
+        return (
+            self.affinity_weight * affinity
+            + self.slo_weight * headroom
+            - self.queue_weight * min(s.queue_frac, 2.0)
+            - self.degrade_weight * s.degrade_level
+        )
+
+    def pick(
+        self,
+        key: Sequence[int],
+        signals: List[ReplicaSignals],
+        *,
+        slo_class: str = "interactive",
+        pinned: Optional[str] = None,
+        exclude: Optional[Sequence[str]] = None,
+    ) -> Tuple[str, int]:
+        """Choose a replica for a request with routing key ``key``.
+
+        Returns ``(replica_id, affinity_lcp)``. ``pinned`` (a session's
+        current owner) wins outright while routable and class-admitting
+        — sticky sessions are the cheapest affinity there is.
+        ``exclude`` removes replicas a retry already failed on.
+        Raises :class:`CellOverloaded` when the class sheds."""
+        excluded = set(exclude or ())
+        signals = [s for s in signals if s.replica_id not in excluded]
+        if not any(s.routable() for s in signals):
+            raise CellOverloaded("no routable replica in the cell")
+        candidates = self._class_candidates(signals, slo_class)
+        if not candidates:
+            raise CellOverloaded(
+                f"all routable replicas past the {slo_class!r}-class "
+                f"admission threshold; shedding at the cell boundary"
+            )
+        by_id = {s.replica_id: s for s in candidates}
+        if pinned is not None and pinned in by_id:
+            _, lcp = self.table.lookup(key, alive=[pinned])
+            return pinned, lcp
+        owner, lcp = self.table.lookup(key, alive=list(by_id))
+        best_id, best_score = None, None
+        order = sorted(by_id)
+        for i, rid in enumerate(order):
+            s = by_id[rid]
+            aff = lcp if rid == owner else 0
+            sc = self.score(s, slo_class, aff, len(key))
+            # Deterministic rotation tiebreak: equal scores spread
+            # round-robin instead of piling onto the lexicographically
+            # first replica.
+            sc += 1e-9 * ((i + self._rr) % max(len(order), 1))
+            if best_score is None or sc > best_score:
+                best_id, best_score = rid, sc
+        self._rr += 1
+        return best_id, (lcp if best_id == owner else 0)
+
+
+__all__ = [
+    "CellOverloaded",
+    "ReplicaRouter",
+    "ReplicaSignals",
+    "RoutingTable",
+    "route_key",
+]

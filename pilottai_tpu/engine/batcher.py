@@ -995,6 +995,52 @@ class ContinuousBatcher:
             self._pending.qsize() + len(self._backlog) + self._prepped_reqs
         )
 
+    @property
+    def watchdog_source(self):
+        """This engine's ``EngineHealth`` source name (None without a
+        watchdog) — the serving cell checks per-replica health by it."""
+        return self._watchdog.name if self._watchdog is not None else None
+
+    def routing_signals(self) -> Dict[str, Any]:
+        """The replica-side routing signals of ISSUE 11, as one cheap
+        snapshot: queue depth + shed-limit fraction, degrade rung and
+        watchdog verdict. Per-class SLO burn comes from the cell's own
+        per-replica tracker (in-process) or the control-plane heartbeat
+        (remote) — the engine doesn't know its replica's service
+        classes."""
+        depth = self.queue_depth()
+        # Without admission control there is no hard shed depth; 8 slots'
+        # worth of backlog per slot is the soft norm the router uses to
+        # compare replicas (never to shed — only max_queue_depth sheds).
+        limit = self.max_queue_depth or 8 * self.n_slots
+        return {
+            "queue_depth": depth,
+            "queue_frac": depth / max(limit, 1),
+            "degrade_level": self.degrade.level(),
+            "healthy": self._watchdog is None or not self._watchdog.stalled,
+        }
+
+    def export_session_kv(self, session_id: str):
+        """Cross-replica migration, source side (ISSUE 11): the
+        session's KV lineage in the host tier's transfer format, taken
+        under the slot lock so no spill/restore interleaves. None when
+        the KV cache tier is off or the session is unknown — callers
+        treat that as 'nothing to move' (the target re-prefills)."""
+        if self.kvcache is None or self.kvcache.host is None:
+            return None
+        with self._lock:
+            return self.kvcache.export_session(session_id)
+
+    def import_session_kv(self, export) -> Dict[str, int]:
+        """Cross-replica migration, target side: land the exported
+        entries in this engine's host tier so the session's next turn
+        restores here instead of re-prefilling. Returns the accepted
+        entry/token counts (budget pressure may reject some)."""
+        if self.kvcache is None or self.kvcache.host is None or not export:
+            return {"accepted": 0, "tokens": 0}
+        with self._lock:
+            return self.kvcache.import_session(export)
+
     def saturated(self) -> bool:
         return (
             self.max_queue_depth is not None

@@ -115,6 +115,12 @@ class HostTier:
         self._tree = RadixTree()
         self._bytes = 0
         self._clock = 0
+        # Eviction notification (fired OUTSIDE the lock, after a budget
+        # eviction fully dropped an entry from this tier): the serving
+        # cell's routing table decays its affinity entry for the prefix
+        # — once the KV is gone from both tiers, routing by it is pure
+        # superstition. Callback receives the evicted key.
+        self.on_evict = None
         # session id -> latest prompt prefix (lineage tip). Bounded LRU:
         # client-minted ids must not grow host state unboundedly.
         self._sessions: "OrderedDict[str, Tuple[int, ...]]" = OrderedDict()
@@ -144,12 +150,14 @@ class HostTier:
         rows: Optional[int] = None,
         meta: Any = None,
         kind: str = "dense",
+        count: bool = True,
     ) -> bool:
         """Accept an evicted entry's device arrays: start the async D2H
         now (off the hot path — nothing waits on it here), account the
         bytes, and evict colder host entries past the budget. Returns
         False (and starts nothing) when the entry alone exceeds the
-        whole budget."""
+        whole budget. ``count=False`` skips the spill counters (a
+        cross-replica migration import is a transfer, not a spill)."""
         nbytes = _nbytes(arrays)
         if self.budget_bytes <= 0 or nbytes > self.budget_bytes:
             return False
@@ -170,10 +178,12 @@ class HostTier:
             entry.stamp = self._clock
             self._tree.insert(key, entry)
             self._bytes += nbytes
-            self._evict_over_budget_locked()
+            evicted = self._evict_over_budget_locked()
             self._gauges_locked()
-        global_metrics.inc("engine.kvcache.spills")
-        global_metrics.inc("engine.kvcache.spill_bytes", nbytes)
+        self._fire_evictions(evicted)
+        if count:
+            global_metrics.inc("engine.kvcache.spills")
+            global_metrics.inc("engine.kvcache.spill_bytes", nbytes)
         return True
 
     # ------------------------------------------------------------------ #
@@ -259,8 +269,9 @@ class HostTier:
             entry.stamp = self._clock
             self._tree.insert(entry.key, entry)
             self._bytes += entry.nbytes
-            self._evict_over_budget_locked()
+            evicted = self._evict_over_budget_locked()
             self._gauges_locked()
+        self._fire_evictions(evicted)
 
     def clear(self) -> None:
         with self._lock:
@@ -287,6 +298,44 @@ class HostTier:
                 "engine.kvcache.sessions", float(len(self._sessions))
             )
 
+    def lineage(self, session_id: Optional[str]) -> Optional[Tuple[int, ...]]:
+        """The session's recorded lineage tip (latest prompt prefix), or
+        None — the migration export's starting point."""
+        if not session_id:
+            return None
+        with self._lock:
+            return self._sessions.get(session_id)
+
+    def drop_session(self, session_id: Optional[str]) -> None:
+        """Forget a session's lineage pin (after its KV migrated away —
+        the source tier must not keep protecting entries it no longer
+        holds for a session it no longer serves)."""
+        if not session_id:
+            return
+        with self._lock:
+            self._sessions.pop(session_id, None)
+            global_metrics.set_gauge(
+                "engine.kvcache.sessions", float(len(self._sessions))
+            )
+
+    def prefix_entries(self, ids: Sequence[int]) -> List[HostEntry]:
+        """EVERY entry whose key prefixes ``ids``, shallowest first —
+        the host-resident part of a session's KV lineage, read without
+        removing. The migration export COPIES these (the entries may
+        serve OTHER sessions sharing the preamble, and a target-side
+        budget rejection must not lose the KV from both replicas);
+        dropping the migrated session's pin afterwards lets the source
+        copies age out under normal budget pressure."""
+        with self._lock:
+            nodes = self._tree.payload_prefixes(tuple(ids))
+            out: List[HostEntry] = []
+            for node in nodes:
+                entry = node.payload
+                self._clock += 1
+                entry.stamp = self._clock
+                out.append(entry)
+            return out
+
     def _protected_locked(self, entry: HostEntry) -> bool:
         k = entry.key
         n = len(k)
@@ -304,15 +353,18 @@ class HostTier:
             entry.stamp, entry.tokens, entry.rows, self.policy
         )
 
-    def _evict_over_budget_locked(self) -> None:
+    def _evict_over_budget_locked(self) -> List[Tuple[int, ...]]:
         """One ranked pass per overflow (not per victim — a multi-victim
         overflow at 'thousands of paged blocks' scale must not rescan
         every entry × every session lineage per eviction): score and
         session-protection are computed once per entry, unpinned entries
         evict coldest-first, and pinned entries only once nothing
-        unpinned remains (bounded memory beats a perfect pin)."""
+        unpinned remains (bounded memory beats a perfect pin). Returns
+        the evicted keys so callers can fire ``on_evict`` outside the
+        lock."""
+        evicted: List[Tuple[int, ...]] = []
         if self._bytes <= self.budget_bytes or len(self._tree) <= 1:
-            return
+            return evicted
         ranked = sorted(
             ((self._score_locked(e), e) for _, e in self._tree.items()),
             key=lambda t: t[0],
@@ -320,19 +372,35 @@ class HostTier:
         deferred: List[HostEntry] = []
         for _s, entry in ranked:
             if self._bytes <= self.budget_bytes:
-                return
+                return evicted
             if self._protected_locked(entry):
                 deferred.append(entry)
                 continue
             self._tree.remove(entry.key)
             self._bytes -= entry.nbytes
+            evicted.append(entry.key)
             global_metrics.inc("engine.kvcache.evictions")
         for entry in deferred:
             if self._bytes <= self.budget_bytes or len(self._tree) <= 1:
-                return
+                return evicted
             self._tree.remove(entry.key)
             self._bytes -= entry.nbytes
+            evicted.append(entry.key)
             global_metrics.inc("engine.kvcache.evictions")
+        return evicted
+
+    def _fire_evictions(self, keys: List[Tuple[int, ...]]) -> None:
+        """Eviction callback fan-out — OUTSIDE the tier lock (the cell's
+        routing table takes its own lock; never raises into the spill
+        path)."""
+        cb = self.on_evict
+        if cb is None or not keys:
+            return
+        for key in keys:
+            try:
+                cb(key)
+            except Exception:  # noqa: BLE001 — decay is best-effort
+                pass
 
     def _gauges_locked(self) -> None:
         global_metrics.set_gauge(

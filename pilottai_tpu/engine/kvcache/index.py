@@ -37,7 +37,7 @@ dropped at apply time; the host entries themselves persist).
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -408,6 +408,108 @@ class KVCacheIndex:
             "engine.kvcache.restore_ms", (time.perf_counter() - t0) * 1e3
         )
         return out, rec
+
+    # ------------------------------------------------------------------ #
+    # Cross-replica session transfer (ISSUE 11)
+    # ------------------------------------------------------------------ #
+
+    def export_session(self, session_id: Optional[str]):
+        """Package a session's cached KV lineage in the host tier's
+        spill format — the *transfer* format of ISSUE 11: each record is
+        exactly what a ``HostTier.put`` accepts, so importing on another
+        replica makes the session restorable there with the normal
+        resume path (and therefore byte-identical output, per the tier's
+        parity contract). Called under the batcher's slot lock.
+
+        Everything is COPIED, never moved: host entries may serve OTHER
+        sessions sharing the preamble lineage, and a target-side budget
+        rejection must not lose KV from both replicas. Only the session
+        PIN leaves the source (``drop_session``), so the source copies
+        age out under normal budget pressure once nothing pins them.
+        Returns ``{"session_id", "ids", "entries"}`` or None when the
+        session has no recorded lineage."""
+        if self.host is None:
+            return None
+        ids = self.host.lineage(session_id)
+        if not ids:
+            return None
+        entries: List[dict] = []
+        have: set = set()
+
+        def add(key, k_np, v_np, tokens, rows, meta, kind):
+            key = tuple(key)
+            if key in have or not key:
+                return
+            have.add(key)
+            entries.append({
+                "key": list(key), "k": np.asarray(k_np),
+                "v": np.asarray(v_np), "tokens": int(tokens),
+                "rows": int(rows), "meta": meta, "kind": kind,
+            })
+
+        for e in self.host.prefix_entries(ids):
+            arrays = e.copy.wait() if hasattr(e.copy, "wait") else list(e.copy)
+            add(e.key, arrays[0], arrays[1], e.tokens, e.rows, e.meta, e.kind)
+        store = self.prefix_store
+        if store is not None:
+            hot = store.match(ids)
+            if hot is not None:
+                add(hot.ids, hot.ks, hot.vs, len(hot.ids), hot.p_bucket,
+                    hot.p_bucket, "dense")
+        index = self.page_index
+        if index is not None:
+            node = index.match(ids)
+            if node is not None:
+                path = index.path_tokens(node)
+                for b, page in enumerate(node.path_pages):
+                    key = tuple(path[: (b + 1) * self.page_size])
+                    if key in have:
+                        continue
+                    for _attempt in range(2):
+                        # Same donated-buffer race as _spill_page: the
+                        # device thread rebinds the pool outside the
+                        # slot lock — re-read the binding once.
+                        cache = self._get_cache()
+                        try:
+                            ks, vs = _gather_page(cache, jnp.int32(page))
+                            break
+                        except Exception:  # noqa: BLE001 — rebind race
+                            continue
+                    else:
+                        continue
+                    add(key, ks, vs, self.page_size, self.page_size, b,
+                        "page")
+        self.host.drop_session(session_id)
+        entries.sort(key=lambda e: len(e["key"]))
+        return {"session_id": session_id, "ids": list(ids),
+                "entries": entries}
+
+    def import_session(self, export) -> Dict[str, int]:
+        """Accept a session export from another replica: every record
+        lands in THIS host tier (``count=False`` — migrations are not
+        spills in the metrics) and the session pin moves here, so the
+        session's next turn restores locally. Returns
+        ``{"accepted", "tokens"}`` counting only the entries that
+        actually landed — budget pressure may reject some (the resume
+        then re-prefills those spans, correct but slower; the source
+        still holds its copy), and the metrics must not report KV as
+        moved that was dropped."""
+        if self.host is None or not export:
+            return {"accepted": 0, "tokens": 0}
+        accepted = 0
+        tokens = 0
+        for e in export.get("entries", ()):
+            if self.host.put(
+                tuple(e["key"]), (e["k"], e["v"]),
+                tokens=e["tokens"], rows=e["rows"], meta=e.get("meta"),
+                kind=e.get("kind", "dense"), count=False,
+            ):
+                accepted += 1
+                tokens += int(e["tokens"])
+        self.host.note_session(
+            export.get("session_id"), tuple(export.get("ids") or ())
+        )
+        return {"accepted": accepted, "tokens": tokens}
 
     # ------------------------------------------------------------------ #
     # Restore apply (device thread only)

@@ -181,6 +181,32 @@ class RadixTree:
                 best = node
         return best
 
+    def payload_prefixes(
+        self, ids: Sequence[int], proper: bool = False
+    ) -> List[RadixNode]:
+        """EVERY payload node whose key prefixes ``ids``, shallowest
+        first (so ``[-1]`` is ``longest_payload_prefix``'s answer). The
+        cell router's affinity lookup needs the whole chain — the
+        deepest entry may belong to a dead replica, and a dead owner's
+        entry must not shadow a live owner's shallower one. One O(len)
+        walk."""
+        limit = len(ids) - 1 if proper else len(ids)
+        out: List[RadixNode] = []
+        node = self._root
+        i = 0
+        while i < len(ids):
+            child = node.children.get(ids[i])
+            if child is None:
+                break
+            m = _common_len(child.label, ids[i:])
+            if m < len(child.label):
+                break
+            i += m
+            node = child
+            if node.payload is not None and node.key_len <= limit:
+                out.append(node)
+        return out
+
     def deepest_common(
         self, ids: Sequence[int]
     ) -> Tuple[Optional[RadixNode], int]:

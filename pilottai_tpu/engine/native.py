@@ -519,6 +519,33 @@ class NativeEngine(LLMBackend):
             if not afut.done():
                 request.cancelled = True
 
+    # ------------------------------------------------------------------ #
+    # Serving-cell surface (distributed/cell.py, ISSUE 11)
+    # ------------------------------------------------------------------ #
+
+    def routing_signals(self) -> Dict[str, Any]:
+        """Replica routing signals (queue/degrade/health); empty dict
+        before the engine booted (the cell treats that as idle)."""
+        return (
+            self.batcher.routing_signals() if self.batcher is not None
+            else {}
+        )
+
+    def export_session_kv(self, session_id: str):
+        """Migration source: the session's KV in the host tier's
+        transfer format (blocking device→host gathers — a control-plane
+        operation, run it off the event loop)."""
+        return (
+            self.batcher.export_session_kv(session_id)
+            if self.batcher is not None else None
+        )
+
+    def import_session_kv(self, export) -> Dict[str, int]:
+        return (
+            self.batcher.import_session_kv(export)
+            if self.batcher is not None else {"accepted": 0, "tokens": 0}
+        )
+
     def get_metrics(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"backend": self.name, "model": self.model_cfg.name}
         if self.batcher is not None:

@@ -105,6 +105,27 @@ _gm.declare("engine.kvcache.restore_ms", "histogram")  # host-side staging
 _gm.declare("engine.kvcache.host_bytes", "gauge")
 _gm.declare("engine.kvcache.host_entries", "gauge")
 _gm.declare("engine.kvcache.sessions", "gauge")      # live session pins
+# Serving cell (distributed/cell.py + router.py, ISSUE 11): the cell
+# front door's routed/shed/affinity/migration surface. Per-class
+# routed/shed counters are declared for the DEFAULT classes here;
+# ServingCell declares any deployment-defined classes at construction.
+_gm.declare("cell.replicas", "gauge")
+_gm.declare("cell.replicas_routable", "gauge")
+_gm.declare("cell.sessions", "gauge")                # sticky session pins
+_gm.declare("cell.routed.interactive", "counter")
+_gm.declare("cell.routed.batch", "counter")
+_gm.declare("cell.shed.interactive", "counter")      # cell-boundary sheds
+_gm.declare("cell.shed.batch", "counter")
+_gm.declare("cell.affinity_lookups", "counter")
+_gm.declare("cell.affinity_hits", "counter")         # pinned or prefix hit
+_gm.declare("cell.affinity_hit_rate", "gauge")
+_gm.declare("cell.rerouted", "counter")              # fault/drain re-admits
+_gm.declare("cell.migrations", "counter")
+_gm.declare("cell.migrated_entries", "counter")
+_gm.declare("cell.migrated_tokens", "counter")
+_gm.declare("cell.migration_ms", "histogram")        # export→import wall
+_gm.declare("cell.drains", "counter")
+_gm.declare("cell.drain_s", "histogram")             # full drain wall
 
 __all__ = [
     "AgentOccupancy",

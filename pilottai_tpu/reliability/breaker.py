@@ -171,8 +171,19 @@ class CircuitBreaker:
 
     def on_engine_stall(self, snapshot: Optional[Dict[str, Any]] = None) -> None:
         """``EngineHealth`` subscriber form (reliability/watchdog.py):
-        a bound method, so the health registry can hold it weakly."""
-        del snapshot
+        a bound method, so the health registry can hold it weakly.
+
+        ``health_sources`` (set by the owner — the serving cell scopes
+        each replica's breaker to its own engine's watchdog source)
+        filters the process-wide stall fan-out: in a multi-replica
+        process, replica A hanging must fast-fail A's handler, not
+        ground every sibling. None (the default, single-engine
+        processes) keeps the original any-stall-opens behavior."""
+        sources = getattr(self, "health_sources", None)
+        if sources is not None and snapshot is not None:
+            stalled = set(snapshot.get("sources") or ())
+            if not (stalled & set(sources)):
+                return
         self.force_open("engine watchdog stall")
 
     # ------------------------------------------------------------------ #

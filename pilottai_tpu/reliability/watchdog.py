@@ -114,6 +114,15 @@ class EngineHealth:
     def healthy(self) -> bool:
         return not self._stalls
 
+    def source_healthy(self, source: Optional[str]) -> bool:
+        """Per-source verdict: a multi-replica process (serving cell)
+        must keep routing to healthy replicas while a sibling is hung —
+        the aggregate ``healthy()`` would ground the whole cell."""
+        if source is None:
+            return self.healthy()
+        with self._lock:
+            return source not in self._stalls
+
     def snapshot(self) -> Dict[str, Any]:
         """Aggregate view (the health endpoint's shape): oldest stall's
         age, every source's reason, the largest retry_after."""

@@ -381,7 +381,18 @@ class APIServer:
             # own timeouts would reveal it.
             from pilottai_tpu.reliability import global_engine_health
 
-            if global_engine_health.healthy():
+            cell_health = getattr(self.handler, "health_snapshot", None)
+            if callable(cell_health):
+                # Serving cell (distributed/cell.py): health aggregates
+                # across replicas — the cell is up while ANY replica is
+                # routable; one stalled replica degrades, not grounds.
+                snap = cell_health()
+                status = 200 if snap.get("ok") else 503
+                await self._send(writer, status, {
+                    "status": "ok" if snap.get("ok") else "unhealthy",
+                    **{k: v for k, v in snap.items() if k != "ok"},
+                })
+            elif global_engine_health.healthy():
                 await self._send(writer, 200, {"status": "ok"})
             else:
                 snap = global_engine_health.snapshot()
@@ -425,10 +436,16 @@ class APIServer:
         elif path == "/slo.json" and method == "GET":
             # Per-class SLO attainment / burn rate (obs/slo.py) — the
             # page an operator (or the autoscaler's dashboard) watches
-            # during an incident.
-            from pilottai_tpu.obs import global_slo
+            # during an incident. A serving cell aggregates per-replica
+            # trackers (request-weighted attainment/burn, worst-replica
+            # p99) and attaches each replica's own snapshot.
+            cell_slo = getattr(self.handler, "slo_snapshot", None)
+            if callable(cell_slo):
+                await self._send(writer, 200, _jsonable(cell_slo()))
+            else:
+                from pilottai_tpu.obs import global_slo
 
-            await self._send(writer, 200, global_slo.snapshot())
+                await self._send(writer, 200, global_slo.snapshot())
         elif path == "/dag.json" and method == "GET":
             # Task-DAG attribution (obs/dag.py): active task summaries +
             # recent finished breakdowns with critical paths; ?task_id=

@@ -11,12 +11,14 @@ JSON. This tool makes it mechanical:
         --fail-on-regression                            # CI gate mode
 
 It walks the top level, every ``models.<section>`` block, every
-``SLO.classes.<class>`` block and the ``RECOVERY`` and ``KVCACHE``
-blocks, compares numeric metrics whose direction it knows (steps/s,
-MFU, attainment, busy_frac, recovered_frac, prefix_hit_rate,
+``SLO.classes.<class>`` / ``CELL.classes.<class>`` block and the
+``RECOVERY``, ``KVCACHE`` and ``CELL`` blocks, compares numeric
+metrics whose direction it knows (steps/s, MFU, attainment, busy_frac,
+recovered_frac, prefix_hit_rate, affinity_hit_rate,
 prefill_tokens_saved up = good; p50/p99, host_gap, burn_rate,
-recovery_ms, restore_ms, tokens_replayed, overhead fractions down =
-good), and prints a readable table with deltas, flagging moves beyond
+recovery_ms, restore_ms, migration_ms, drain_s, shed, tokens_replayed,
+overhead fractions down = good), and prints a readable table with
+deltas, flagging moves beyond
 ``--threshold`` (default 10%). ``x/y`` success strings compare as ratios. Keys with no
 known direction (config echoes, counts) are skipped.
 
@@ -125,7 +127,7 @@ def _from_tail(tail: str) -> Dict[str, Any]:
     diff only compares keys present in BOTH rounds."""
     doc: Dict[str, Any] = {}
     remainder = tail
-    for block in ("models", "SLO", "phases", "KVCACHE"):
+    for block in ("models", "SLO", "phases", "KVCACHE", "CELL"):
         marker = f'"{block}": '
         at = remainder.find(marker)
         if at < 0:
@@ -171,7 +173,8 @@ def _sections(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     doc = _unwrap(doc)
     out: Dict[str, Dict[str, Any]] = {"top": {}}
     for key, value in doc.items():
-        if key in ("models", "SLO", "phases", "RECOVERY", "KVCACHE"):
+        if key in ("models", "SLO", "phases", "RECOVERY", "KVCACHE",
+                   "CELL"):
             continue
         num = _numeric(value)
         if num is not None:
@@ -188,6 +191,21 @@ def _sections(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
             k: n for k, v in kvcache.items()
             if (n := _numeric(v)) is not None
         }
+    cell = doc.get("CELL")
+    if isinstance(cell, dict):
+        # Scalars at the section root (affinity_hit_rate, migration_ms,
+        # drain_s, migrations ...) plus per-class sub-blocks with
+        # attainment / burn_rate / shed / routed, SLO-style.
+        out["cell"] = {
+            k: n for k, v in cell.items()
+            if (n := _numeric(v)) is not None
+        }
+        for cls, block in (cell.get("classes") or {}).items():
+            if isinstance(block, dict):
+                out[f"cell.{cls}"] = {
+                    k: n for k, v in block.items()
+                    if (n := _numeric(v)) is not None
+                }
     for name, block in (doc.get("models") or {}).items():
         if isinstance(block, dict):
             out[f"models.{name}"] = {

@@ -100,6 +100,71 @@ async def test_remote_agent_executes_and_heartbeats():
 
 
 @pytest.mark.asyncio
+async def test_heartbeat_carries_replica_routing_signals():
+    """ISSUE 11 satellite: worker heartbeats ship the host's replica
+    routing signals — per-class SLO burn/attainment, the engine's
+    degrade rung and queue depth, and the health verdict — so a
+    cell-style router ranks remote engines by the same policy as
+    in-process replicas. Round-trip: seed the worker-side globals, wait
+    one heartbeat, read the proxy's parsed signals."""
+    from pilottai_tpu.distributed import ReplicaSignals
+    from pilottai_tpu.obs import global_slo
+    from pilottai_tpu.utils.metrics import global_metrics
+
+    # Worker-side state the heartbeat must carry (the in-process test
+    # shares globals with the endpoint — the signals still cross the
+    # wire as JSON and come back parsed).
+    for _ in range(5):
+        global_slo.record("interactive", ok=False)
+    global_metrics.set_gauge("engine.degrade_level", 2.0)
+    global_metrics.set_gauge("engine.queue_depth", 7.0)
+
+    serve = _serve()
+    await serve.start()
+    endpoint = ServeEndpoint(serve)
+    await endpoint.start()
+    worker = AgentWorker(
+        "127.0.0.1", endpoint.port,
+        [_mock_agent(specializations=["generic"])],
+        heartbeat_interval=0.05,
+    )
+    await worker.start()
+    try:
+        deadline = time.time() + 10
+        while not endpoint.worker_signals and time.time() < deadline:
+            await asyncio.sleep(0.05)
+        assert endpoint.worker_signals, "signals never arrived"
+        signals = endpoint.worker_signals[worker.worker_id]
+        assert signals["engine"]["degrade_level"] == 2.0
+        assert signals["engine"]["queue_depth"] == 7.0
+        # The router's shed thresholds read queue_frac — it must cross
+        # the wire (7 deep / 64 soft norm without admission control).
+        assert signals["engine"]["queue_frac"] == pytest.approx(7 / 64, abs=1e-3)
+        assert signals["engine"]["healthy"] is True
+        assert signals["slo"]["interactive"]["burn_rate"] > 0
+        assert signals["slo"]["interactive"]["attainment"] < 1.0
+
+        proxy = next(iter(serve.agents.values()))
+        assert isinstance(proxy, RemoteAgent)
+        assert proxy.signals == signals
+        # The router-shape view parses into ReplicaSignals cleanly.
+        parsed = ReplicaSignals.from_payload(proxy.routing_signals())
+        assert parsed.replica_id == proxy.id
+        assert parsed.degrade_level == 2
+        assert parsed.queue_depth == 7
+        assert parsed.queue_frac == pytest.approx(7 / 64, abs=1e-3)
+        assert parsed.burn_rate["interactive"] > 0
+        assert parsed.routable()
+    finally:
+        await worker.stop()
+        await endpoint.stop()
+        await serve.stop()
+        global_slo.reset()
+        global_metrics.set_gauge("engine.degrade_level", 0.0)
+        global_metrics.set_gauge("engine.queue_depth", 0.0)
+
+
+@pytest.mark.asyncio
 async def test_worker_reconnects_after_connection_blip():
     """A dropped connection must not strand the worker (review finding:
     re-registration used to collide with the stale proxy's id and kill

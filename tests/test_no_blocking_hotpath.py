@@ -45,10 +45,13 @@ HOT_PATH = {
 # eviction time on the device/prep threads and the restore path on the
 # prep thread under the slot lock — a blocking device read in either
 # would re-serialize host and device exactly like one in the batcher.
-# The whole package is scanned; the only sanctioned wait is
+# The whole package is scanned; the only sanctioned waits are
 # ``SpillCopy.wait`` (materializes a copy STARTED at spill time — the
-# _HostCopy discipline), so np.asarray is allowed only inside ``wait``.
-KV_ASARRAY_ALLOWED_FUNCS = {"wait"}
+# _HostCopy discipline) and the session-migration export
+# (``export_session`` + its ``add`` closure, ISSUE 11): a control-plane
+# operation the cell runs in an executor, never on the device/prep/
+# reader threads.
+KV_ASARRAY_ALLOWED_FUNCS = {"wait", "export_session", "add"}
 
 # Attribute calls that block the calling thread on the device, in any
 # spelling (``jax.device_get(x)`` and ``x.block_until_ready()`` are both
