@@ -1004,6 +1004,244 @@ async def bench_swarm(model: str, provider: str, n_agents: int = 32,
     }
 
 
+async def bench_sched(model, provider, n_waves=4, gang=3, n_bg=6,
+                      max_iterations=1):
+    """SCHED section (ISSUE 12 / ROADMAP item 4): the DAG-aware
+    scheduler's on-vs-off comparison on ONE workload — fan-out waves of
+    ``gang`` HIGH-priority sibling tasks (gang-tagged, rolled up under
+    a synthetic parent dag so PR 7's straggler/critical-path
+    attribution applies) contending with LOW-priority background
+    traffic on a deliberately saturated engine (2 slots), run twice:
+    ``engine_sched_policy="fifo"`` + scheduler policy off, then
+    ``"dag"`` + policy on.
+
+    Reported per mode, in PR 7's field shapes:
+
+    * ``swarm_straggler_frac`` — Σ parent ``straggler_s`` ÷ Σ task
+      ``e2e_s`` (the task.* histograms, section-pure): the price of
+      each join waiting on its slowest branch. Gang admission +
+      critical-path priority attack exactly this.
+    * ``swarm_critical_path_frac`` — Σ parent ``critical_path_s`` ÷ Σ
+      task ``e2e_s``: the PARENT's wall (its critical path ≈ the
+      fan-out's makespan) as a fraction of all task time spent. More
+      parallel efficiency → smaller numerator on the same work.
+
+    The acceptance bar (ISSUE 12): both lower with the scheduler on,
+    greedy outputs byte-identical on/off (pinned by
+    tests/test_sched.py, not re-measured here), and scheduler-on task
+    success ≥ scheduler-off (tests/test_mini_swarm.py CI lane)."""
+    from pilottai_tpu.core.agent import BaseAgent
+    from pilottai_tpu.core.config import (
+        AgentConfig,
+        LLMConfig,
+        SamplingConfig,
+        ServeConfig,
+    )
+    from pilottai_tpu.core.task import Task
+    from pilottai_tpu.obs.dag import global_dag
+    from pilottai_tpu.sched import global_scheduler
+    from pilottai_tpu.serve import Serve
+    from pilottai_tpu.train.protocol import (
+        DEFAULT_CHECKPOINT,
+        SERVE_MAX_NEW,
+        SERVE_MAX_SEQ,
+        has_checkpoint,
+    )
+    from pilottai_tpu.utils.metrics import global_metrics as _gm
+
+    has_ckpt = has_checkpoint()
+    counters = (
+        "sched.gang_admits", "sched.gang_partial", "sched.priority_aged",
+        "sched.priority_boosts", "sched.prewarms", "sched.prewarm_hits",
+    )
+    out = {
+        "waves": n_waves, "gang": gang, "background_per_wave": n_bg,
+        "model": model if has_ckpt or provider == "tpu" else "untrained",
+    }
+    try:
+        for mode in ("off", "on"):
+            global_scheduler.configure(policy="dag" if mode == "on" else "off")
+            global_scheduler.reset()
+            global_dag.reset()
+            from pilottai_tpu.engine.handler import LLMHandler
+
+            llm = LLMHandler(LLMConfig(
+                model_name=model, provider=provider,
+                checkpoint_path=str(DEFAULT_CHECKPOINT) if has_ckpt else None,
+                # Small on purpose: the scheduler only matters when an
+                # engine backlog exists — two slots against gang +
+                # background concurrency keeps a backlog standing for
+                # the whole wave, so admission ORDER (the thing under
+                # test) is what decides who progresses.
+                engine_slots=2, engine_admit_batch=2,
+                engine_max_seq=SERVE_MAX_SEQ, engine_chunk=16,
+                dtype="bfloat16" if provider == "tpu" else "float32",
+                engine_sched_policy="dag" if mode == "on" else "fifo",
+                # The aging floor must scale with service time: at the
+                # default 2 s a LOW background call ages to CRITICAL
+                # within ONE slow-engine LLM call and neutralizes the
+                # priority signal this section exists to measure. 30 s
+                # still guarantees no starvation across the run.
+                engine_priority_aging_s=30.0,
+                # Gang wait sized to the fan-out's emission spread (the
+                # siblings below arrive ~0.3 s apart, as a real
+                # decomposition emits them): the gang holds until its
+                # siblings are present — or this bound — then admits
+                # together ahead of the background.
+                engine_gang_wait_ms=1500.0,
+                # Pre-warm needs the KV cache tier; tiny hot store so
+                # the cold tier actually serves.
+                engine_prefix_cache=2, engine_kvcache_host_mb=64,
+                sampling=SamplingConfig(
+                    temperature=0.0, max_new_tokens=SERVE_MAX_NEW
+                ),
+            ))
+            agents = [
+                BaseAgent(
+                    config=AgentConfig(
+                        role=f"worker{i}", specializations=["generic"],
+                        max_iterations=max_iterations,
+                    ),
+                    llm=llm,
+                )
+                for i in range(gang + n_bg)
+            ]
+            serve = Serve(
+                name=f"sched-bench-{mode}", agents=agents, manager_llm=llm,
+                config=ServeConfig(
+                    decomposition_enabled=False,
+                    max_concurrent_tasks=gang + n_bg,
+                ),
+            )
+            await serve.start()
+            try:
+                # Warmup: compiles + the scheduler's stage model (two
+                # tasks per role teach the stage transitions and
+                # converge the pre-warm prefixes).
+                await asyncio.gather(*[
+                    serve.execute_task(f"warm task {i}")
+                    for i in range(gang + n_bg)
+                ])
+                _reset_task_attribution()
+                before = {k: _gm.get(k) for k in counters}
+                steps0 = _gm.get("engine.completed")
+                parent_bd = []
+                wave_walls = []
+                ok = total = 0
+                t0 = time.perf_counter()
+                for w in range(n_waves):
+                    parent_id = f"sched-{mode}-wave-{w}"
+                    gang_id = f"bench-gang-{mode}-{w}"
+                    global_dag.start(parent_id, type="fanout")
+                    # The straggler shape (ISSUE 12: "a task's slowest
+                    # branch stops straggling behind unrelated
+                    # traffic"): siblings are emitted ~0.3 s apart, the
+                    # way a real decomposition streams its subtasks
+                    # out, and an unrelated LOW-priority BURST lands
+                    # between the second-to-last and LAST sibling.
+                    # Under FIFO exactly that one branch queues behind
+                    # the whole burst while its siblings already ran —
+                    # slowest − median spikes by the burst's drain
+                    # time. (Uniform background can't show this: FIFO
+                    # fairness delays every branch EQUALLY, and
+                    # straggler_s measures imbalance, not delay.) With
+                    # the scheduler on, the late sibling's HIGH
+                    # priority + the gang sort it ahead of the burst.
+                    def _bg(i):
+                        return asyncio.create_task(serve.execute_task(
+                            Task(
+                                description=(
+                                    f"background {w}-{i}: tally ledger "
+                                    f"{w * 10 + i}"
+                                ),
+                                priority="low",
+                            )
+                        ))
+
+                    def _sib(i):
+                        return asyncio.create_task(serve.execute_task(
+                            Task(
+                                description=(
+                                    f"branch {w}-{i}: check inventory "
+                                    f"{w * 10 + i}"
+                                ),
+                                priority="high",
+                                parent_task_id=parent_id,
+                                metadata={
+                                    "gang_id": gang_id,
+                                    "gang_size": gang,
+                                },
+                            )
+                        ))
+
+                    background = [_bg(0)]
+                    await asyncio.sleep(0.2)
+                    tw = time.perf_counter()
+                    sib_handles = []
+                    for i in range(gang - 1):
+                        sib_handles.append(_sib(i))
+                        await asyncio.sleep(0.3)
+                    background += [_bg(i) for i in range(1, n_bg)]
+                    await asyncio.sleep(0.3)
+                    sib_handles.append(_sib(gang - 1))  # the straggler
+                    sibs = await asyncio.gather(*sib_handles)
+                    wave_walls.append(time.perf_counter() - tw)
+                    summary = global_dag.finish(parent_id, "ok")
+                    parent_bd.append((summary or {}).get("breakdown") or {})
+                    bg = await asyncio.gather(*background)
+                    ok += sum(1 for r in list(sibs) + list(bg) if r.success)
+                    total += gang + len(bg)
+                wall = time.perf_counter() - t0
+                llm_steps = _gm.get("engine.completed") - steps0
+                hists = _gm.snapshot()["histograms"]
+
+                def _total(name):
+                    h = hists.get(name) or {}
+                    return (h.get("count") or 0) * (h.get("mean") or 0.0)
+
+                e2e_total = _total("task.e2e_s")
+                parent_cp = sum(
+                    float(bd.get("critical_path_s") or 0.0)
+                    for bd in parent_bd
+                )
+                delta = {
+                    k.split(".", 1)[1]: int(_gm.get(k) - before[k])
+                    for k in counters
+                }
+                out[mode] = {
+                    "swarm_straggler_frac": (
+                        round(_total("task.straggler_s") / e2e_total, 4)
+                        if e2e_total else None
+                    ),
+                    "swarm_critical_path_frac": (
+                        round(parent_cp / e2e_total, 4) if e2e_total else None
+                    ),
+                    "wave_p50_ms": round(
+                        statistics.median(wave_walls) * 1000.0, 1
+                    ),
+                    "steps_per_sec": round(llm_steps / wall, 2),
+                    "success": f"{ok}/{total}",
+                    **delta,
+                }
+            finally:
+                await serve.stop()
+                await llm.stop()
+            gc.collect()
+    finally:
+        # The process default: policy on (engine_sched_policy defaults
+        # to "dag" too) — later sections must not inherit "off".
+        global_scheduler.configure(policy="dag")
+    on, off = out.get("on") or {}, out.get("off") or {}
+
+    def _lower(key):
+        a, b = on.get(key), off.get(key)
+        return bool(a is not None and b is not None and a < b)
+
+    out["straggler_frac_improved"] = _lower("swarm_straggler_frac")
+    out["critical_path_frac_improved"] = _lower("swarm_critical_path_frac")
+    return out
+
+
 def _note(tag, payload):
     """Section progress to stderr — a crash in a later section must not
     lose the numbers already measured."""
@@ -1308,6 +1546,25 @@ async def run_bench():
         _note("cell FAILED", {"error": str(exc)})
         sec_cell = {"cell_error": str(exc)}
 
+    # Section 10: DAG-aware scheduler (ISSUE 12 / ROADMAP item 4) — the
+    # same fan-out-plus-background workload with the scheduler off then
+    # on; straggler_frac and (parent) critical_path_frac must come DOWN
+    # with it on. Runs the protocol checkpoint so agents actually
+    # complete tasks; greedy on/off parity is pinned by
+    # tests/test_sched.py rather than re-measured here.
+    sec_sched = None
+    try:
+        sec_sched = await bench_sched(
+            "protocol-s", "tpu" if on_accel else "cpu",
+            n_waves=4 if on_accel else 3,
+            gang=4 if on_accel else 3,
+            n_bg=6 if on_accel else 4,
+        )
+        _note("sched", sec_sched)
+    except Exception as exc:  # noqa: BLE001 — keep earlier sections
+        _note("sched FAILED", {"error": str(exc)})
+        sec_sched = {"sched_error": str(exc)}
+
     headline = sec_8b or sec_1b
     out = {
         "metric": "agent_steps_per_sec_per_chip",
@@ -1373,6 +1630,18 @@ async def run_bench():
             sec_cell.get("affinity_hit_rate") if sec_cell else None
         ),
         "CELL": sec_cell,
+        # DAG-aware scheduler headlines (ISSUE 12): straggler fraction
+        # with the scheduler on vs off on the same workload (full
+        # on/off blocks under SCHED).
+        "sched_straggler_frac_on": (
+            (sec_sched.get("on") or {}).get("swarm_straggler_frac")
+            if sec_sched else None
+        ),
+        "sched_straggler_frac_off": (
+            (sec_sched.get("off") or {}).get("swarm_straggler_frac")
+            if sec_sched else None
+        ),
+        "SCHED": sec_sched,
         **sec_pipeline,
         **(sec_swarm or {}),
         # Orchestrator-path phase percentiles: traffic since the last

@@ -33,6 +33,7 @@ from pilottai_tpu.core.task import Task, TaskPriority, TaskResult, TaskStatus
 from pilottai_tpu.obs.dag import global_dag, global_occupancy
 from pilottai_tpu.prompts.manager import PromptManager
 from pilottai_tpu.prompts.schemas import schema_for
+from pilottai_tpu.sched import global_scheduler
 from pilottai_tpu.tools.tool import Tool, ToolRegistry
 from pilottai_tpu.utils.json_utils import coerce_bool, extract_json
 from pilottai_tpu.utils.logging import get_logger
@@ -550,20 +551,56 @@ class BaseAgent:
         tools: Optional[List[Dict[str, Any]]] = None,
         schema: Optional[Dict[str, Any]] = None,
         task: Optional[Task] = None,
+        stage: Optional[str] = None,
     ) -> Dict[str, Any]:
+        sys_prompt = self.system_prompt()
+        # DAG-aware scheduling hints (pilottai_tpu/sched/): the task's
+        # full priority rung — boosted when its live remaining critical
+        # path dominates the active set — plus the gang tag for
+        # first-stage fan-out siblings. note_stage side effects learn
+        # this role's stage order and pre-warm the predicted NEXT
+        # stage's prompt prefix through the engine's KV cache tier.
+        # Structured form: the engine re-renders tool-preamble + system
+        # + user through the same framing as the real request
+        # (native._sched_prewarm mirrors _build_request per path), so
+        # the pre-warmed token prefix byte-matches the admission that
+        # follows. Built only when the scheduler can consume it
+        # (policy "dag" AND an engine attached) — otherwise rendering
+        # the tool preamble and merging 4 KB prefixes per call would be
+        # pure hot-path waste.
+        prefix: Optional[Dict[str, Any]] = None
+        if global_scheduler.wants_prefix:
+            prefix = {"system": sys_prompt, "user": prompt}
+            if tools:
+                from pilottai_tpu.engine.base import tool_preamble
+                from pilottai_tpu.engine.types import ToolSpec
+
+                prefix = {
+                    "tools": tool_preamble([
+                        t if isinstance(t, ToolSpec) else ToolSpec(**t)
+                        for t in tools
+                    ]),
+                    **prefix,
+                }
+        hints = global_scheduler.request_hints(
+            task, stage, role=self.role, prompt=prefix,
+        )
         # Every rules.yaml prompt demands strict JSON: constrained decoding
         # makes the reply well-formed by construction on in-tree engines —
         # and SCHEMA-constrained where the template's shape is expressible
         # (prompts/schemas.py), so the wire fields are exact, not hoped for.
         response = await self.llm.generate_response(
             [
-                {"role": "system", "content": self.system_prompt()},
+                {"role": "system", "content": sys_prompt},
                 {"role": "user", "content": prompt},
             ],
             tools=tools,
             json_mode=True,
             json_schema=schema,
             slo_class=self._slo_class_for(task),
+            priority=hints.get("priority"),
+            gang_id=hints.get("gang_id"),
+            gang_size=hints.get("gang_size", 0),
         )
         self.conversation_history.append(
             {"prompt_tail": prompt[-200:], "response": response.content[:500]}
@@ -580,7 +617,8 @@ class BaseAgent:
     async def _analyze_task(self, task: Task) -> Dict[str, Any]:
         prompt = self.prompts.format_prompt("task_analysis", task=task.to_prompt())
         return await self._ask(
-            prompt, schema=schema_for("agent", "task_analysis"), task=task
+            prompt, schema=schema_for("agent", "task_analysis"), task=task,
+            stage="analyze",
         )
 
     async def _select_tools(self, task: Task) -> List[Tool]:
@@ -598,6 +636,7 @@ class BaseAgent:
         data = await self._ask(
             prompt, tools=[t.to_spec() for t in candidates],
             schema=schema_for("agent", "tool_selection"), task=task,
+            stage="tools",
         )
         names = data.get("selected_tools", [])
         if not names and data.get("action"):
@@ -633,7 +672,8 @@ class BaseAgent:
                 ) or "none yet"),
             )
             plan = await self._ask(
-                prompt, tools=[t.to_spec() for t in tools] or None, task=task
+                prompt, tools=[t.to_spec() for t in tools] or None,
+                task=task, stage="step",
             )
             action = plan.get("action", "respond")
             complete = coerce_bool(plan.get("task_complete", False))
@@ -673,7 +713,8 @@ class BaseAgent:
             "result_evaluation", task=task.to_prompt(), result=str(output)[:2000]
         )
         return await self._ask(
-            prompt, schema=schema_for("agent", "result_evaluation"), task=task
+            prompt, schema=schema_for("agent", "result_evaluation"),
+            task=task, stage="evaluate",
         )
 
     # ------------------------------------------------------------------ #

@@ -252,6 +252,43 @@ class LLMConfig(BaseModel):
                 "engine_kvcache_policy must be 'cost' or 'lru'"
             )
         return v
+    # DAG-aware admission scheduling (pilottai_tpu/sched/ +
+    # engine/batcher.py, ROADMAP item 4). "dag" orders the admission
+    # backlog by request priority (Task.priority threads the full
+    # lattice through GenerationParams.priority), groups gang-tagged
+    # fan-out siblings, and ages waiting work one rung per
+    # engine_priority_aging_s so nothing starves; "fifo" is the seed's
+    # submission order. Greedy output is byte-identical either way
+    # (tests/test_sched.py).
+    engine_sched_policy: str = Field(default="dag")
+
+    @field_validator("engine_sched_policy")
+    @classmethod
+    def _valid_sched_policy(cls, v: str) -> str:
+        if v not in ("fifo", "dag"):
+            raise ValueError(
+                "engine_sched_policy must be 'fifo' or 'dag'"
+            )
+        return v
+    # Gang admission wait bound (ms): how long an incomplete gang — or
+    # one the free slots+pages can't take whole — may defer behind
+    # other work before it admits partially anyway.
+    engine_gang_wait_ms: float = Field(default=50.0, ge=0)
+    # Aging floor: seconds of backlog wait per promoted priority rung
+    # (LOW reaches CRITICAL after 3x this and can never starve under
+    # sustained critical-path load). 0 disables aging.
+    engine_priority_aging_s: float = Field(default=2.0, ge=0)
+    # Speculative stage pre-warm depth: how many tokens of a predicted
+    # next-stage prompt prefix the scheduler may ask the engine to
+    # pre-warm (KV cache tier restore staged on the prep thread — the
+    # next hop's prefill finds device-resident KV). 0 detaches the
+    # engine from the scheduler's pre-warm loop entirely.
+    engine_prewarm_depth: int = Field(default=512, ge=0)
+    # Dense prefix-store entry floor in tokens (None = the prefill
+    # bucket floor, 64 by default): prompts at or below it never cache
+    # — the engine warns ONCE when such a prompt is seen instead of
+    # missing silently (engine/prefix_cache.py).
+    engine_prefix_min_len: Optional[int] = Field(default=None, ge=1)
     # Adaptive draft-model speculation: >0 enables shallow-layer
     # self-drafting (the target's own first N layers + unembed propose
     # drafts — LayerSkip-style, no second checkpoint, no extra HBM) for

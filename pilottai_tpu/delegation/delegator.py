@@ -22,6 +22,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from pilottai_tpu.core.agent import BaseAgent
 from pilottai_tpu.core.task import Task
 from pilottai_tpu.obs.dag import global_dag
+from pilottai_tpu.sched import global_scheduler
 from pilottai_tpu.utils.logging import get_logger
 
 
@@ -129,6 +130,13 @@ class TaskDelegator:
             start=t0, end=time.perf_counter(),
             reason=reason, delegated=target is not None,
         )
+        if target is not None:
+            # Speculative stage pre-warm (pilottai_tpu/sched/): the
+            # delegation target is decided — start restoring its first
+            # stage's prompt preamble through the KV cache tier NOW, on
+            # the engine's prep thread, so by the time the task reaches
+            # the target's queue its first prefill finds resident KV.
+            global_scheduler.prewarm_role(target.role)
         return target, reason
 
     async def _evaluate_inner(

@@ -362,6 +362,9 @@ class ServingCell:
         json_schema=None,
         slo_class: Optional[str] = None,
         session_id: Optional[str] = None,
+        priority: Optional[int] = None,
+        gang_id: Optional[str] = None,
+        gang_size: int = 0,
     ):
         """Route-and-execute with bounded re-routing: replica faults
         (including a drain cancelling the in-flight call) re-admit on a
@@ -385,6 +388,7 @@ class ServingCell:
             task = asyncio.ensure_future(rep.handler.generate_response(
                 messages, tools=tools, params=params, json_mode=json_mode,
                 json_schema=json_schema, slo_class=cls, session_id=sid,
+                priority=priority, gang_id=gang_id, gang_size=gang_size,
             ))
             rep._calls.add(task)
             try:

@@ -54,7 +54,7 @@ import os
 import queue
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from types import SimpleNamespace
@@ -115,6 +115,11 @@ from pilottai_tpu.utils.metrics import global_metrics
 from pilottai_tpu.utils.tracing import global_tracer
 
 
+#: Priority-rung names for the per-priority backlog-wait histograms
+#: (index = the 0..3 lattice; mirrors core.task.TaskPriority).
+_PRIO_NAMES = ("low", "normal", "high", "critical")
+
+
 @dataclass
 class GenRequest:
     prompt_ids: List[int]
@@ -164,6 +169,19 @@ class GenRequest:
     # degradation ladder's last rung sheds it outright. None =
     # interactive semantics.
     slo_class: Optional[str] = None
+    # DAG-aware scheduling (pilottai_tpu/sched/): the full task-priority
+    # lattice (0=LOW … 3=CRITICAL), threaded Task.priority →
+    # GenerationParams.priority → here. Under sched_policy="dag" the
+    # backlog is priority-ordered (with an aging floor so LOW cannot
+    # starve); under "fifo" the field is carried but ignored.
+    priority: int = 1
+    # Gang admission: sibling fan-out branches from one decompose stage
+    # share a gang_id and are admitted as a group when slots+pages
+    # suffice for all ``gang_size`` members (bounded wait, then partial
+    # admit) — a task's slowest branch stops straggling behind
+    # unrelated backlog. None = ungoverned (FIFO/priority only).
+    gang_id: Optional[str] = None
+    gang_size: int = 0
     # KV-cache session handle (engine/kvcache/): multi-turn agent
     # conversations send the same id every turn, pinning their KV
     # lineage in the host tier across device-cache evictions — a resume
@@ -187,6 +205,10 @@ class GenRequest:
     # bench's prefix_hit_rate arbitrarily. Set by the first counted
     # lookup.
     kv_counted: bool = field(default=False, repr=False)
+    # Aging-floor rungs already granted (and counted) by the priority
+    # backlog — sched.priority_aged must count each promotion once, not
+    # once per selection cycle.
+    aged_rungs: int = field(default=0, repr=False)
 
     @property
     def flight_key(self) -> Optional[str]:
@@ -307,6 +329,15 @@ class ContinuousBatcher:
         kvcache_host_mb: int = 0,       # host-RAM cold tier for evicted
                                         # prefix KV (0 = off)
         kvcache_policy: str = "cost",   # tier eviction: "cost" | "lru"
+        sched_policy: str = "fifo",     # backlog order: "fifo" | "dag"
+                                        # (priority + gang + aging)
+        gang_wait_ms: float = 50.0,     # bounded wait for gang siblings
+                                        # / capacity before partial admit
+        priority_aging_s: float = 2.0,  # seconds of backlog wait per
+                                        # aged priority rung (starvation
+                                        # floor; 0 = no aging)
+        prefix_min_len: Optional[int] = None,  # dense-store entry floor
+                                               # (None = min_bucket)
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -367,6 +398,40 @@ class ContinuousBatcher:
         # backlog pressure drops the traffic nobody is watching first.
         self.max_queue_depth = max_queue_depth
         self.batch_shed_frac = batch_shed_frac
+        # DAG-aware backlog scheduling (pilottai_tpu/sched/, ROADMAP
+        # item 4): "dag" orders admission by effective priority
+        # (request priority + aging-floor promotions, gang siblings
+        # grouped), "fifo" keeps the seed's submission order. Greedy
+        # output is byte-identical either way — ordering changes WHEN a
+        # request admits, never what it computes (tests/test_sched.py).
+        if sched_policy not in ("fifo", "dag"):
+            raise ValueError(
+                f"unknown sched_policy {sched_policy!r}; "
+                f"supported: 'fifo', 'dag'"
+            )
+        self.sched_policy = sched_policy
+        self.gang_wait_ms = max(0.0, gang_wait_ms)
+        self.priority_aging_s = max(0.0, priority_aging_s)
+        # Gang bookkeeping: first-seen stamp per gang (the bounded-wait
+        # clock; pruned when the gang's last member leaves the
+        # backlog), the gangs the LAST ordering pass deferred
+        # (selection blocks on them instead of admitting a sibling
+        # subset early), and a bounded memory of gangs that ALREADY
+        # dispatched (metrics fire once per gang, and a late or
+        # fault-recovered sibling of a gang that already went must
+        # admit at its own priority immediately — re-deferring it
+        # behind the whole backlog for another wait bound would be the
+        # exact inversion the feature exists to remove).
+        self._gang_seen: Dict[str, float] = {}
+        self._gang_counted: "OrderedDict[str, bool]" = OrderedDict()
+        self._gang_deferred: set = set()
+        # Speculative stage pre-warm (sched/ → prep thread): predicted
+        # next-stage prompt prefixes waiting for a KV-tier lookup whose
+        # host hit stages the restore before the real request arrives.
+        # Bounded — pre-warm is advisory, a full queue just drops.
+        self._prewarm_queue: deque = deque(maxlen=32)
+        # One-shot dense-store floor warning (see _warn_min_len).
+        self._warned_min_len = False
         # Engine fault domain: bounded in-flight recovery, the capability
         # ladder, and (optionally) the device watchdog.
         self.recovery_max_attempts = max(0, recovery_max_attempts)
@@ -568,7 +633,15 @@ class ContinuousBatcher:
             else:
                 self.prefix_store = PrefixStore(
                     capacity=prefix_cache,
-                    min_len=min_bucket,
+                    # Entry floor: prompts shorter than this never cache
+                    # (engine_prefix_min_len; None = the prefill bucket
+                    # floor). Prompts below it get a one-shot warning at
+                    # export/pre-warm time instead of silently never
+                    # hitting (_warn_min_len).
+                    min_len=(
+                        prefix_min_len if prefix_min_len is not None
+                        else min_bucket
+                    ),
                     # Prompt-length cap bounds HBM: a 2048-row 8B entry
                     # is ~540 MB; capacity x 1024 rows keeps the store
                     # around 0.5 GB worst case next to 8 GB of weights
@@ -591,6 +664,7 @@ class ContinuousBatcher:
                 host_bytes=int(kvcache_host_mb) * 1024 * 1024,
                 policy=kvcache_policy,
                 get_cache=lambda: self.cache,
+                min_len=prefix_min_len,
             )
         # Restored page chains awaiting their device-thread pool write
         # (engine/kvcache/index.py:PendingRestore; appended under the
@@ -731,6 +805,7 @@ class ContinuousBatcher:
                 jax.block_until_ready(self.cache.lengths)
         except Exception:  # noqa: BLE001 — best-effort quiesce
             pass
+        self._prewarm_queue.clear()  # advisory: staged pre-warms drop
         # Fail any stranded requests.
         stranded = list(self._backlog)
         self._backlog.clear()
@@ -1309,6 +1384,265 @@ class ContinuousBatcher:
             except queue.Empty:
                 break
 
+    # ------------------------------------------------------------------ #
+    # DAG-aware backlog scheduling (pilottai_tpu/sched/, ROADMAP item 4)
+    # ------------------------------------------------------------------ #
+
+    def _eff_priority(self, req: GenRequest, now: float) -> int:
+        """Effective priority: the request's rung plus aging-floor
+        promotions — one rung per ``priority_aging_s`` of backlog wait,
+        so sustained critical-path traffic can delay LOW work but never
+        starve it (the starvation regression test pins this). Promotion
+        deltas are counted once per request (``sched.priority_aged``)."""
+        p = max(0, min(int(req.priority), 3))
+        if self.priority_aging_s > 0 and p < 3:
+            aged = int((now - req.submitted_at) / self.priority_aging_s)
+            if aged > 0:
+                boosted = min(3, p + aged)
+                if boosted - p > req.aged_rungs:
+                    global_metrics.inc(
+                        "sched.priority_aged", boosted - p - req.aged_rungs
+                    )
+                    req.aged_rungs = boosted - p
+                p = boosted
+        return p
+
+    def _order_backlog_locked(self) -> None:
+        """Priority-order the backlog in place (slot lock held;
+        ``sched_policy="dag"`` only). Stable sort by effective priority
+        then submission time — uniform-priority traffic therefore keeps
+        EXACT FIFO order (aging is monotone in wait, so it can never
+        invert two same-priority requests), and recovered re-admissions
+        (earliest ``submitted_at``) stay at the head.
+
+        Gang handling: members of one gang sort together on the gang's
+        BEST effective priority (one critical sibling lifts the whole
+        fan-out) and its earliest submission; a gang still missing
+        siblings, or whose whole membership doesn't fit the free
+        slots+pages right now, is DEFERRED behind ungoverned work until
+        either both hold or its bounded wait (``gang_wait_ms``) expires
+        — after which it admits partially rather than holding the line
+        forever. Ordering changes only WHEN a request admits, never
+        what it computes: greedy output is byte-identical under any
+        ordering (tests/test_sched.py pins it)."""
+        now = time.perf_counter()
+        items = list(self._backlog)
+        members: Dict[str, List[GenRequest]] = {}
+        for r in items:
+            if r.gang_id:
+                members.setdefault(r.gang_id, []).append(r)
+        # Prune the wait clocks of gangs that fully left the backlog.
+        # _gang_counted deliberately survives (bounded, see __init__):
+        # it marks gangs that already dispatched, so their stragglers
+        # skip deferral below.
+        for gid in list(self._gang_seen):
+            if gid not in members:
+                self._gang_seen.pop(gid, None)
+        free_slots = sum(
+            1 for i, s in enumerate(self._slots)
+            if s is None and i not in self._prep_reserved
+        ) - len(self._release)
+        deferred: set = set()
+        gang_eff: Dict[str, int] = {}
+        gang_anchor: Dict[str, float] = {}
+        for gid, reqs in members.items():
+            seen = self._gang_seen.setdefault(gid, now)
+            gang_eff[gid] = max(self._eff_priority(r, now) for r in reqs)
+            gang_anchor[gid] = min(r.submitted_at for r in reqs)
+            if gid in self._gang_counted:
+                # The gang already dispatched: a late-arriving or
+                # fault-recovered sibling admits at its own priority
+                # NOW — waiting for siblings that already ran would
+                # manufacture the straggler this machinery removes.
+                continue
+            if (now - seen) * 1e3 >= self.gang_wait_ms:
+                continue  # wait bound expired: partial-admit fallback
+            size = max((r.gang_size for r in reqs), default=0)
+            if size > self.n_slots:
+                # Unsatisfiable by construction: a gang wider than the
+                # engine can never co-admit, so deferring it would be
+                # pure priority inversion (lower-priority work taking
+                # every freed slot for the whole wait bound). Admit at
+                # priority immediately; the pop-time accounting counts
+                # it partial.
+                continue
+            complete = size <= len(reqs)
+            capacity = len(reqs) <= max(free_slots, 0)
+            if capacity and self.alloc is not None:
+                # Conservative whole-gang page check (ignores prefix
+                # sharing — a false defer only costs the bounded wait).
+                need_pages = sum(
+                    self.alloc.pages_needed(min(
+                        len(r.prompt_ids) + r.max_new_tokens,
+                        self.max_seq_len,
+                    ))
+                    for r in reqs
+                )
+                if need_pages > self.num_pages - 1:
+                    continue  # can never fit the pool: same clamp
+                capacity = need_pages <= self.alloc.free_pages
+            if not (complete and capacity):
+                deferred.add(gid)
+        # The selection loop consults this: a deferred gang at the
+        # backlog head BLOCKS (like a page-gated head) instead of
+        # admitting a sibling subset early — the sort below already put
+        # every admissible request in front of it, so only the gang
+        # itself waits. Recomputed every selection; the wait bound
+        # guarantees it clears.
+        self._gang_deferred = deferred
+        if len(items) < 2:
+            return
+
+        def key(r: GenRequest):
+            if r.gang_id:
+                return (
+                    1 if r.gang_id in deferred else 0,
+                    -gang_eff[r.gang_id],
+                    gang_anchor[r.gang_id],
+                    r.submitted_at,
+                )
+            return (0, -self._eff_priority(r, now), r.submitted_at, 0.0)
+
+        items.sort(key=key)
+        self._backlog = deque(items)
+
+    def _note_admission_pop(self, req: GenRequest) -> None:
+        """Backlog-pop bookkeeping (slot lock held): the per-priority
+        submit→admission wait histogram — priority inversion shows up
+        as a crossed percentile here, not in a debugger — and one
+        admit/partial outcome count per gang."""
+        wait_ms = max(0.0, (time.perf_counter() - req.submitted_at) * 1e3)
+        prio = _PRIO_NAMES[max(0, min(int(req.priority), 3))]
+        global_metrics.observe(f"engine.backlog_wait_ms.{prio}", wait_ms)
+        gid = req.gang_id
+        # Gang accounting only under the policy that actually groups
+        # gangs — under "fifo" the outcome counters would be
+        # meaningless ("partial" = siblings hadn't arrived yet) and the
+        # dispatched-gang memory would never serve its purpose.
+        if (
+            self.sched_policy == "dag"
+            and gid and gid not in self._gang_counted
+        ):
+            self._gang_counted[gid] = True
+            while len(self._gang_counted) > 1024:
+                self._gang_counted.popitem(last=False)
+            present = 1 + sum(1 for r in self._backlog if r.gang_id == gid)
+            if req.gang_size and present < req.gang_size:
+                global_metrics.inc("sched.gang_partial")
+            else:
+                global_metrics.inc("sched.gang_admits")
+
+    # ------------------------------------------------------------------ #
+    # Speculative stage pre-warm (sched/ → prep thread → KV cache tier)
+    # ------------------------------------------------------------------ #
+
+    def prewarm(
+        self, prompt_ids: List[int], session_id: Optional[str] = None
+    ) -> bool:
+        """Stage a KV-tier lookup for a PREDICTED prompt prefix (any
+        thread; advisory). The lookup runs on the prep thread
+        (``_drain_prewarms``): a host-tier hit starts its restore
+        exactly as a real admission's would — async H2D staged off the
+        device thread, pool scatter via ``_apply_restores`` — so when
+        the predicted request actually arrives its prefill finds
+        device-resident KV. No slot, no decode, no output: pre-warm can
+        reorder nothing and is byte-identity-neutral by construction.
+        Returns False when the engine cannot pre-warm (no KV cache
+        tier, warming up, or the advisory queue is full)."""
+        if self.kvcache is None or self._warming or not prompt_ids:
+            global_metrics.inc("sched.prewarm_skipped")
+            return False
+        if len(self._prewarm_queue) >= self._prewarm_queue.maxlen:
+            global_metrics.inc("sched.prewarm_skipped")
+            return False
+        self._prewarm_queue.append((list(prompt_ids), session_id))
+        self._prep_wake.set()
+        if not self.overlap_admission:
+            self._wake.set()
+        return True
+
+    def _drain_prewarms(self) -> None:
+        """Run queued pre-warm lookups (prep thread when overlapping,
+        device thread inline — the same thread that runs selection, so
+        the slot-lock discipline is identical to ``_prefix_hit``)."""
+        while True:
+            try:
+                ids, sid = self._prewarm_queue.popleft()
+            except IndexError:
+                return
+            global_metrics.inc("sched.prewarms")
+            if self.kvcache is None or self._warming:
+                global_metrics.inc("sched.prewarm_skipped")
+                continue
+            if (
+                self.page_index is None
+                and len(ids) <= self.kvcache.min_len
+            ):
+                # A dense entry stores the prompt minus its last token,
+                # so anything at or below the floor can never hit
+                # (KVCacheIndex.min_len — the documented
+                # engine_prefix_min_len knob).
+                self._warn_min_len(len(ids), "pre-warm")
+                global_metrics.inc("sched.prewarm_skipped")
+                continue
+            hit = False
+            try:
+                with self._lock:
+                    if self.page_index is not None:
+                        node, rec = self.kvcache.lookup_paged(
+                            ids, session_id=sid, alloc=self.alloc,
+                            max_seq_len=self.max_seq_len,
+                            need_tokens=min(len(ids), self.max_seq_len),
+                            epoch=self._alloc_epoch, count=False,
+                        )
+                        if rec is not None:
+                            self._pending_restores.append(rec)
+                        hit = node is not None or rec is not None
+                    elif self.prefix_store is not None:
+                        n = len(ids)
+
+                        def fits(plen: int, p_bucket: int) -> bool:
+                            return (
+                                plen + self._tail_bucket(max(n - plen, 1))
+                                <= self.max_seq_len
+                                and p_bucket <= self.max_seq_len
+                            )
+
+                        hit = self.kvcache.lookup_dense(
+                            ids, session_id=sid, fits=fits,
+                            bucket=self._bucket, count=False,
+                        ) is not None
+            except Exception as exc:  # noqa: BLE001 — advisory path
+                self._log.warning("prewarm lookup failed: %s", exc)
+                continue
+            if hit:
+                global_metrics.inc("sched.prewarm_hits")
+                # A staged restore scatters at the device thread's next
+                # _apply_restores drain — wake it.
+                self._wake.set()
+
+    def _warn_min_len(self, n: int, where: str) -> None:
+        """One-shot dense-store floor warning: prompts at or below
+        ``min_len`` silently never cache (entries store the prompt
+        minus its last token) — say so ONCE per engine instead of
+        letting bench or pre-warm prompts miss forever with no
+        signal."""
+        if self._warned_min_len:
+            return
+        self._warned_min_len = True
+        floor = (
+            self.kvcache.min_len if self.kvcache is not None
+            else (self.prefix_store.min_len
+                  if self.prefix_store is not None else 0)
+        )
+        self._log.warning(
+            "%s prompt of %d token(s) is at or below the dense "
+            "prefix-store floor (min_len=%d): prompts this short are "
+            "never cached or pre-warmed — lower engine_prefix_min_len "
+            "(docs/SERVING.md) if this workload should cache",
+            where, n, floor,
+        )
+
     def _admit(self) -> None:
         """Stop released slots, then dispatch pending admissions. With
         overlapped admission (the default) the groups arrive PREBUILT
@@ -1346,6 +1680,7 @@ class ContinuousBatcher:
 
         if not self.overlap_admission:
             self._drain_pending()
+            self._drain_prewarms()  # inline mode: same-thread parity
 
         # A segmented admission in flight: advance it by ONE segment and
         # yield the cycle — the caller dispatches a decode chunk next, so
@@ -1567,6 +1902,11 @@ class ContinuousBatcher:
         of installs."""
         while not self._stop.is_set():
             self._drain_pending()
+            # Speculative pre-warms ride the prep thread too: the
+            # restore staging (host memcpy + async H2D) lands exactly
+            # where a real admission's would, never on the device
+            # thread.
+            self._drain_prewarms()
             if (
                 self._segmenting is not None
                 or self._seg_pending
@@ -1643,6 +1983,11 @@ class ContinuousBatcher:
         failure so overlapping selections can't double-book them."""
         seg = None
         with self._lock:
+            # DAG-aware ordering first (policy-gated; warmup keeps the
+            # compile sweep's deterministic submission order): priority
+            # + aging floor + gang grouping decide who the "head" is.
+            if self.sched_policy == "dag" and not self._warming:
+                self._order_backlog_locked()
             # A slot completed but not yet device-released is not yet
             # admissible: its release ops (decode stop, page free) run
             # next device cycle, and admitting into it now would let
@@ -1695,6 +2040,19 @@ class ContinuousBatcher:
                                     "request deadline expired before admission"
                                 ))
                             continue
+                        # A deferred gang at the head waits (bounded by
+                        # gang_wait_ms) for its siblings or for enough
+                        # slots+pages to take the WHOLE gang — the
+                        # ordering pass already moved every admissible
+                        # request in front of it, so nothing else is
+                        # being held up.
+                        if (
+                            self.sched_policy == "dag"
+                            and req.gang_id
+                            and req.gang_id in self._gang_deferred
+                        ):
+                            blocked = True
+                            break
                         # Prefix-cache match keys the group: one shared
                         # cached prefix per admission dispatch.
                         key = self._prefix_hit(req)
@@ -1757,6 +2115,7 @@ class ContinuousBatcher:
                                     blocked = True
                                     break
                         self._backlog.popleft()
+                        self._note_admission_pop(req)
                         idx = free.pop(0)
                         self._prep_reserved.add(idx)
                         if self.alloc is not None:
@@ -2323,6 +2682,9 @@ class ContinuousBatcher:
             # long-prompt workload is the one that needs caching most.
             ids = tuple(req.prompt_ids[:-1])[: store.max_len]
             if len(ids) < store.min_len:
+                # Below the entry floor: this prompt will never cache —
+                # one-shot warning instead of the PR 9 NOTE's silence.
+                self._warn_min_len(len(req.prompt_ids), "admitted")
                 continue
             with self._lock:
                 known = ids in seen or store.has(ids)
@@ -3344,6 +3706,16 @@ class ContinuousBatcher:
                 else {}
             ),
             "decode_steps": global_metrics.get("engine.decode_steps"),
+            # DAG-aware scheduling (pilottai_tpu/sched/): backlog
+            # ordering policy + gang/pre-warm outcome counters.
+            "sched": {
+                "policy": self.sched_policy,
+                "gang_admits": global_metrics.get("sched.gang_admits"),
+                "gang_partial": global_metrics.get("sched.gang_partial"),
+                "priority_aged": global_metrics.get("sched.priority_aged"),
+                "prewarms": global_metrics.get("sched.prewarms"),
+                "prewarm_hits": global_metrics.get("sched.prewarm_hits"),
+            },
             "overlap_admission": self.overlap_admission,
             "pipeline_depth": self.PIPELINE_DEPTH,
             "chunk_policy": self.chunk_policy,

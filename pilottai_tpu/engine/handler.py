@@ -169,6 +169,9 @@ class LLMHandler:
         json_schema: Optional[Dict[str, Any]] = None,
         slo_class: Optional[str] = None,
         session_id: Optional[str] = None,
+        priority: Optional[int] = None,
+        gang_id: Optional[str] = None,
+        gang_size: int = 0,
     ):
         """One request-normalization path for the streaming AND
         non-streaming calls — the two must never drift in default-params
@@ -203,6 +206,15 @@ class LLMHandler:
             # KV-cache session lineage (engine/kvcache/): same
             # fill-don't-override rule as slo_class.
             params = params.model_copy(update={"session_id": session_id})
+        if priority is not None and params.priority is None:
+            # DAG-aware scheduling (pilottai_tpu/sched/): the caller's
+            # task-priority rung — same fill-don't-override rule, so an
+            # explicit per-request priority always survives.
+            params = params.model_copy(update={"priority": priority})
+        if gang_id is not None and params.gang_id is None:
+            params = params.model_copy(
+                update={"gang_id": gang_id, "gang_size": gang_size}
+            )
         return msgs, specs, params
 
     def _ensure_trace(self, params: GenerationParams) -> GenerationParams:
@@ -280,6 +292,9 @@ class LLMHandler:
         json_schema: Optional[Dict[str, Any]] = None,
         slo_class: Optional[str] = None,
         session_id: Optional[str] = None,
+        priority: Optional[int] = None,
+        gang_id: Optional[str] = None,
+        gang_size: int = 0,
     ) -> LLMResponse:
         """Chat completion with retry/backoff (reference ``llm.py:38-66``).
 
@@ -290,10 +305,13 @@ class LLMHandler:
         none (the orchestrator passes its task-derived class here);
         ``session_id`` likewise fills the KV-cache session handle so
         multi-turn callers pin their prefix lineage across turns.
+        ``priority``/``gang_id``/``gang_size`` are the DAG scheduler's
+        admission hints (pilottai_tpu/sched/) — same fill-don't-override
+        rule.
         """
         msgs, specs, params = self._normalize(
             messages, tools, params, json_mode, json_schema, slo_class,
-            session_id,
+            session_id, priority, gang_id, gang_size,
         )
         params = self._ensure_trace(params)
         trace_id, flight_id = params.trace_id, params.flight_id
@@ -497,6 +515,9 @@ class LLMHandler:
         json_schema: Optional[Dict[str, Any]] = None,
         slo_class: Optional[str] = None,
         session_id: Optional[str] = None,
+        priority: Optional[int] = None,
+        gang_id: Optional[str] = None,
+        gang_size: int = 0,
         info: Optional[Dict[str, Any]] = None,
     ):
         """Streaming chat completion: an async generator of text deltas
@@ -514,7 +535,7 @@ class LLMHandler:
             messages = [messages]
         msgs, specs, params = self._normalize(
             messages, tools, params, json_mode, json_schema, slo_class,
-            session_id,
+            session_id, priority, gang_id, gang_size,
         )
         params = self._ensure_trace(params)
         trace_id, flight_id = params.trace_id, params.flight_id

@@ -115,11 +115,20 @@ class KVCacheIndex:
         host_bytes: int = 0,
         policy: str = "cost",
         get_cache: Optional[Callable[[], Any]] = None,
+        min_len: Optional[int] = None,
     ) -> None:
         self.prefix_store = prefix_store
         self.page_index = page_index
         self.page_size = page_size
         self._get_cache = get_cache
+        # Dense entry floor (engine_prefix_min_len): prompts at or below
+        # it never produce a dense entry (entries store the prompt minus
+        # its last token), so lookups and pre-warms that short can never
+        # hit. Documented here because the tier's callers (the batcher's
+        # pre-warm path, bench workloads) must clear it — the one-shot
+        # warning in the batcher fires when they don't. None = the
+        # store's own floor (or 0 paged, where granularity is a page).
+        self._min_len = min_len
         self.host: Optional[HostTier] = (
             HostTier(host_bytes, policy) if host_bytes > 0 else None
         )
@@ -133,6 +142,16 @@ class KVCacheIndex:
                 prefix_store.on_evict = self._spill_dense
             if page_index is not None:
                 page_index.on_evict = self._spill_page
+
+    @property
+    def min_len(self) -> int:
+        """The dense tier's caching floor in tokens (0 when paged or
+        uncached — block granularity makes the dense floor moot)."""
+        if self._min_len is not None:
+            return self._min_len
+        if self.prefix_store is not None:
+            return self.prefix_store.min_len
+        return 0
 
     # ------------------------------------------------------------------ #
     # Spill (eviction callbacks of the device-resident structures)

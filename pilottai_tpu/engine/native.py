@@ -236,6 +236,12 @@ class NativeEngine(LLMBackend):
             # budget + cost-aware eviction policy for both tiers.
             kvcache_host_mb=self.config.engine_kvcache_host_mb,
             kvcache_policy=self.config.engine_kvcache_policy,
+            # DAG-aware admission scheduling (pilottai_tpu/sched/):
+            # priority-ordered backlog + gang admission + aging floor.
+            sched_policy=self.config.engine_sched_policy,
+            gang_wait_ms=self.config.engine_gang_wait_ms,
+            priority_aging_s=self.config.engine_priority_aging_s,
+            prefix_min_len=self.config.engine_prefix_min_len,
             kv_quantize=self.config.engine_kv_quantize == "int8",
             draft_layers=self.config.engine_draft_layers,
             pipeline_depth=self.config.engine_pipeline,
@@ -258,9 +264,65 @@ class NativeEngine(LLMBackend):
         )
         self.batcher.start()
         self.batcher.warmup()
+        # Speculative stage pre-warm (pilottai_tpu/sched/): the global
+        # scheduler's predicted next-stage prefixes land here — encoded,
+        # clamped to engine_prewarm_depth tokens, and staged on the
+        # batcher's prep thread. Depth 0 = stay detached.
+        if self.config.engine_prewarm_depth > 0:
+            from pilottai_tpu.sched import global_scheduler
+
+            global_scheduler.attach_prewarm(id(self), self._sched_prewarm)
         self._log.info("engine up in %.1fs", time.perf_counter() - t0)
 
+    def _sched_prewarm(self, prompt, session_id=None) -> bool:
+        """Scheduler pre-warm entry point (any thread): render the
+        predicted prefix through the SAME chat framing as
+        ``_build_request`` — the structured ``{"system", "user"}`` form
+        re-renders via the chat template / generic transcript, so the
+        pre-warmed token prefix byte-matches the admission that follows
+        (a raw-text pre-warm would key the radix on different tokens
+        and never hit) — then hand it to the batcher's advisory
+        queue."""
+        batcher = self.batcher
+        if batcher is None:
+            return False
+        if isinstance(prompt, dict):
+            # Mirror _build_request's assembly EXACTLY per path: the
+            # chat template frames the tool preamble as the first
+            # system turn; the generic (template-less) path prepends it
+            # RAW ahead of the transcript (render_generic_request's
+            # tools kwarg). Framing it as a system turn on the generic
+            # path would diverge at byte 0 and the pre-warm would never
+            # match a tool-bearing admission.
+            tool_text = prompt.get("tools")
+            msgs = [
+                {"role": role, "content": str(prompt[role])}
+                for role in ("system", "user") if prompt.get(role)
+            ]
+            msg_dicts = (
+                [{"role": "system", "content": str(tool_text)}]
+                if tool_text else []
+            ) + msgs
+            rendered = self.tokenizer.render_chat(msg_dicts)
+            if rendered is not None:
+                ids = self.tokenizer.encode(rendered, add_bos=False)
+            else:
+                text = render_generic_request(
+                    [ChatMessage(**m) for m in msgs]
+                )
+                if tool_text:
+                    text = f"{tool_text}\n\n{text}"
+                ids = self.tokenizer.encode(text)
+        else:
+            ids = self.tokenizer.encode(str(prompt))
+        return batcher.prewarm(
+            ids[: self.config.engine_prewarm_depth], session_id=session_id
+        )
+
     async def stop(self) -> None:
+        from pilottai_tpu.sched import global_scheduler
+
+        global_scheduler.detach_prewarm(id(self))
         if self.batcher is not None:
             loop = asyncio.get_running_loop()
             await loop.run_in_executor(None, self.batcher.stop)
@@ -339,6 +401,11 @@ class NativeEngine(LLMBackend):
             # KV-cache session lineage: the batcher's prefix lookup pins
             # this session's host-tier entries against eviction.
             session_id=params.session_id,
+            # DAG-aware scheduling: the full priority lattice + gang
+            # tag, into the batcher's priority-ordered backlog.
+            priority=params.priority if params.priority is not None else 1,
+            gang_id=params.gang_id,
+            gang_size=params.gang_size,
             # Flight-recorder correlation: the batcher marks admission /
             # token phases against the flight id and emits its span
             # against the trace id.

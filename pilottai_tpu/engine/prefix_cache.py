@@ -57,7 +57,19 @@ class PrefixEntry:
 
 
 class PrefixStore:
-    """Radix-indexed store of cached prompt-prefix K/V panels."""
+    """Radix-indexed store of cached prompt-prefix K/V panels.
+
+    ``min_len`` is the ENTRY FLOOR and a real serving knob
+    (``engine_prefix_min_len``, default = the 64-token prefill bucket
+    floor): an entry stores the admitted prompt MINUS its last token
+    (match() requires a proper prefix — the tail token must produce the
+    first-token logits), so only prompts of at least ``min_len + 1``
+    tokens ever cache. Workloads of shorter prompts silently never hit;
+    the batcher warns once when it sees one (``_warn_min_len``) instead
+    of leaving that to a NOTE in the changelog. Lowering the floor
+    trades more (smaller, less valuable) entries for coverage of short
+    prompts; the cap ``max_len`` bounds per-entry HBM.
+    """
 
     def __init__(self, capacity: int = 8, min_len: int = 64,
                  max_len: int = 1024, policy: str = "lru",

@@ -126,6 +126,23 @@ _gm.declare("cell.migrated_tokens", "counter")
 _gm.declare("cell.migration_ms", "histogram")        # export→import wall
 _gm.declare("cell.drains", "counter")
 _gm.declare("cell.drain_s", "histogram")             # full drain wall
+# DAG-aware scheduler (pilottai_tpu/sched/ + the batcher's priority
+# backlog, ROADMAP item 4): declared at boot so the scheduling surface
+# is export_completeness-clean before the first boosted admission.
+# engine.backlog_wait_ms is per-priority-rung: submit → admission-pop
+# wall, the histogram that makes priority inversion VISIBLE (a critical
+# request waiting behind batch work shows up here, not in a debugger).
+_gm.declare("engine.backlog_wait_ms.low", "histogram")
+_gm.declare("engine.backlog_wait_ms.normal", "histogram")
+_gm.declare("engine.backlog_wait_ms.high", "histogram")
+_gm.declare("engine.backlog_wait_ms.critical", "histogram")
+_gm.declare("sched.priority_boosts", "counter")   # critical-path boosts
+_gm.declare("sched.priority_aged", "counter")     # aging-floor promotions
+_gm.declare("sched.gang_admits", "counter")       # whole-gang admissions
+_gm.declare("sched.gang_partial", "counter")      # wait-bound fallbacks
+_gm.declare("sched.prewarms", "counter")          # pre-warm requests
+_gm.declare("sched.prewarm_hits", "counter")      # found KV (hot or host)
+_gm.declare("sched.prewarm_skipped", "counter")   # no tier / below floor
 
 __all__ = [
     "AgentOccupancy",

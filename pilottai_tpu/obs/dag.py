@@ -443,6 +443,14 @@ class DagLedger:
         self._tracer = tracer
         # Queue residency start times (task_id → (perf_counter, priority)).
         self._queued: Dict[str, Tuple[float, str]] = {}
+        # Live criticality model (ROADMAP item 4 → pilottai_tpu/sched/):
+        # per-task-type stage profiles learned from finished dags — the
+        # ordered top-level stage names and an EMA of each stage's
+        # duration — so criticality() can blame-walk a PARTIALLY
+        # complete dag and estimate its remaining critical path while
+        # the task is still running.
+        self._stage_ema: Dict[Tuple[str, str], float] = {}
+        self._stage_seq: Dict[str, Tuple[str, ...]] = {}
         # Ambient (task_id, node_id) stack — contextvars so interleaved
         # asyncio task executions each see their own nesting.
         self._ctx: contextvars.ContextVar[tuple] = contextvars.ContextVar(
@@ -682,6 +690,93 @@ class DagLedger:
             pass
 
     # ------------------------------------------------------------------ #
+    # Live criticality (the control signal of pilottai_tpu/sched/)
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def _top_stages(dag: TaskDag) -> List[DagNode]:
+        """Top-level lifecycle stages in start order — the per-type
+        profile's alphabet. ``agent`` nodes count as stages (they ARE
+        the execute body for non-decomposed tasks)."""
+        return sorted(
+            (
+                n for n in dag.nodes.values()
+                if n.parent_id is None and n.kind in ("stage", "agent")
+            ),
+            key=lambda n: n.start,
+        )
+
+    def _learn_profile_locked(self, dag: TaskDag) -> None:
+        """Update the per-type stage profile from a finished dag (ledger
+        lock held): the ordered stage-name sequence (last run wins — the
+        pipeline shape, not an average) and a duration EMA per stage."""
+        stages = self._top_stages(dag)
+        if not stages:
+            return
+        ttype = str(dag.attributes.get("type") or "generic")
+        seen: List[str] = []
+        for node in stages:
+            if node.name not in seen:
+                seen.append(node.name)
+            key = (ttype, node.name)
+            prev = self._stage_ema.get(key)
+            dur = node.duration
+            self._stage_ema[key] = (
+                dur if prev is None else 0.7 * prev + 0.3 * dur
+            )
+        self._stage_seq[ttype] = tuple(seen)
+
+    def _criticality_locked(self, dag: TaskDag, now: float) -> float:
+        """Blame walk over one partially complete dag (ledger lock
+        held): completed profile stages contribute 0, the open stage
+        its EMA minus its elapsed time (floored at 0), stages not yet
+        started their full EMA."""
+        ttype = str(dag.attributes.get("type") or "generic")
+        seq = self._stage_seq.get(ttype)
+        if not seq:
+            return 0.0
+        by_name: Dict[str, DagNode] = {}
+        for node in self._top_stages(dag):
+            # Latest occurrence wins: a retried stage restarts its
+            # clock, and blaming the stale first run would zero out
+            # live work.
+            by_name[node.name] = node
+        remaining = 0.0
+        for name in seq:
+            ema = self._stage_ema.get((ttype, name), 0.0)
+            node = by_name.get(name)
+            if node is None:
+                remaining += ema
+            elif node.end is None:
+                remaining += max(ema - (now - node.start), 0.0)
+        return remaining
+
+    def criticality(self, task_id: str) -> float:
+        """Estimated REMAINING critical-path seconds for an active
+        task. 0.0 for unknown tasks or types with no finished history
+        (the estimator stays silent until it has evidence — the
+        scheduler then falls back to the task's static priority)."""
+        now = time.perf_counter()
+        with self._lock:
+            dag = self._active.get(task_id)
+            if dag is None:
+                return 0.0
+            return self._criticality_locked(dag, now)
+
+    def criticalities(self) -> Dict[str, float]:
+        """Remaining-critical-path estimates for every active task (the
+        scheduler's boost decision compares a task against this set).
+        ONE lock acquisition for the whole walk — this runs on every
+        agent LLM call, and per-task re-acquisition would serialize
+        agent threads on the observability lock."""
+        now = time.perf_counter()
+        with self._lock:
+            return {
+                tid: self._criticality_locked(dag, now)
+                for tid, dag in self._active.items()
+            }
+
+    # ------------------------------------------------------------------ #
     # Finish
     # ------------------------------------------------------------------ #
 
@@ -703,6 +798,7 @@ class DagLedger:
             if dag.ended is None:  # synthetic ledgers may pre-stamp it
                 dag.ended = time.perf_counter()
             dag.compute()
+            self._learn_profile_locked(dag)
             self._finished.append(dag)
             self._registry.set_gauge("task.active", len(self._active))
             parent = (
@@ -803,11 +899,15 @@ class DagLedger:
             return len(self._active)
 
     def reset(self) -> None:
-        """Drop all state (tests / bench section isolation)."""
+        """Drop all state (tests / bench section isolation) — including
+        the learned stage profiles, so one suite's task shapes can't
+        leak criticality estimates into another's."""
         with self._lock:
             self._active.clear()
             self._finished.clear()
             self._queued.clear()
+            self._stage_ema.clear()
+            self._stage_seq.clear()
             self._registry.set_gauge("task.active", 0.0)
 
 

@@ -1,0 +1,37 @@
+"""DAG-aware scheduling: the control loop over PR 7's tracing.
+
+``obs/dag.py`` *measures* critical paths, stragglers and orchestration
+overhead; this package *acts* on them (ROADMAP item 4, grounded in
+"Towards Efficient Agents: A Co-Design of Inference Architecture and
+System" — orchestration-level DAG knowledge driving engine-level
+admission). Three rungs:
+
+* **critical-path priority admission** — ``DagScheduler.priority_for``
+  turns ``global_dag.criticality()`` (a live blame-walk estimate of a
+  task's remaining critical path) into a priority boost; the full
+  lattice threads ``Task.priority`` → ``GenerationParams.priority`` →
+  ``GenRequest.priority`` into the batcher's priority-ordered backlog
+  (``engine_sched_policy="dag"``), with an aging floor so low-priority
+  work cannot starve.
+* **gang admission** — sibling fan-out branches from one decompose
+  stage carry a shared ``gang_id``; the batcher admits the gang as a
+  group when slots+pages suffice for all of it (bounded wait, then
+  partial-admit fallback), so a task's slowest branch stops straggling
+  behind unrelated traffic.
+* **speculative stage pre-warm** — on entering stage N, the scheduler
+  predicts stage N+1's prompt prefix (learned per role/stage) and asks
+  the engine to pre-warm it: the KV cache tier's session restore
+  (PR 9) staged on the prep thread (PR 5), so the next hop's prefill
+  is nearly free.
+
+Greedy outputs are byte-identical with the scheduler on or off
+(tests/test_sched.py) — the scheduler reorders and pre-warms, it never
+changes what any single request computes.
+
+Import cost: stdlib + obs + utils only — no jax (control-plane safe,
+same constraint as ``obs``/``reliability``).
+"""
+
+from pilottai_tpu.sched.scheduler import DagScheduler, global_scheduler
+
+__all__ = ["DagScheduler", "global_scheduler"]

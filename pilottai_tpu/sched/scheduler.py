@@ -1,0 +1,370 @@
+"""DagScheduler: priority boosts, gang tags and stage pre-warm.
+
+One process-global instance (``global_scheduler``) sits between the
+orchestrator (agents, serve, delegator) and the engine:
+
+* agents ask :meth:`request_hints` before every LLM call — it returns
+  the engine-facing ``priority`` (the task's static priority, boosted
+  when the task's live remaining critical path dominates the active
+  set), the ``gang_id``/``gang_size`` tag for sibling fan-out calls,
+  and — as a side effect — records the stage's prompt prefix and fires
+  a pre-warm for the PREDICTED next stage;
+* engines attach a pre-warm callback (:meth:`attach_prewarm`) at
+  start; ``prewarm`` broadcasts a predicted prompt prefix to every
+  attached engine, which stages the KV cache tier's restore on its
+  prep thread (``ContinuousBatcher.prewarm``). Without an attached
+  engine (mock backends, control-plane processes) every pre-warm is a
+  cheap no-op.
+
+The scheduler is ADVISORY by design: every method is best-effort and
+never raises into the serving path, the engine enforces its own aging
+floor against starvation, and ``policy="off"`` reduces every hint to
+the task's static priority with no gangs and no pre-warm.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from pilottai_tpu.obs.dag import global_dag
+from pilottai_tpu.utils.logging import get_logger
+from pilottai_tpu.utils.metrics import global_metrics
+
+#: Priority lattice bounds (core.task.TaskPriority: LOW=0 … CRITICAL=3).
+MIN_PRIORITY, MAX_PRIORITY = 0, 3
+
+#: A criticality estimate below this (seconds) never earns a boost —
+#: sub-50 ms remainders are noise against the estimator's EMA clock.
+_BOOST_FLOOR_S = 0.05
+
+#: Boost when a task's remaining critical path exceeds this multiple of
+#: the median across active tasks: the task IS the path everyone else's
+#: join is waiting on.
+_BOOST_RATIO = 1.5
+
+
+class DagScheduler:
+    """Advisory DAG-aware scheduler (see module docstring)."""
+
+    def __init__(self, policy: str = "dag") -> None:
+        self.policy = policy
+        self._lock = threading.Lock()
+        self._log = get_logger("sched")
+        # Engine pre-warm callbacks, keyed by attach key (the engine's
+        # id): fn(prompt_text, session_id) -> None.
+        self._prewarm_fns: Dict[Any, Callable[[str, Optional[str]], Any]] = {}
+        # Learned stage model per agent role: observed successor stage
+        # (the pipeline order analyze → tools → step → evaluate emerges
+        # from traffic, never hardcoded) and the latest prompt prefix
+        # per (role, stage) — what a pre-warm of that stage restores. A
+        # prefix is either plain text or the structured
+        # ``{"system": ..., "user": ...}`` form agents pass, which the
+        # engine re-renders through the SAME chat framing as a real
+        # request so the pre-warmed token prefix byte-matches the
+        # admission that follows.
+        self._next_stage: Dict[Tuple[str, str], str] = {}
+        self._first_stage: Dict[str, str] = {}
+        self._stage_prefix: Dict[Tuple[str, str], Any] = {}
+        # Observations per (role, stage): the stored prefix CONVERGES to
+        # the cross-task common head (template preamble) by repeated
+        # merging, and pre-warm only fires once a stage has stabilized
+        # (≥2 observations) — pre-warming one task's FULL prompt would
+        # whole-restore (and consume) a host entry no other task can
+        # prefix-match, hurting instead of helping.
+        self._stage_obs: Dict[Tuple[str, str], int] = {}
+        # Last stage seen per (task, role) — bounded LRU so abandoned
+        # tasks can't grow it without bound.
+        self._task_stage: "OrderedDict[Tuple[str, str], str]" = OrderedDict()
+        self._task_stage_cap = 512
+        #: Characters of prompt head kept per stage (the engine clamps
+        #: again to its own token-level ``engine_prewarm_depth``).
+        self.prefix_chars = 4096
+        # Criticality snapshot cache: priority_for runs on EVERY agent
+        # LLM call, and the estimates move on stage timescales
+        # (hundreds of ms) — re-walking the ledger per call would put
+        # the observability lock on the agent hot path. One snapshot
+        # per TTL window serves all calls inside it.
+        self._crit_ttl_s = 0.1
+        self._crit_at = 0.0
+        self._crit_snapshot: Dict[str, float] = {}
+
+    # ------------------------------------------------------------------ #
+    # Configuration / engine attachment
+    # ------------------------------------------------------------------ #
+
+    def configure(self, policy: Optional[str] = None) -> None:
+        if policy is not None:
+            if policy not in ("off", "dag"):
+                raise ValueError(
+                    f"unknown sched policy {policy!r}; supported: "
+                    f"'off', 'dag'"
+                )
+            self.policy = policy
+
+    def attach_prewarm(
+        self, key: Any, fn: Callable[[str, Optional[str]], Any]
+    ) -> None:
+        """Register an engine's pre-warm entry point (NativeEngine does
+        this at start and detaches at stop). Bound methods are held
+        WEAKLY — the process-global scheduler must never keep a whole
+        engine (weights, device cache) alive after its owner dropped it
+        without calling stop() (same discipline as the engine-health
+        registry's breaker subscriptions)."""
+        try:
+            ref: Any = weakref.WeakMethod(fn)
+        except TypeError:  # plain function / lambda (tests)
+            ref = lambda fn=fn: fn  # noqa: E731 — constant deref shim
+        with self._lock:
+            self._prewarm_fns[key] = ref
+
+    def detach_prewarm(self, key: Any) -> None:
+        with self._lock:
+            self._prewarm_fns.pop(key, None)
+
+    @property
+    def wants_prefix(self) -> bool:
+        """Should call sites bother building the pre-warm prefix?
+        Only under policy "dag" AND with at least one engine attached —
+        mock/external backends and prewarm_depth=0 deployments never
+        attach, and rendering tool preambles + merging 4 KB prefixes
+        per LLM call with zero consumers is hot-path waste."""
+        return self.policy == "dag" and bool(self._prewarm_fns)
+
+    # ------------------------------------------------------------------ #
+    # Priority (critical-path boost)
+    # ------------------------------------------------------------------ #
+
+    def priority_for(self, task: Any) -> int:
+        """The engine-facing priority for ``task``'s LLM calls: its
+        static ``Task.priority`` (clamped to the lattice), plus one rung
+        when the task's live remaining critical path dominates the
+        active set — the slowest branch of a fan-out (or the task a
+        deep pipeline is blocked on) preempts backlog ahead of its
+        siblings, which is exactly what shrinks the straggler gap."""
+        try:
+            base = int(getattr(task, "priority", 1))
+        except (TypeError, ValueError):
+            base = 1
+        base = max(MIN_PRIORITY, min(base, MAX_PRIORITY))
+        if self.policy != "dag" or base >= MAX_PRIORITY:
+            return base
+        try:
+            task_id = getattr(task, "id", None)
+            if task_id is None:
+                return base
+            now = time.monotonic()
+            with self._lock:
+                if now - self._crit_at > self._crit_ttl_s:
+                    self._crit_snapshot = global_dag.criticalities()
+                    self._crit_at = now
+                crits = self._crit_snapshot
+            crit = crits.get(task_id, 0.0)
+            if crit <= _BOOST_FLOOR_S or len(crits) < 2:
+                return base
+            others = sorted(v for k, v in crits.items() if k != task_id)
+            median = others[len(others) // 2]
+            if crit >= max(median * _BOOST_RATIO, _BOOST_FLOOR_S):
+                global_metrics.inc("sched.priority_boosts")
+                return min(base + 1, MAX_PRIORITY)
+        except Exception:  # noqa: BLE001 — advisory, never block a call
+            pass
+        return base
+
+    # ------------------------------------------------------------------ #
+    # Request hints (the one call sites make)
+    # ------------------------------------------------------------------ #
+
+    def request_hints(
+        self,
+        task: Any,
+        stage: Optional[str] = None,
+        *,
+        role: Optional[str] = None,
+        prompt: Optional[Any] = None,
+        session_id: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Engine-facing hints for one LLM call: ``priority`` always
+        (the full lattice threads even with the policy off — mapping
+        priority only onto slo_class was the lossy path this fixes),
+        ``gang_id``/``gang_size`` for the first stage of a tagged
+        fan-out sibling, plus the stage-transition side effects (prefix
+        learning, next-stage pre-warm) under policy "dag"."""
+        hints: Dict[str, Any] = {"priority": self.priority_for(task)}
+        if task is None:
+            return hints
+        meta = getattr(task, "metadata", None) or {}
+        gang_id = meta.get("gang_id")
+        if (
+            self.policy == "dag"
+            and gang_id
+            and stage is not None
+            and role is not None
+            and stage == self._first_stage.get(role, stage)
+        ):
+            # Only the first stage's calls gang: siblings drift apart
+            # after it, and ganging desynchronized calls would just
+            # burn the gang wait bound on every admission.
+            hints["gang_id"] = str(gang_id)
+            hints["gang_size"] = int(meta.get("gang_size") or 0)
+        if stage is not None and role is not None:
+            self.note_stage(
+                getattr(task, "id", None), role, stage,
+                prompt=prompt, session_id=session_id,
+            )
+        return hints
+
+    # ------------------------------------------------------------------ #
+    # Stage model + speculative pre-warm
+    # ------------------------------------------------------------------ #
+
+    def _clamp_prefix(self, prompt: Any) -> Any:
+        if isinstance(prompt, dict):
+            return {
+                k: str(v)[: self.prefix_chars] for k, v in prompt.items()
+            }
+        return str(prompt)[: self.prefix_chars]
+
+    @staticmethod
+    def _common_head(a: str, b: str) -> str:
+        n = min(len(a), len(b))
+        i = 0
+        while i < n and a[i] == b[i]:
+            i += 1
+        return a[:i]
+
+    def _merge_prefix(self, old: Any, new: Any) -> Any:
+        """Shrink the stored stage prefix to what is COMMON across
+        tasks: after two observations it holds exactly the shared
+        template preamble — the part a pre-warm can restore that the
+        next task's prompt will actually prefix-match."""
+        if isinstance(old, dict) and isinstance(new, dict):
+            return {
+                k: self._common_head(str(old.get(k, "")), str(v))
+                for k, v in new.items() if k in old
+            }
+        return self._common_head(str(old), str(new))
+
+    def note_stage(
+        self,
+        task_id: Optional[str],
+        role: str,
+        stage: str,
+        prompt: Optional[Any] = None,
+        session_id: Optional[str] = None,
+    ) -> None:
+        """Record a stage entry: learn the role's stage order and the
+        stage's prompt prefix, then pre-warm the PREDICTED next stage's
+        prefix so its prefill finds restored KV. Never raises. A no-op
+        with the policy off — learning would cost the hot path lock
+        traffic and prefix merges with no consumer."""
+        if self.policy != "dag":
+            return
+        try:
+            with self._lock:
+                self._first_stage.setdefault(role, stage)
+                if prompt:
+                    skey = (role, stage)
+                    clamped = self._clamp_prefix(prompt)
+                    prev_prefix = self._stage_prefix.get(skey)
+                    if prev_prefix is None:
+                        self._stage_prefix[skey] = clamped
+                        self._stage_obs[skey] = 1
+                    else:
+                        self._stage_prefix[skey] = self._merge_prefix(
+                            prev_prefix, clamped
+                        )
+                        self._stage_obs[skey] = (
+                            self._stage_obs.get(skey, 1) + 1
+                        )
+                predicted = None
+                if task_id is not None:
+                    key = (str(task_id), role)
+                    prev = self._task_stage.get(key)
+                    if prev is not None and prev != stage:
+                        self._next_stage[(role, prev)] = stage
+                    self._task_stage[key] = stage
+                    self._task_stage.move_to_end(key)
+                    while len(self._task_stage) > self._task_stage_cap:
+                        self._task_stage.popitem(last=False)
+                nxt = self._next_stage.get((role, stage))
+                if nxt is not None and self._stage_obs.get(
+                    (role, nxt), 0
+                ) >= 2:
+                    predicted = self._stage_prefix.get((role, nxt))
+            if self.policy == "dag" and predicted:
+                self.prewarm(predicted, session_id=session_id)
+        except Exception:  # noqa: BLE001 — advisory
+            pass
+
+    def prewarm_role(self, role: str, session_id: Optional[str] = None) -> None:
+        """Pre-warm a role's FIRST stage prefix — the delegator's hook:
+        the moment a delegation target is chosen, its first prompt's
+        preamble starts restoring before the task even reaches its
+        queue."""
+        if self.policy != "dag":
+            return
+        with self._lock:
+            first = self._first_stage.get(role)
+            prefix = (
+                self._stage_prefix.get((role, first))
+                if first is not None
+                and self._stage_obs.get((role, first), 0) >= 2
+                else None
+            )
+        if prefix:
+            self.prewarm(prefix, session_id=session_id)
+
+    def prewarm(self, prompt: Any, session_id: Optional[str] = None) -> int:
+        """Broadcast a predicted prompt prefix (text, or the structured
+        ``{"system", "user"}`` form) to every attached engine. Returns
+        how many engines accepted the pre-warm (0 without an engine —
+        mock backends and control planes pay nothing)."""
+        if self.policy != "dag" or not prompt:
+            return 0
+        with self._lock:
+            refs = list(self._prewarm_fns.items())
+        accepted = 0
+        dead = []
+        for key, ref in refs:
+            fn = ref()
+            if fn is None:  # engine collected without stop()
+                dead.append(key)
+                continue
+            try:
+                if fn(prompt, session_id) is not False:
+                    accepted += 1
+            except Exception as exc:  # noqa: BLE001 — advisory
+                self._log.warning("prewarm callback failed: %s", exc)
+        if dead:
+            with self._lock:
+                for key in dead:
+                    self._prewarm_fns.pop(key, None)
+        return accepted
+
+    # ------------------------------------------------------------------ #
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "policy": self.policy,
+                "engines_attached": len(self._prewarm_fns),
+                "stages_learned": len(self._stage_prefix),
+                "transitions_learned": len(self._next_stage),
+            }
+
+    def reset(self) -> None:
+        """Drop learned stage state (tests / bench mode isolation);
+        attached engines stay attached."""
+        with self._lock:
+            self._next_stage.clear()
+            self._first_stage.clear()
+            self._stage_prefix.clear()
+            self._stage_obs.clear()
+            self._task_stage.clear()
+
+
+global_scheduler = DagScheduler()

@@ -612,6 +612,19 @@ class Serve:
                 subtasks[i].id for i in deps if isinstance(i, int) and i < len(subtasks)
             ]
             subtasks.append(sub)
+        # Gang-tag the independent siblings (pilottai_tpu/sched/): the
+        # fan-out branches with no intra-decomposition dependencies all
+        # become runnable at once, and their first-stage LLM calls
+        # should admit to the engine as a group — the batcher holds a
+        # bounded wait for the whole gang so one branch's analysis
+        # doesn't straggle behind unrelated backlog while its siblings
+        # finish (the join waits for the slowest branch either way).
+        independent = [s for s in subtasks if not s.dependencies]
+        if len(independent) >= 2:
+            gang_id = f"gang-{task.id[:8]}"
+            for s in independent:
+                s.metadata["gang_id"] = gang_id
+                s.metadata["gang_size"] = len(independent)
         task.subtasks = [s.id for s in subtasks]
         self._parent_children[task.id] = [s.id for s in subtasks]
         self._emit_event(task, "decomposed", subtasks=[s.id for s in subtasks])

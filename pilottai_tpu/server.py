@@ -644,6 +644,42 @@ class APIServer:
         return raw
 
     @staticmethod
+    def _priority(
+        req: Dict[str, Any], headers: Optional[Dict[str, str]]
+    ) -> Optional[int]:
+        """The request's scheduling priority (pilottai_tpu/sched/):
+        body ``priority`` beats the ``x-priority`` header; accepts the
+        rung number (0-3) or its name (low/normal/high/critical).
+        Out-of-lattice values are a 400 — a typo'd priority silently
+        falling to NORMAL would exempt the request from the ordering
+        the client asked for."""
+        raw = req.get("priority")
+        if raw is None:
+            raw = (headers or {}).get("x-priority")
+        if raw is None:
+            return None
+        names = {"low": 0, "normal": 1, "high": 2, "critical": 3}
+        if isinstance(raw, str) and raw.strip().lower() in names:
+            return names[raw.strip().lower()]
+        try:
+            if isinstance(raw, bool) or (
+                isinstance(raw, float) and not raw.is_integer()
+            ):
+                # int(2.7) would silently truncate to HIGH — the same
+                # reject-don't-coerce contract as everything else here.
+                value = None
+            else:
+                value = int(raw)
+        except (TypeError, ValueError):
+            value = None
+        if value is None or not 0 <= value <= 3:
+            raise _HttpError(
+                400, "'priority' must be 0-3 or one of "
+                "low/normal/high/critical"
+            )
+        return value
+
+    @staticmethod
     def _session_id(
         req: Dict[str, Any], headers: Optional[Dict[str, str]]
     ) -> Optional[str]:
@@ -711,6 +747,9 @@ class APIServer:
         session_id = self._session_id(req, headers or {})
         if session_id is not None:
             params = params.model_copy(update={"session_id": session_id})
+        priority = self._priority(req, headers)
+        if priority is not None:
+            params = params.model_copy(update={"priority": priority})
         model = req.get("model") or getattr(
             getattr(handler, "config", None), "model_name", "default"
         )

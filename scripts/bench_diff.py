@@ -12,7 +12,9 @@ JSON. This tool makes it mechanical:
 
 It walks the top level, every ``models.<section>`` block, every
 ``SLO.classes.<class>`` / ``CELL.classes.<class>`` block and the
-``RECOVERY``, ``KVCACHE`` and ``CELL`` blocks, compares numeric
+``RECOVERY``, ``KVCACHE``, ``CELL`` and ``SCHED`` (scheduler-on /
+scheduler-off sub-blocks; straggler_frac and — in this section only —
+critical_path_frac are down-good) blocks, compares numeric
 metrics whose direction it knows (steps/s, MFU, attainment, busy_frac,
 recovered_frac, prefix_hit_rate, affinity_hit_rate,
 prefill_tokens_saved up = good; p50/p99, host_gap, burn_rate,
@@ -61,8 +63,16 @@ LOWER_BETTER = (
 )
 
 
-def _direction(key: str) -> Optional[int]:
-    """+1 = higher is better, -1 = lower is better, None = don't judge."""
+def _direction(key: str, section: str = "") -> Optional[int]:
+    """+1 = higher is better, -1 = lower is better, None = don't judge.
+
+    Section-aware exception: in the SCHED section the critical-path
+    FRACTION is the parent fan-out's makespan over total task time —
+    the scheduler exists to drive it DOWN — whereas the swarm/pipeline
+    sections' critical_path_frac is an attribution-tightness check
+    (cp ≈ e2e, higher = better-covered)."""
+    if section.startswith("sched") and "critical_path_frac" in key:
+        return -1
     for sub in LOWER_BETTER:
         if sub in key:
             return -1
@@ -127,7 +137,7 @@ def _from_tail(tail: str) -> Dict[str, Any]:
     diff only compares keys present in BOTH rounds."""
     doc: Dict[str, Any] = {}
     remainder = tail
-    for block in ("models", "SLO", "phases", "KVCACHE", "CELL"):
+    for block in ("models", "SLO", "phases", "KVCACHE", "CELL", "SCHED"):
         marker = f'"{block}": '
         at = remainder.find(marker)
         if at < 0:
@@ -174,7 +184,7 @@ def _sections(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     out: Dict[str, Dict[str, Any]] = {"top": {}}
     for key, value in doc.items():
         if key in ("models", "SLO", "phases", "RECOVERY", "KVCACHE",
-                   "CELL"):
+                   "CELL", "SCHED"):
             continue
         num = _numeric(value)
         if num is not None:
@@ -206,6 +216,21 @@ def _sections(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
                     k: n for k, v in block.items()
                     if (n := _numeric(v)) is not None
                 }
+    sched = doc.get("SCHED")
+    if isinstance(sched, dict):
+        # Section-root scalars plus the scheduler-on / scheduler-off
+        # sub-blocks (straggler/critical-path fracs, steps/s, success).
+        out["sched"] = {
+            k: n for k, v in sched.items()
+            if (n := _numeric(v)) is not None
+        }
+        for mode in ("on", "off"):
+            block = sched.get(mode)
+            if isinstance(block, dict):
+                out[f"sched.{mode}"] = {
+                    k: n for k, v in block.items()
+                    if (n := _numeric(v)) is not None
+                }
     for name, block in (doc.get("models") or {}).items():
         if isinstance(block, dict):
             out[f"models.{name}"] = {
@@ -234,7 +259,7 @@ def diff(
     for sec in sorted(set(old_secs) & set(new_secs)):
         o_blk, n_blk = old_secs[sec], new_secs[sec]
         for key in sorted(set(o_blk) & set(n_blk)):
-            direction = _direction(key)
+            direction = _direction(key, section=sec)
             if direction is None:
                 continue
             o, n = o_blk[key], n_blk[key]

@@ -118,6 +118,11 @@ async def test_heartbeat_carries_replica_routing_signals():
         global_slo.record("interactive", ok=False)
     global_metrics.set_gauge("engine.degrade_level", 2.0)
     global_metrics.set_gauge("engine.queue_depth", 7.0)
+    # Earlier suites' batchers (chaos shed tests) leave their
+    # max_queue_depth on the process-global gauge; clear it so the
+    # soft-norm branch under test is the one that runs regardless of
+    # file order (the 7/64 expectation below was order-dependent).
+    global_metrics.set_gauge("engine.max_queue_depth", 0.0)
 
     serve = _serve()
     await serve.start()

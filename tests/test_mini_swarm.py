@@ -31,7 +31,7 @@ pytestmark = [
 ]
 
 
-def _swarm_llm():
+def _swarm_llm(sched_policy="dag"):
     from pilottai_tpu.core.config import LLMConfig, SamplingConfig
     from pilottai_tpu.engine.handler import LLMHandler
 
@@ -40,6 +40,7 @@ def _swarm_llm():
         checkpoint_path=str(DEFAULT_CHECKPOINT),
         engine_slots=4, engine_admit_batch=4,
         engine_max_seq=SERVE_MAX_SEQ, engine_chunk=16, dtype="float32",
+        engine_sched_policy=sched_policy,
         sampling=SamplingConfig(
             temperature=0.0, max_new_tokens=SERVE_MAX_NEW
         ),
@@ -141,3 +142,66 @@ def test_mini_swarm_success_rate_and_checkpoint_routing():
     # specialist (2 extract + 2 summarize, executed over an idle pool).
     assert counts["extractor"] >= 2, counts
     assert counts["generator"] >= 2, counts
+
+
+def test_mini_swarm_scheduler_on_at_least_off():
+    """ISSUE 12 CI lane: the DAG-aware scheduler must never COST task
+    success — scheduler-on (priority backlog + gang + aging + pre-warm)
+    completes at least as many mini-swarm tasks as scheduler-off on the
+    same workload and checkpoint. (Latency gains are the bench's story;
+    this gate is about safety of turning the policy on by default.)"""
+    from pilottai_tpu.core.agent import BaseAgent
+    from pilottai_tpu.core.config import AgentConfig, ServeConfig
+    from pilottai_tpu.serve import Serve
+
+    async def run_swarm(policy):
+        from pilottai_tpu.sched import global_scheduler
+
+        global_scheduler.configure(
+            policy="dag" if policy == "dag" else "off"
+        )
+        global_scheduler.reset()
+        llm = _swarm_llm(sched_policy=policy)
+        agents = [
+            BaseAgent(
+                config=AgentConfig(
+                    role=f"worker{i}", specializations=["generic"],
+                    max_iterations=2,
+                ),
+                llm=llm,
+            )
+            for i in range(3)
+        ]
+        serve = Serve(
+            name=f"mini-swarm-{policy}", agents=agents, manager_llm=llm,
+            config=ServeConfig(
+                decomposition_enabled=False, max_concurrent_tasks=3,
+            ),
+        )
+        await serve.start()
+        try:
+            results = await asyncio.gather(*[
+                serve.execute_task(f"swarm task {i}: check inventory {i}")
+                for i in range(8)
+            ])
+            return sum(1 for r in results if r.success), len(results)
+        finally:
+            await serve.stop()
+            await llm.stop()
+
+    async def main():
+        try:
+            off_ok, off_n = await run_swarm("fifo")
+            on_ok, on_n = await run_swarm("dag")
+        finally:
+            from pilottai_tpu.sched import global_scheduler
+
+            global_scheduler.configure(policy="dag")
+        return off_ok, off_n, on_ok, on_n
+
+    off_ok, off_n, on_ok, on_n = asyncio.run(main())
+    assert on_n == off_n
+    assert on_ok >= off_ok, (
+        f"scheduler-on completed {on_ok}/{on_n} vs scheduler-off "
+        f"{off_ok}/{off_n} — the DAG policy cost task success"
+    )
