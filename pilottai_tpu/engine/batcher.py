@@ -77,6 +77,7 @@ from pilottai_tpu.engine.decode import (
     AF_TEMP,
     AF_TOPP,
     DecodeState,
+    _paged_kernel_for,
     admit_group,
     admit_group_prefix,
     admit_group_prefix_paged,
@@ -95,6 +96,9 @@ from pilottai_tpu.models.common import ModelConfig
 from pilottai_tpu.ops.kvcache import KVCache, free_slots
 from pilottai_tpu.ops.paged import PageAllocator, PagedKVCache
 from pilottai_tpu.ops.pallas.decode_attention import decode_shapes_ok
+from pilottai_tpu.ops.pallas.paged_attention import paged_sharding_ok
+from pilottai_tpu.parallel.collectives import CollectiveModel
+from pilottai_tpu.parallel.sharding import kv_shard_axes, place_kv_cache
 from pilottai_tpu.obs import (
     global_attribution,
     global_blackbox,
@@ -497,6 +501,68 @@ class ContinuousBatcher:
         self.flash_mesh = (
             mesh if mesh is not None and mesh.devices.size > 1 else None
         )
+        # Tensor-parallel serving state (ISSUE 13). ``mesh`` drives four
+        # things beyond the flash prefill:
+        # * the KV pool / dense cache panels are CREATED on their
+        #   sharded layout (_rebuild_device_state → place_kv_cache):
+        #   kv-heads over 'model', dense slots over 'data' — the paged
+        #   8B pool stops being resident whole on any one chip;
+        # * the paged Pallas decode kernel runs per-shard under
+        #   shard_map (kv_mesh → decode_chunk/decode_chunk_spec);
+        # * admission replicates over the 'data' axis: slots partition
+        #   into ``data_groups`` contiguous groups (the same split the
+        #   batch-dim sharding uses) and _free_slot_indices interleaves
+        #   selection across them, so a {'model':M,'data':D} engine
+        #   serves D balanced decode groups;
+        # * per-dispatch collective time is attributed per axis
+        #   (parallel/collectives.py → engine.collective_frac[.axis]).
+        self.mesh = self.flash_mesh
+        kv_axes = kv_shard_axes(
+            self.mesh, n_kv_heads=cfg.n_kv_heads, n_slots=n_slots
+        )
+        self.kv_heads_sharded = kv_axes["heads"] is not None
+        self.data_groups = int(kv_axes["data_groups"])
+        # The dense Pallas decode kernel (opt-in A/B path,
+        # PILOTTAI_DECODE_PALLAS) has no shard_map wrapper: on a mesh
+        # whose dense panels shard it cannot lower per-shard — demote
+        # to the XLA dense path, which GSPMD partitions fine (and which
+        # beats the kernel at serving sizes anyway; see use_pallas
+        # resolution above).
+        if (
+            self.mesh is not None and not paged and self.use_pallas
+            and (kv_axes["heads"] is not None or kv_axes["slots"] is not None)
+        ):
+            self.use_pallas = False
+        self.kv_mesh = None
+        if (
+            self.mesh is not None and paged and self.use_pallas
+            and paged_sharding_ok(self.mesh, n_slots, cfg.n_kv_heads)
+        ):
+            self.kv_mesh = self.mesh
+        # KV placement mesh: the pool/panels shard per kv_shard_axes —
+        # EXCEPT when the paged Pallas kernel will run but cannot run
+        # sharded (slots don't divide the data axes, or a seq axis is
+        # present): a model-sharded pool under the UNWRAPPED kernel
+        # would force a whole-pool gather (or fail to lower) on every
+        # dispatch, so the pool stays replicated and only the weights
+        # shard. The XLA fallback path partitions any layout.
+        self._kv_place_mesh = self.mesh
+        if paged and self.use_pallas and self.kv_mesh is None:
+            self._kv_place_mesh = None
+            if self.kv_heads_sharded:
+                # Report the EFFECTIVE placement: an operator debugging
+                # HBM pressure must not be told the pool is split across
+                # TP shards while it is resident whole on every chip.
+                self.kv_heads_sharded = False
+                get_logger("engine.batcher").warning(
+                    "paged Pallas kernel cannot run sharded on this "
+                    "mesh; KV pool stays replicated — only weights shard"
+                )
+        self.collective_model = CollectiveModel.for_mesh(
+            self.mesh, cfg,
+            platform="tpu" if self.on_tpu else "cpu",
+            paged=paged, kv_quantize=self.kv_quantize,
+        )
         self._log = get_logger("engine.batcher")
         # Subword JSON grammar tables (token_bytes [V, L], token_len [V])
         # from json_mask.token_byte_table — None for byte tokenizers,
@@ -665,6 +731,10 @@ class ContinuousBatcher:
                 policy=kvcache_policy,
                 get_cache=lambda: self.cache,
                 min_len=prefix_min_len,
+                # Host-tier restores upload already split over the
+                # 'model' axis when the pool is (ISSUE 13) — the
+                # restore scatter then consumes them shard-local.
+                place=self._restore_place,
             )
         # Restored page chains awaiting their device-thread pool write
         # (engine/kvcache/index.py:PendingRestore; appended under the
@@ -921,10 +991,6 @@ class ContinuousBatcher:
             load_autotune,
             store_autotune,
         )
-        from pilottai_tpu.ops.pallas.paged_attention import (
-            paged_decode_attention,
-        )
-
         # The key deliberately carries NO decode-chunk terms: the timing
         # exercises the attention kernel alone, so two deployments that
         # differ only in chunk_size / chunk_policy / chunk buckets must
@@ -934,17 +1000,28 @@ class ContinuousBatcher:
         # per-cell launch floor that is nb-insensitive, so a max_seq
         # change reuses the winner (clamped to the new VMEM-safe range)
         # instead of re-timing.
+        # Sharded dispatch times the shard_map-wrapped kernel over
+        # per-shard heads/slots — a different launch grid than single
+        # chip, so the winner is keyed by mesh shape (empty off-mesh:
+        # existing single-chip cache entries stay valid).
+        mesh_tag = (
+            ":mesh" + "x".join(
+                f"{a}{s}" for a, s in sorted(dict(self.kv_mesh.shape).items())
+                if s > 1
+            )
+            if self.kv_mesh is not None else ""
+        )
         key = (
             f"paged_strip:{self.cfg.name}:P{self.page_size}"
             f":nb{self.max_pages_per_slot}:K{self.cfg.n_kv_heads}"
             f":H{self.cfg.head_dim}:hd{self.cfg.n_heads}"
-            f":q{int(self.kv_quantize)}:B{self.n_slots}"
+            f":q{int(self.kv_quantize)}:B{self.n_slots}{mesh_tag}"
         )
         wide_key = (
             f"paged_strip:{self.cfg.name}:P{self.page_size}"
             f":K{self.cfg.n_kv_heads}:H{self.cfg.head_dim}"
             f":hd{self.cfg.n_heads}:q{int(self.kv_quantize)}"
-            f":B{self.n_slots}"
+            f":B{self.n_slots}{mesh_tag}"
         )
         cached = load_autotune(key)
         if cached is None:
@@ -971,13 +1048,19 @@ class ContinuousBatcher:
             )
             k_pool, v_pool = self.cache.layers[0]
             sc = None if self.cache.scales is None else self.cache.scales[0]
+            # Time the kernel the dispatch path will actually run: on a
+            # serving mesh the pool is model-sharded and the unwrapped
+            # pallas_call must never see it (it would gather the whole
+            # pool per rep — or fail to lower — and pick the strip from
+            # gather-dominated timings).
+            kernel = _paged_kernel_for(self.kv_mesh)
             candidates = sorted({
                 self._max_safe_strip(s) for s in (1, 2, 4, 8)
             })
             timings = {}
             for strip in candidates:
                 def run(strip=strip):
-                    return paged_decode_attention(
+                    return kernel(
                         q, k_pool, v_pool, tbl_j, last,
                         n_blocks=n_blocks, n_strip=strip,
                         softcap=self.cfg.attn_softcap,
@@ -1315,7 +1398,31 @@ class ContinuousBatcher:
         return max(self._bucket(n), min(128, self.max_seq_len))
 
     def _free_slot_indices(self) -> List[int]:
-        return [i for i, s in enumerate(self._slots) if s is None]
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        if self.data_groups <= 1 or len(free) <= 1:
+            return free
+        # Data-axis admission replication (ISSUE 13): slots partition
+        # into ``data_groups`` contiguous blocks — the exact split the
+        # batch-dim NamedSharding uses — and selection interleaves
+        # across groups, least-occupied first. A bursty admission wave
+        # then spreads its requests over every data shard's slots
+        # instead of filling group 0 while groups 1..D-1 idle, so a
+        # {'model':M,'data':D} engine genuinely serves D concurrent
+        # decode groups.
+        per = self.n_slots // self.data_groups
+        groups: List[List[int]] = [[] for _ in range(self.data_groups)]
+        for i in free:
+            groups[min(i // per, self.data_groups - 1)].append(i)
+        order = sorted(
+            range(self.data_groups),
+            key=lambda g: (per - len(groups[g]), g),  # occupancy, stable
+        )
+        out: List[int] = []
+        for rank in range(per):
+            for g in order:
+                if rank < len(groups[g]):
+                    out.append(groups[g][rank])
+        return out
 
     def _expire_deadlines(self) -> None:
         """Force-release occupied slots whose deadline passed mid-decode
@@ -2249,8 +2356,12 @@ class ContinuousBatcher:
                 global_metrics.inc("engine.prefill_segments")
                 if not self._warming:
                     seg_dur = time.perf_counter() - t_seg
-                    global_attribution.record(
-                        "prefill", seg_dur, tokens=seg
+                    self._record_attributed(
+                        "prefill", seg_dur, seg,
+                        est=(
+                            self.collective_model.prefill_seconds(seg)
+                            if self.collective_model is not None else None
+                        ),
                     )
                     with self._lock:
                         self._prefill_since_fold += seg_dur
@@ -2537,7 +2648,14 @@ class ContinuousBatcher:
             # estimate.
             pf_dur = admit_at - t_pf
             pf_tokens = int(prep.meta_i32[AI_LEN].sum())
-            global_attribution.record("prefill", pf_dur, tokens=pf_tokens)
+            self._record_attributed(
+                "prefill", pf_dur, pf_tokens,
+                est=(
+                    self.collective_model.prefill_seconds(pf_tokens)
+                    if self.collective_model is not None else None
+                ),
+                at=admit_at,
+            )
             idle_s = 0.0
             with self._lock:
                 if self._inflight == 0:
@@ -3072,6 +3190,10 @@ class ContinuousBatcher:
                     table=table,
                     use_pallas=self.paged and use_pallas_now,
                     page_strip=self.page_strip,
+                    kv_mesh=(
+                        self.kv_mesh
+                        if self.paged and use_pallas_now else None
+                    ),
                     draft_layers=self.draft_layers,
                     draft_mode=(
                         jnp.asarray(draft_vec)
@@ -3086,6 +3208,10 @@ class ContinuousBatcher:
                         prefix_bound=prefix_bound, table=table,
                         json_tables=chunk_json, schema_tables=chunk_schema,
                         page_strip=self.page_strip,
+                        kv_mesh=(
+                            self.kv_mesh
+                            if self.paged and use_pallas_now else None
+                        ),
                     )
                 )
         # Start the D2H transfer the moment the chunk is enqueued: the
@@ -3307,9 +3433,62 @@ class ContinuousBatcher:
                 dur = max(t_fold - prev_mark - gap_ms / 1e3 - pf_since, 0.0)
             else:
                 dur = max(t_fold - t_dispatch, 0.0)
-            global_attribution.record("decode", dur, tokens=accepted)
+            self._record_attributed(
+                "decode", dur, accepted,
+                est=(
+                    self.collective_model.decode_seconds(
+                        n_blocks, self.n_slots, accepted
+                    )
+                    if self.collective_model is not None else None
+                ),
+                at=t_fold,
+            )
         # Fold landed: the watchdog's definition of forward progress.
         self._beat()
+
+    def _restore_place(self, arr):
+        """Host→device upload for KV-tier restore panels, following the
+        pool's 'model'-axis sharding when it has one (identity layout
+        otherwise). Shapes: dense entries [L, K, rows, H]; paged restore
+        chains [L, 1, rows, K, H]."""
+        mesh = self._kv_place_mesh
+        if mesh is None or not self.kv_heads_sharded:
+            return jax.device_put(arr)
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        K = self.cfg.n_kv_heads
+        if arr.ndim == 4 and arr.shape[1] == K:
+            spec = P(None, "model", None, None)
+        elif arr.ndim == 5 and arr.shape[3] == K:
+            spec = P(None, None, None, "model", None)
+        else:
+            return jax.device_put(arr)
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    def _record_attributed(
+        self,
+        phase: str,
+        wall_s: float,
+        tokens: int,
+        est: Optional[Dict[str, float]] = None,
+        at: Optional[float] = None,
+    ) -> None:
+        """One dispatch's device-time attribution, with per-axis
+        collective time carved out of the measured wall (ISSUE 13).
+        ``est`` is the CollectiveModel's per-axis seconds estimate for
+        this dispatch; the split never invents time — collective +
+        compute records sum to exactly the measured wall, so
+        ``engine.collective_frac[.axis]`` is a share of real device
+        time. Off-mesh (est None/empty) this is the plain single-record
+        path the gauges always had."""
+        if est:
+            compute_s, coll = self.collective_model.split(wall_s, est)
+            global_attribution.record(
+                phase, compute_s, tokens=tokens, at=at, collective=coll,
+            )
+        else:
+            global_attribution.record(phase, wall_s, tokens=tokens, at=at)
 
     def _fire_stream(self, emits: List) -> None:
         """Fire streaming callbacks OUTSIDE the slot lock (reader thread).
@@ -3403,6 +3582,17 @@ class ContinuousBatcher:
                 dtype=self.cache_dtype, quantized=self.kv_quantize,
             )
             alloc = None
+        # Serving-mesh layout AT CREATION (parallel/sharding.py): paged
+        # pool kv-heads shard over 'model', dense panels over
+        # ('data'/'fsdp', 'model'). The cache is donated through every
+        # dispatch, so the initial committed layout is what jit's
+        # argument shardings follow — placing it here means the first
+        # dispatch starts sharded instead of paying a whole-pool
+        # reshard, and a failure-path rebuild restores the same layout.
+        cache = place_kv_cache(
+            cache, self._kv_place_mesh,
+            n_kv_heads=self.cfg.n_kv_heads, n_slots=self.n_slots,
+        )
         with self._lock:
             self.cache = cache
             self.alloc = alloc
@@ -3734,6 +3924,26 @@ class ContinuousBatcher:
             ),
             "collective_frac": round(
                 global_metrics.get("engine.collective_frac"), 4
+            ),
+            **(
+                {"mesh": {
+                    "shape": {
+                        str(a): int(s) for a, s in self.mesh.shape.items()
+                        if int(s) > 1
+                    },
+                    "n_chips": int(self.mesh.devices.size),
+                    "kv_heads_sharded": self.kv_heads_sharded,
+                    "data_groups": self.data_groups,
+                    "collective_frac_model": round(
+                        global_metrics.get("engine.collective_frac.model"),
+                        4,
+                    ),
+                    "collective_frac_data": round(
+                        global_metrics.get("engine.collective_frac.data"),
+                        4,
+                    ),
+                }}
+                if self.mesh is not None else {}
             ),
             **(
                 {"max_queue_depth": self.max_queue_depth,

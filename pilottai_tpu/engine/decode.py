@@ -54,9 +54,23 @@ from pilottai_tpu.ops.paged import (
     write_prompts_paged,
 )
 from pilottai_tpu.ops.pallas.decode_attention import decode_attention
-from pilottai_tpu.ops.pallas.paged_attention import paged_decode_attention
+from pilottai_tpu.ops.pallas.paged_attention import (
+    paged_decode_attention,
+    paged_decode_attention_sharded,
+)
 
 NEG_INF = -2.0**30
+
+
+def _paged_kernel_for(kv_mesh):
+    """The paged-attention entry point for this dispatch: per-shard
+    under ``shard_map`` when the pool is model-sharded (``kv_mesh`` set
+    by the batcher only when ``paged_sharding_ok``), else the plain
+    kernel. ONE selection point — the sharded-dispatch contract must
+    not diverge between the decode / spec / model-draft sites."""
+    if kv_mesh is not None:
+        return partial(paged_decode_attention_sharded, kv_mesh)
+    return paged_decode_attention
 
 # ---------------------------------------------------------------------- #
 # Packed admission metadata: ONE int32 + ONE float32 staging buffer per
@@ -334,6 +348,7 @@ def _combine_stats(acc_a, m_a, l_a, acc_b, m_b, l_b):
     jax.jit,
     static_argnames=(
         "cfg", "n_steps", "use_pallas", "prefix_bound", "page_strip",
+        "kv_mesh",
     ),
     donate_argnames=("cache", "dstate", "sampling"),
 )
@@ -353,6 +368,10 @@ def decode_chunk(
     # ^ (token_bytes [Vt, L], token_len [Vt]) — subword JSON grammar mask
     page_strip: int = 1,  # static — pages per paged-kernel grid cell
                           # (autotuned by the batcher at warmup)
+    kv_mesh: Any = None,  # static — serving mesh: the paged Pallas path
+                          # runs per-shard under shard_map (pool kv-heads
+                          # over 'model', slots over 'data'); None = the
+                          # single-chip dispatch
 ) -> Tuple[jax.Array, jax.Array, KVCache, DecodeState, SamplingState]:
     """Run ``n_steps`` decode steps for every slot in one dispatch.
 
@@ -457,8 +476,13 @@ def decode_chunk(
                 # chunk ring in (the separate per-layer ring dispatch +
                 # combine this path used to pay per step is gone) — the
                 # plain-decode stats contract allows it because the
-                # ring's validity is the shared scalar `i`.
-                acc_p, _, l_p = paged_decode_attention(
+                # ring's validity is the shared scalar `i`. On a serving
+                # mesh the kernel runs per-shard (kv-heads over 'model',
+                # slots over 'data'); the cross-shard merge is the
+                # output projection's all-reduce, not an attention-side
+                # collective (heads are independent).
+                kernel = _paged_kernel_for(kv_mesh)
+                acc_p, _, l_p = kernel(
                     qf, layer_k, layer_v, table, prefix_last,
                     q_positions=pos, n_blocks=n_blocks, n_strip=page_strip,
                     scale=qscale, softcap=cfg.attn_softcap, window=window,
@@ -683,7 +707,8 @@ def _model_drafts(
             qg = qf.reshape(B, K, G, H)
             if paged_kernel is not None:
                 sc = paged_kernel["kv_scales"]
-                acc_p, m_p, l_p = paged_decode_attention(
+                kernel = _paged_kernel_for(paged_kernel.get("kv_mesh"))
+                acc_p, m_p, l_p = kernel(
                     qf, prefix_panels[l][0], prefix_panels[l][1],
                     paged_kernel["table"], last, q_positions=qpos,
                     n_blocks=paged_kernel["n_blocks"], scale=qscale,
@@ -897,7 +922,7 @@ def _spec_block_attn(
     jax.jit,
     static_argnames=(
         "cfg", "n_steps", "draft_len", "prefix_bound", "use_pallas",
-        "draft_layers", "page_strip",
+        "draft_layers", "page_strip", "kv_mesh",
     ),
     donate_argnames=("cache", "dstate", "sampling", "history"),
 )
@@ -921,6 +946,8 @@ def decode_chunk_spec(
                                         # drafts come from the model
                                         # instead of the n-gram lookup
     page_strip: int = 1,     # static — pages per paged-kernel grid cell
+    kv_mesh: Any = None,     # static — serving mesh for the per-shard
+                             # paged kernel (see decode_chunk)
 ) -> Tuple[jax.Array, jax.Array, KVCache, DecodeState, SamplingState, jax.Array]:
     """Speculative fused chunk: ``n_steps`` verify-blocks of ``draft_len``
     tokens per dispatch. Same contract as ``decode_chunk`` except the
@@ -1005,7 +1032,8 @@ def decode_chunk_spec(
             # still n-gram-happy.
             pk_info = (
                 {"table": table, "n_blocks": n_blocks,
-                 "kv_scales": kv_scales, "n_strip": page_strip}
+                 "kv_scales": kv_scales, "n_strip": page_strip,
+                 "kv_mesh": kv_mesh}
                 if (paged and use_pallas) else None
             )
             mode = (
@@ -1047,7 +1075,8 @@ def decode_chunk_spec(
                 # (q_blocks): the kernel offsets row d's position by d
                 # for the sliding-window mask; causality vs the prefix
                 # is free (every prefix key precedes the block).
-                acc_p, m_p, l_p = paged_decode_attention(
+                kernel = _paged_kernel_for(kv_mesh)
+                acc_p, m_p, l_p = kernel(
                     qg.reshape(B, cfg.n_kv_heads * G * D, cfg.head_dim),
                     layer_k, layer_v, table, prefix_last,
                     q_positions=pos, n_blocks=n_blocks, q_blocks=D,

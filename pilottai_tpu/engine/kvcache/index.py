@@ -116,11 +116,19 @@ class KVCacheIndex:
         policy: str = "cost",
         get_cache: Optional[Callable[[], Any]] = None,
         min_len: Optional[int] = None,
+        place: Optional[Callable[[Any], Any]] = None,
     ) -> None:
         self.prefix_store = prefix_store
         self.page_index = page_index
         self.page_size = page_size
         self._get_cache = get_cache
+        # Host→device placement for restored panels. Default: plain
+        # ``jax.device_put``. A tensor-parallel batcher passes a
+        # sharding-aware placer so restored K/V uploads land already
+        # split over the 'model' axis — the follow-on admission/scatter
+        # consumes them without a whole-panel reshard (ISSUE 13: the
+        # PR 9 gather/spill/restore paths follow the KV sharding).
+        self._place = place if place is not None else jax.device_put
         # Dense entry floor (engine_prefix_min_len): prompts at or below
         # it never produce a dense entry (entries store the prompt minus
         # its last token), so lookups and pre-warms that short can never
@@ -279,8 +287,8 @@ class KVCacheIndex:
         if lcp < len(h.key) or p_bucket < h.rows:
             ks_h = ks_h[:, :, :p_bucket]
             vs_h = vs_h[:, :, :p_bucket]
-        ks_d = jax.device_put(ks_h)
-        vs_d = jax.device_put(vs_h)
+        ks_d = self._place(ks_h)
+        vs_d = self._place(vs_h)
         if lcp == len(h.key):
             # Whole-entry restore: ownership moves back to the hot
             # store. A partial (sliced) restore leaves the host entry in
@@ -385,10 +393,10 @@ class KVCacheIndex:
             pad = ((0, 0), (0, 0), (0, (kb - k) * P), (0, 0))
             ks_np = np.pad(ks_np, pad)
             vs_np = np.pad(vs_np, pad)
-        ks_dev = jax.device_put(
+        ks_dev = self._place(
             np.ascontiguousarray(ks_np.transpose(0, 2, 1, 3)[:, None])
         )
-        vs_dev = jax.device_put(
+        vs_dev = self._place(
             np.ascontiguousarray(vs_np.transpose(0, 2, 1, 3)[:, None])
         )
         table = np.full((1, kb), alloc.sentinel, np.int32)

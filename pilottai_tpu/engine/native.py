@@ -128,6 +128,21 @@ class NativeEngine(LLMBackend):
             self.model_cfg.param_count() / 1e9,
             dict(mesh_cfg.shape),
         )
+        # Tensor-parallel serving shardability (ISSUE 13): which KV dims
+        # will shard on this mesh and which degrade to replication — one
+        # loud line at boot instead of a silently replicated pool.
+        if self.mesh.devices.size > 1:
+            from pilottai_tpu.parallel.sharding import validate_serving_mesh
+
+            report = validate_serving_mesh(
+                self.mesh, self.model_cfg, self.config.engine_slots
+            )
+            self._log.info(
+                "serving mesh: kv_heads_sharded=%s data_groups=%d",
+                report["kv_heads_sharded"], report["data_groups"],
+            )
+            for warning in report["warnings"]:
+                self._log.warning("serving mesh: %s", warning)
         if self.config.checkpoint_path:
             # Format-dispatching: HF safetensors or a native orbax tree
             # (in-tree trained models, e.g. protocol-s).

@@ -144,6 +144,12 @@ class DeviceTimeAttributor:
             self._mesh_axes = tuple(mesh_axes)
         for axis in mesh_axes:
             self._registry.declare(f"engine.collective_frac.{axis}", "gauge")
+            # Cumulative per-axis collective seconds next to the rolling
+            # gauge: section-scoped consumers (bench MULTICHIP) take
+            # exact deltas instead of sampling a 60 s window.
+            self._registry.declare(
+                f"engine.attributed_collective_s.{axis}", "counter"
+            )
 
     # ------------------------------------------------------------------ #
 
@@ -156,20 +162,29 @@ class DeviceTimeAttributor:
         flops: Optional[float] = None,
         axis: Optional[str] = None,
         at: Optional[float] = None,
+        collective: Optional[Dict[str, float]] = None,
     ) -> None:
         """One dispatch's attribution. ``flops`` defaults to
         ``tokens x flops_per_token``; pass it explicitly for work the
         token formula doesn't describe (collectives: 0). ``axis`` tags
-        collective time to a mesh axis for the per-axis gauges."""
+        collective time to a mesh axis for the per-axis gauges.
+        ``collective`` is this dispatch's per-axis collective-seconds
+        split (the batcher's CollectiveModel carve-out): the axis events
+        land in the window under the SAME lock/gauge pass as the phase
+        record, so a sharded fold stays one attributor call instead of
+        one per axis on the reader-thread hot path."""
         if phase not in PHASES:
             raise ValueError(f"unknown phase {phase!r}; expected {PHASES}")
         now = at if at is not None else time.perf_counter()
         duration_s = max(float(duration_s), 0.0)
         if flops is None:
             flops = tokens * self._flops_per_token
+        coll = {
+            ax: float(s) for ax, s in (collective or {}).items() if s > 0.0
+        }
         with self._lock:
             if self._t0 is None:
-                self._t0 = now - duration_s
+                self._t0 = now - duration_s - sum(coll.values())
             self._events.append((now, phase, duration_s, flops, axis))
             self._w_flops += flops
             self._w_dur += duration_s
@@ -179,10 +194,20 @@ class DeviceTimeAttributor:
                     self._w_axis[axis] = (
                         self._w_axis.get(axis, 0.0) + duration_s
                     )
+            for ax, coll_s in coll.items():
+                self._events.append((now, "collective", coll_s, 0.0, ax))
+                self._w_dur += coll_s
+                self._w_coll += coll_s
+                self._w_axis[ax] = self._w_axis.get(ax, 0.0) + coll_s
             self._prune_locked(now)
             gauges = self._gauges_locked(now)
         reg = self._registry
         reg.inc(f"engine.attributed_{phase}_s", duration_s)
+        if phase == "collective" and axis is not None:
+            reg.inc(f"engine.attributed_collective_s.{axis}", duration_s)
+        for ax, coll_s in coll.items():
+            reg.inc("engine.attributed_collective_s", coll_s)
+            reg.inc(f"engine.attributed_collective_s.{ax}", coll_s)
         if flops:
             reg.inc("engine.achieved_flops", flops)
         if phase == "prefill" and tokens:

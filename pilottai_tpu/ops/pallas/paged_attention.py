@@ -374,4 +374,144 @@ def strip_vmem_bytes(
     return n_strip * (kv + sc)
 
 
-__all__ = ["paged_decode_attention", "strip_vmem_bytes"]
+# --------------------------------------------------------------------- #
+# Multi-chip dispatch (shard_map) — ISSUE 13: tensor-parallel serving
+# --------------------------------------------------------------------- #
+
+def paged_sharding_ok(
+    mesh,
+    n_slots: int,
+    n_kv_heads: int,
+    batch_axes: Tuple[str, ...] = ("data", "fsdp"),
+    head_axis: str = "model",
+    seq_axis: str = "seq",
+) -> bool:
+    """True when the paged kernel can run per-shard with no cross-device
+    work inside the attention itself: kv-heads divide the TP axis (the
+    pool's K dim and the query rows' head-major packing split along the
+    same boundary), the slot count divides the batch axes, and the
+    sequence axis is unsharded. GQA heads are independent, so sharding
+    them needs no collective — the cross-shard merge happens at the
+    attention OUTPUT projection, whose row-parallel matmul all-reduces
+    over ``model`` (the same contract as ``flash_sharding_ok``)."""
+    shape = dict(mesh.shape)
+    if int(shape.get(seq_axis, 1)) != 1:
+        return False
+    tp = int(shape.get(head_axis, 1))
+    db = 1
+    for a in batch_axes:
+        db *= int(shape.get(a, 1))
+    return n_kv_heads % tp == 0 and n_slots % db == 0
+
+
+def paged_decode_attention_sharded(
+    mesh,
+    q: jax.Array,        # [B, N, H] — N packs (kv_head, group[, q_block])
+    k_pool: jax.Array,   # [K, num_pages, P, H]
+    v_pool: jax.Array,
+    table: jax.Array,    # [B, max_pages]
+    last_valid: jax.Array,
+    q_positions: Optional[jax.Array] = None,
+    n_blocks: int = 0,
+    scale: Optional[float] = None,
+    softcap: float = 0.0,
+    window: int = 0,
+    q_blocks: int = 1,
+    k_scales: Optional[jax.Array] = None,
+    v_scales: Optional[jax.Array] = None,
+    n_strip: int = 1,
+    ring_k: Optional[jax.Array] = None,
+    ring_v: Optional[jax.Array] = None,
+    ring_step: Optional[jax.Array] = None,
+    interpret: bool = False,
+    batch_axes: Tuple[str, ...] = ("data", "fsdp"),
+    head_axis: str = "model",
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """:func:`paged_decode_attention` under ``shard_map``: the page
+    pool's kv-head dim shards over the TP axis, slots over the data
+    axes, and each shard runs the single-chip strip kernel on its own
+    heads and pages — the pool never materializes whole on any chip.
+    The query rows are head-major (``N = K·G[·D]``), so a contiguous N
+    split lands each shard exactly its own kv-heads' queries. Attention
+    over heads is embarrassingly parallel: the returned per-head stats
+    need no cross-shard combine — the merge over the model axis is the
+    attention output projection's all-reduce, emitted by GSPMD around
+    this call. Same call contract and bit-identical per-shard math as
+    the unsharded kernel (tests/test_multichip.py pins parity)."""
+    from jax.sharding import PartitionSpec as P
+
+    from pilottai_tpu.parallel.mesh import compat_shard_map
+
+    shape = dict(mesh.shape)
+    present = [
+        a for a in batch_axes
+        if a in mesh.axis_names and int(shape.get(a, 1)) > 1
+    ]
+    bspec = tuple(present) if present else None
+    head = (
+        head_axis
+        if head_axis in mesh.axis_names and int(shape.get(head_axis, 1)) > 1
+        else None
+    )
+    if q_positions is None:
+        q_positions = jnp.asarray(last_valid, jnp.int32)
+
+    in_specs = [
+        P(bspec, head, None),        # q
+        P(head, None, None, None),   # k_pool
+        P(head, None, None, None),   # v_pool
+        P(bspec, None),              # table
+        P(bspec),                    # last_valid
+        P(bspec),                    # q_positions
+    ]
+    operands = [q, k_pool, v_pool, table, last_valid, q_positions]
+    quantized = k_scales is not None
+    if quantized:
+        in_specs += [P(head, None, None), P(head, None, None)]
+        operands += [k_scales, v_scales]
+    ring = ring_k is not None
+    if ring:
+        in_specs += [
+            P(bspec, head, None, None),
+            P(bspec, head, None, None),
+            P(),                     # ring_step scalar
+        ]
+        operands += [ring_k, ring_v, jnp.asarray(ring_step, jnp.int32)]
+
+    def fn(q_, kp_, vp_, tb_, lv_, qp_, *rest):
+        i = 0
+        ks_ = vs_ = None
+        if quantized:
+            ks_, vs_ = rest[0], rest[1]
+            i = 2
+        rk_ = rv_ = rs_ = None
+        if ring:
+            rk_, rv_, rs_ = rest[i], rest[i + 1], rest[i + 2]
+        return paged_decode_attention(
+            q_, kp_, vp_, tb_, lv_, q_positions=qp_,
+            n_blocks=n_blocks, scale=scale, softcap=softcap,
+            window=window, q_blocks=q_blocks,
+            k_scales=ks_, v_scales=vs_, n_strip=n_strip,
+            ring_k=rk_, ring_v=rv_, ring_step=rs_,
+            interpret=interpret,
+        )
+
+    return compat_shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=(
+            P(bspec, head, None),    # acc [B, N, H]
+            P(bspec, head),          # m   [B, N]
+            P(bspec, head),          # l   [B, N]
+        ),
+        check_vma=False,
+    )(*operands)
+
+
+__all__ = [
+    "paged_decode_attention",
+    "paged_decode_attention_sharded",
+    "paged_sharding_ok",
+    "strip_vmem_bytes",
+]

@@ -3,23 +3,36 @@
 ABSENT in the reference (SURVEY.md §2.13-2.14 — its only concurrency is
 asyncio). This package is new TPU-native surface: SPMD over
 ``jax.sharding.Mesh`` with XLA collectives riding ICI, scaling the in-tree
-engine the way the reference's remote-API path never could.
+engine the way the reference's remote-API path never could. As of
+ISSUE 13 it also owns the serving KV-cache shardings
+(``kv_shard_axes``/``place_kv_cache``) and the per-axis collective-time
+attribution model (``collectives.CollectiveModel``) behind
+``engine.collective_frac[.axis]``.
 """
 
+from pilottai_tpu.parallel.collectives import CollectiveModel, collective_ops
 from pilottai_tpu.parallel.mesh import MeshConfig, best_mesh_config, create_mesh
 from pilottai_tpu.parallel.ring_attention import ring_attention
 from pilottai_tpu.parallel.sharding import (
+    kv_shard_axes,
     logical_to_spec,
+    place_kv_cache,
     shard_params,
+    validate_serving_mesh,
     with_logical_constraint,
 )
 
 __all__ = [
+    "CollectiveModel",
     "MeshConfig",
-    "create_mesh",
     "best_mesh_config",
+    "collective_ops",
+    "create_mesh",
+    "kv_shard_axes",
     "logical_to_spec",
+    "place_kv_cache",
     "ring_attention",
     "shard_params",
+    "validate_serving_mesh",
     "with_logical_constraint",
 ]

@@ -1,0 +1,319 @@
+"""Per-axis collective-traffic attribution for the sharded serving path.
+
+PR 6 built ``engine.collective_frac[.axis]`` so multichip serving could
+attribute its interconnect time, but until the KV pool and decode state
+actually sharded (ISSUE 13) nothing ever recorded a ``collective`` phase
+and the gauges sat at 0 regardless of mesh shape. This module closes
+that loop without requiring a profiler in the serving hot path:
+
+* :class:`CollectiveModel` — a closed-form per-dispatch estimate of the
+  bytes each mesh axis moves for one decode block / one prefill token,
+  converted to seconds against a per-platform interconnect bandwidth.
+  The batcher carves the estimate OUT of its measured dispatch walls
+  (``decode`` + ``collective`` records sum to the same total), so
+  ``collective_frac`` is an attribution split of real time, never
+  invented time. The formulas mirror what GSPMD inserts for the
+  sharding rules in ``parallel/sharding.py``:
+
+  - **model axis** (tensor parallel): the attention output projection
+    and the MLP down projection each end in a row-parallel matmul whose
+    result all-reduces over ``model`` — 2 all-reduces of ``[B, T, E]``
+    per layer — plus the logits all-gather over the vocab shard at the
+    unembed. Ring all-reduce moves ``2 (M-1)/M`` of the payload per
+    chip; all-gather ``(M-1)/M``.
+  - **data axis** (batch parallel): steady-state decode is local —
+    slots, decode state and the dense cache batch dim are sharded over
+    ``data`` and never cross it. The cross-group term that remains is
+    the PAGED pool: pages are a global resource (any slot may hold any
+    page), so the pool replicates over ``data`` and every chunk-end
+    ring scatter / admission prompt scatter all-gathers its updates
+    across the data groups.
+
+* :func:`collective_ops` — parse collective ops (op kind, payload
+  bytes, replica groups) out of compiled/optimized HLO text and map
+  each to the mesh axis its replica groups span. Not used in the hot
+  path: it exists so tests can pin that the sharded decode executable
+  REALLY contains model-axis collectives (the premise the analytic
+  model rests on) instead of trusting the formula blindly.
+
+Estimates are documented as estimates (docs/PERF_NOTES.md round 10):
+the point is a live, always-on, per-axis split whose magnitude tracks
+the mesh shape, not a profiler replacement.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# Interconnect bandwidth per chip, bytes/s, used to turn modeled bytes
+# into modeled seconds. TPU v5e ICI: 1.6 Tbit/s aggregate ≈ 2e11 B/s
+# usable per direction per chip (scaling-book figure); the CPU value is
+# a nominal host-memcpy figure so virtual-mesh runs produce finite,
+# comparable-within-themselves fractions (same contract as the CPU
+# peak-FLOPs placeholder in obs/attribution.py).
+_ICI_BYTES_PER_S = {"tpu": 2.0e11, "gpu": 1.0e11, "cpu": 1.0e10}
+
+_DTYPE_BYTES = {"bfloat16": 2, "float32": 4, "float16": 2, "int8": 1}
+
+
+def interconnect_bytes_per_s(platform: str) -> float:
+    return _ICI_BYTES_PER_S.get(platform, _ICI_BYTES_PER_S["cpu"])
+
+
+@dataclass
+class CollectiveModel:
+    """Closed-form per-axis collective seconds for one engine dispatch.
+
+    Built once at batcher construction from the model config and mesh
+    shape; evaluated per fold with plain float math (no locks, no jax).
+    """
+
+    model_size: int = 1       # mesh 'model' axis extent (tensor parallel)
+    data_size: int = 1        # combined batch-axis extent (data × fsdp)
+    data_axis: str = "data"   # gauge key for the batch-parallel term —
+                              # the mesh's REAL batch axis name, so
+                              # collective_frac.<axis> and the declared
+                              # counters line up on an fsdp-only mesh
+                              # (a combined data×fsdp mesh books the
+                              # whole term under 'data')
+    n_layers: int = 0
+    hidden: int = 0
+    vocab: int = 0
+    dtype_bytes: int = 2
+    paged: bool = False
+    kv_bytes_per_token: int = 0   # per-token K+V bytes across layers
+    bytes_per_s: float = _ICI_BYTES_PER_S["cpu"]
+
+    @classmethod
+    def for_mesh(
+        cls,
+        mesh: Optional[Any],
+        cfg: Any,
+        *,
+        platform: str,
+        paged: bool,
+        kv_quantize: bool,
+    ) -> Optional["CollectiveModel"]:
+        """None when the mesh is absent or single-device (nothing to
+        attribute — the gauges stay 0 exactly as before)."""
+        if mesh is None or int(mesh.devices.size) <= 1:
+            return None
+        shape = dict(mesh.shape)
+        model = int(shape.get("model", 1))
+        data = int(shape.get("data", 1)) * int(shape.get("fsdp", 1))
+        if model <= 1 and data <= 1:
+            return None
+        item = _DTYPE_BYTES.get(jnp_dtype_name(cfg.dtype), 2)
+        kv_item = 1 if kv_quantize else item
+        return cls(
+            model_size=model,
+            data_size=data,
+            data_axis=(
+                "data" if int(shape.get("data", 1)) > 1 else "fsdp"
+            ),
+            n_layers=int(cfg.n_layers),
+            hidden=int(cfg.hidden_size),
+            vocab=int(cfg.vocab_size),
+            dtype_bytes=item,
+            paged=paged,
+            kv_bytes_per_token=(
+                2 * int(cfg.n_layers) * int(cfg.n_kv_heads)
+                * int(cfg.head_dim) * kv_item
+            ),
+            bytes_per_s=interconnect_bytes_per_s(platform),
+        )
+
+    # ------------------------------------------------------------------ #
+
+    def _model_axis_bytes(self, tokens: int) -> float:
+        """Per-chip bytes the ``model`` axis moves for ``tokens`` token
+        positions through the trunk: 2 activation all-reduces per layer
+        (attention out-projection + MLP down-projection, ring factor
+        2(M-1)/M) plus the logits all-gather at the unembed
+        ((M-1)/M of the full-vocab row)."""
+        if self.model_size <= 1 or tokens <= 0:
+            return 0.0
+        m = self.model_size
+        act = tokens * self.hidden * self.dtype_bytes
+        allreduce = 2.0 * self.n_layers * act * 2.0 * (m - 1) / m
+        # Logits are fp32 at the sampler boundary.
+        logits = tokens * self.vocab * 4.0 * (m - 1) / m
+        return allreduce + logits
+
+    def _data_axis_bytes(self, tokens: int) -> float:
+        """Per-chip bytes the ``data`` axis moves to keep the
+        data-replicated paged pool coherent: each written token's K/V
+        rows all-gather across the D groups ((D-1)/D). Dense caches
+        shard their batch dim over ``data`` and pay nothing here."""
+        if self.data_size <= 1 or tokens <= 0 or not self.paged:
+            return 0.0
+        d = self.data_size
+        return tokens * self.kv_bytes_per_token * (d - 1) / d
+
+    # ------------------------------------------------------------------ #
+
+    def decode_seconds(
+        self, n_blocks: int, batch: int, written_tokens: int
+    ) -> Dict[str, float]:
+        """Per-axis collective seconds for one folded decode chunk:
+        ``n_blocks`` block-steps over ``batch`` slots (every slot runs
+        the trunk whether or not its output is kept), with
+        ``written_tokens`` accepted tokens landing in the cache at the
+        chunk-end scatter."""
+        out: Dict[str, float] = {}
+        # Trunk all-reduces run per block over the whole slot batch; the
+        # batch dim is sharded over data, so the per-chip activation
+        # payload is batch / data rows.
+        rows = n_blocks * max(batch, 1) / max(self.data_size, 1)
+        m_bytes = self._model_axis_bytes(int(round(rows)))
+        if m_bytes > 0.0:
+            out["model"] = m_bytes / self.bytes_per_s
+        d_bytes = self._data_axis_bytes(written_tokens)
+        if d_bytes > 0.0:
+            out[self.data_axis] = d_bytes / self.bytes_per_s
+        return out
+
+    def prefill_seconds(self, tokens: int) -> Dict[str, float]:
+        """Per-axis collective seconds for one admission prefill over
+        ``tokens`` prompt tokens (trunk all-reduces + the paged prompt
+        scatter's cross-group gather)."""
+        out: Dict[str, float] = {}
+        m_bytes = self._model_axis_bytes(
+            int(round(tokens / max(self.data_size, 1)))
+        )
+        if m_bytes > 0.0:
+            out["model"] = m_bytes / self.bytes_per_s
+        d_bytes = self._data_axis_bytes(tokens)
+        if d_bytes > 0.0:
+            out[self.data_axis] = d_bytes / self.bytes_per_s
+        return out
+
+    def split(
+        self, wall_s: float, est: Dict[str, float], cap: float = 0.5
+    ) -> Tuple[float, Dict[str, float]]:
+        """Attribution split of a measured dispatch wall: scale the
+        estimate down if it would claim more than ``cap`` of the wall
+        (the model must never invent time — a mis-sized bandwidth
+        constant degrades to a bounded overestimate, not a negative
+        compute record). Returns ``(compute_s, {axis: collective_s})``."""
+        total = sum(est.values())
+        if total <= 0.0 or wall_s <= 0.0:
+            return max(wall_s, 0.0), {}
+        scale = min(1.0, (cap * wall_s) / total)
+        scaled = {ax: s * scale for ax, s in est.items()}
+        return max(wall_s - sum(scaled.values()), 0.0), scaled
+
+
+def jnp_dtype_name(dtype: Any) -> str:
+    try:
+        return np.dtype(dtype).name
+    except TypeError:
+        return str(dtype)
+
+
+# --------------------------------------------------------------------- #
+# HLO inspection (tests / diagnostics — not the serving hot path)
+# --------------------------------------------------------------------- #
+
+_COLLECTIVE_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?(?:%?[\w.\-]+\s*=\s*)?"
+    r"(?:\(?([a-z0-9]+)\[([\d,]*)\][^)]*\)?)\s*"
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
+    r"([\w\-.]*)\(",
+)
+_GROUPS_RE = re.compile(r"replica_groups=\{(\{[\d,{} ]*\})\}")
+_HLO_DTYPE_BYTES = {
+    "f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "s8": 1, "u8": 1,
+    "pred": 1, "s64": 8, "u64": 8, "f64": 8,
+}
+
+
+@dataclass
+class CollectiveOp:
+    kind: str
+    dtype: str
+    shape: Tuple[int, ...]
+    bytes: int
+    groups: Tuple[Tuple[int, ...], ...] = ()
+    axis: Optional[str] = None
+
+    @property
+    def group_size(self) -> int:
+        return max((len(g) for g in self.groups), default=0)
+
+
+def _axis_groups(mesh: Any) -> Dict[str, frozenset]:
+    """For each mesh axis: the canonical set of linear-device-index
+    groups a collective spanning exactly that axis would use."""
+    shape = tuple(int(s) for s in mesh.devices.shape)
+    lin = np.arange(int(np.prod(shape))).reshape(shape)
+    out: Dict[str, frozenset] = {}
+    for k, name in enumerate(mesh.axis_names):
+        if shape[k] <= 1:
+            continue
+        moved = np.moveaxis(lin, k, -1).reshape(-1, shape[k])
+        out[str(name)] = frozenset(
+            frozenset(int(x) for x in row) for row in moved
+        )
+    return out
+
+
+def collective_ops(
+    hlo_text: str, mesh: Optional[Any] = None
+) -> List[CollectiveOp]:
+    """Collective ops in (optimized) HLO text, with payload bytes and —
+    when ``mesh`` is given — the mesh axis whose device groups match
+    each op's ``replica_groups`` (None when the groups span several
+    axes or could not be parsed)."""
+    axis_groups = _axis_groups(mesh) if mesh is not None else {}
+    ops: List[CollectiveOp] = []
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_RE.match(line)
+        if not m:
+            continue
+        dtype, dims, kind = m.group(1), m.group(2), m.group(3)
+        # Async pairs (all-reduce-start / all-reduce-done) both carry
+        # the full result payload; count the -start half only, else
+        # TPU-optimized HLO reports ~2x bytes with the -done half
+        # landing under "other" (no replica_groups on -done).
+        if m.group(4).startswith("-done"):
+            continue
+        shape = tuple(int(d) for d in dims.split(",") if d) if dims else ()
+        nbytes = _HLO_DTYPE_BYTES.get(dtype, 4)
+        for d in shape:
+            nbytes *= d
+        groups: Tuple[Tuple[int, ...], ...] = ()
+        gm = _GROUPS_RE.search(line)
+        if gm:
+            groups = tuple(
+                tuple(int(x) for x in g.split(",") if x.strip())
+                for g in re.findall(r"\{([\d, ]*)\}", gm.group(1))
+            )
+        axis = None
+        if groups and axis_groups:
+            gset = frozenset(frozenset(g) for g in groups if len(g) > 1)
+            for name, expect in axis_groups.items():
+                if gset and gset <= expect:
+                    axis = name
+                    break
+        ops.append(CollectiveOp(
+            kind=kind, dtype=dtype, shape=shape, bytes=nbytes,
+            groups=groups, axis=axis,
+        ))
+    return ops
+
+
+def collective_bytes_by_axis(
+    hlo_text: str, mesh: Any
+) -> Dict[str, int]:
+    """Total collective payload bytes per mesh axis in ``hlo_text``
+    (unattributable ops land under ``"other"``)."""
+    out: Dict[str, int] = {}
+    for op in collective_ops(hlo_text, mesh):
+        key = op.axis or "other"
+        out[key] = out.get(key, 0) + op.bytes
+    return out

@@ -12,9 +12,12 @@ JSON. This tool makes it mechanical:
 
 It walks the top level, every ``models.<section>`` block, every
 ``SLO.classes.<class>`` / ``CELL.classes.<class>`` block and the
-``RECOVERY``, ``KVCACHE``, ``CELL`` and ``SCHED`` (scheduler-on /
+``RECOVERY``, ``KVCACHE``, ``CELL``, ``SCHED`` (scheduler-on /
 scheduler-off sub-blocks; straggler_frac and — in this section only —
-critical_path_frac are down-good) blocks, compares numeric
+critical_path_frac are down-good) and ``MULTICHIP`` (per-chip steps/s,
+MFU and per_chip_efficiency up-good; ``collective_frac*`` /
+``collective_ms*`` down-good; the single-device reference under
+``multichip.single``) blocks, compares numeric
 metrics whose direction it knows (steps/s, MFU, attainment, busy_frac,
 recovered_frac, prefix_hit_rate, affinity_hit_rate,
 prefill_tokens_saved up = good; p50/p99, host_gap, burn_rate,
@@ -51,6 +54,8 @@ HIGHER_BETTER = (
     # KVCACHE section (ISSUE 10): prefix_hit_rate matches "hit_rate"
     # above; prefill FLOPs the tier saved are the other up-good axis.
     "tokens_saved",
+    # MULTICHIP section (ISSUE 13): sharded-vs-single-device scaling.
+    "per_chip_efficiency", "total_speedup",
 )
 LOWER_BETTER = (
     "overhead_frac", "straggler_frac", "p50", "p90", "p99", "host_gap",
@@ -60,6 +65,10 @@ LOWER_BETTER = (
     # fold-poison counts are all cost.
     "tokens_replayed", "rebuilds", "recovery_failed", "poisoned",
     "degrade_level", "watchdog_stalls",
+    # MULTICHIP section: interconnect share of device time (matches
+    # collective_frac, collective_frac_model/.data and — via "_ms" —
+    # collective_ms_per_step; must precede any up-good "frac" rule).
+    "collective",
 )
 
 
@@ -137,7 +146,8 @@ def _from_tail(tail: str) -> Dict[str, Any]:
     diff only compares keys present in BOTH rounds."""
     doc: Dict[str, Any] = {}
     remainder = tail
-    for block in ("models", "SLO", "phases", "KVCACHE", "CELL", "SCHED"):
+    for block in ("models", "SLO", "phases", "KVCACHE", "CELL", "SCHED",
+                  "MULTICHIP"):
         marker = f'"{block}": '
         at = remainder.find(marker)
         if at < 0:
@@ -184,7 +194,7 @@ def _sections(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     out: Dict[str, Dict[str, Any]] = {"top": {}}
     for key, value in doc.items():
         if key in ("models", "SLO", "phases", "RECOVERY", "KVCACHE",
-                   "CELL", "SCHED"):
+                   "CELL", "SCHED", "MULTICHIP"):
             continue
         num = _numeric(value)
         if num is not None:
@@ -231,6 +241,21 @@ def _sections(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
                     k: n for k, v in block.items()
                     if (n := _numeric(v)) is not None
                 }
+    multichip = doc.get("MULTICHIP")
+    if isinstance(multichip, dict):
+        # Section-root scalars (per-chip steps/s, MFU, per-axis
+        # collective fracs, efficiency) plus the single-device reference
+        # sub-block the sharded numbers are judged against.
+        out["multichip"] = {
+            k: n for k, v in multichip.items()
+            if (n := _numeric(v)) is not None
+        }
+        single = multichip.get("single_chip")
+        if isinstance(single, dict):
+            out["multichip.single"] = {
+                k: n for k, v in single.items()
+                if (n := _numeric(v)) is not None
+            }
     for name, block in (doc.get("models") or {}).items():
         if isinstance(block, dict):
             out[f"models.{name}"] = {
