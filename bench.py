@@ -1437,6 +1437,76 @@ async def run_multichip_cli():
     print(json.dumps(sec))
 
 
+async def bench_quant(on_accel, n_chips=1):
+    """QUANT section (ISSUE 14 / ROADMAP item 3): the same serving shape
+    per weight-quantization mode, so the decode-roofline claim is a
+    measured series — bytes/token read (the ``engine.weight_bytes*``
+    gauges set at engine boot), steps/s and MFU per mode, and the
+    int4-vs-int8 bytes ratio as the headline cost axis.
+
+    On an accelerator the modes are int8/int4 on the 8B north-star
+    model (the dense bf16 tree does not fit a 16 GB chip — that is the
+    point of the series). On CPU the section is plumbing proof on the
+    protocol-s shape: none/int8/int4, honest tiny-model caveat — its
+    tied fp32 embed is a far larger share of bytes/token than at 8B,
+    so the CPU ratio understates the 8B win (the layer-stream ratio is
+    pinned ≤ 0.55 by tests/test_quant_parity.py either way)."""
+    from pilottai_tpu.core.config import LLMConfig
+    from pilottai_tpu.utils.metrics import global_metrics as _gm
+
+    if on_accel:
+        model, modes = "llama3-8b-byte", ("int8", "int4")
+        shape = dict(
+            engine_slots=8, engine_chunk=16, engine_speculate=6,
+        )
+        load = dict(concurrency=8, steps=24, epochs=2)
+    else:
+        model, modes = "protocol-s", ("none", "int8", "int4")
+        shape = dict(engine_slots=4, engine_chunk=8, engine_speculate=0)
+        load = dict(concurrency=4, steps=12, epochs=1)
+    group = 128
+    out = {"model": model, "quant_group": group, "modes": {}}
+    for mode in modes:
+        cfg = LLMConfig(
+            model_name=model,
+            provider="tpu" if on_accel else "cpu",
+            engine_max_seq=512,
+            dtype="bfloat16" if on_accel else "float32",
+            engine_quant=mode,
+            engine_quant_group=group,
+            timeout=600.0,
+            **shape,
+        )
+        sec = await bench_model(cfg, n_chips=n_chips, **load)
+        out["modes"][mode] = {
+            "steps_per_sec_per_chip": sec["steps_per_sec_per_chip"],
+            "p50_step_ms": sec["p50_step_ms"],
+            "decode_tokens_per_sec_per_chip": sec[
+                "decode_tokens_per_sec_per_chip"
+            ],
+            "mfu": sec["mfu"],
+            # Gauges set by THIS engine's boot (sections run serially,
+            # last writer is this mode's batcher).
+            "weight_bytes": int(_gm.get("engine.weight_bytes")),
+            "weight_bytes_per_token": int(
+                _gm.get("engine.weight_bytes_per_token")
+            ),
+            **(
+                {"device_ms_per_step": sec.get("device_ms_per_step"),
+                 "device_busy_frac": sec.get("device_busy_frac")}
+                if sec.get("device_ms_per_step") is not None else {}
+            ),
+        }
+        _note(f"quant[{mode}]", out["modes"][mode])
+    if "int8" in out["modes"] and "int4" in out["modes"]:
+        out["bytes_per_token_int4_vs_int8"] = round(
+            out["modes"]["int4"]["weight_bytes_per_token"]
+            / max(out["modes"]["int8"]["weight_bytes_per_token"], 1),
+            4,
+        )
+    return out
+
+
 def _note(tag, payload):
     """Section progress to stderr — a crash in a later section must not
     lose the numbers already measured."""
@@ -1787,6 +1857,20 @@ async def run_bench():
         _note("multichip FAILED", {"error": str(exc)})
         sec_multichip = {"multichip_error": str(exc)}
 
+    # Section 12: QUANT (ISSUE 14 / ROADMAP item 3) — the decode weight
+    # stream per quantization mode: bytes/token (measured gauges),
+    # steps/s and MFU for int8 vs int4 (plus dense on CPU), with the
+    # int4/int8 bytes ratio as the cost headline. The fused greedy
+    # epilogue is on per the LLMConfig default, so these numbers are
+    # the composed fast path.
+    sec_quant = None
+    try:
+        sec_quant = await bench_quant(on_accel, n_chips=n_chips)
+        _note("quant", sec_quant)
+    except Exception as exc:  # noqa: BLE001 — keep earlier sections
+        _note("quant FAILED", {"error": str(exc)})
+        sec_quant = {"quant_error": str(exc)}
+
     headline = sec_8b or sec_1b
     out = {
         "metric": "agent_steps_per_sec_per_chip",
@@ -1886,6 +1970,20 @@ async def run_bench():
             if sec_multichip else None
         ),
         "MULTICHIP": sec_multichip,
+        # Weight-quantization headlines (ISSUE 14): 8B int4 MFU on the
+        # accel path (None on CPU runs — the CPU QUANT section is
+        # plumbing proof on the protocol-s shape) and the measured
+        # bytes/token ratio int4 vs int8 (the ≤ 0.55 acceptance axis at
+        # 8B; CPU understates it — tiny tied embed, see bench_quant).
+        "mfu_8b_quant": (
+            ((sec_quant.get("modes") or {}).get("int4") or {}).get("mfu")
+            if sec_quant and on_accel else None
+        ),
+        "quant_bytes_per_token_ratio": (
+            sec_quant.get("bytes_per_token_int4_vs_int8")
+            if sec_quant else None
+        ),
+        "QUANT": sec_quant,
         **sec_pipeline,
         **(sec_swarm or {}),
         # Orchestrator-path phase percentiles: traffic since the last
@@ -1914,6 +2012,10 @@ async def run_bench():
         "MULTICHIP",
         "multichip_steps_per_sec_per_chip", "multichip_mfu",
         "multichip_collective_frac_model", "multichip_collective_frac_data",
+        # QUANT headlines (ISSUE 14): the round's point is the decode
+        # roofline — the per-mode block and both scalar headlines must
+        # survive the driver's 2,000-byte tail window.
+        "QUANT", "mfu_8b_quant", "quant_bytes_per_token_ratio",
         "pipeline_error", "swarm_error", "pipeline_success", "swarm_success",
     ):
         if key in out:

@@ -39,8 +39,12 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="HF safetensors directory (random init without)")
     s.add_argument("--tokenizer", default=None,
                    help="local HF tokenizer path (byte tokenizer without)")
-    s.add_argument("--quantize", default=None, choices=["int8"],
-                   help="weight-only int8 (fits llama3-8b on one 16GB chip)")
+    s.add_argument("--quantize", default=None, choices=["int8", "int4"],
+                   help="weight-only quantization: int8 fits llama3-8b on "
+                        "one 16GB chip; int4 halves the decode weight "
+                        "stream again (packed nibbles + group scales)")
+    s.add_argument("--quant-group", type=int, default=128,
+                   help="int4 scale-group width over the contraction axis")
     s.add_argument("--kv-quantize", default=None, choices=["int8"])
     s.add_argument("--slots", type=int, default=8,
                    help="continuous-batching slots")
@@ -246,6 +250,7 @@ async def run_serve(args, ready: Optional[asyncio.Event] = None,
         checkpoint_path=args.checkpoint,
         tokenizer_path=args.tokenizer,
         quantize=args.quantize,
+        engine_quant_group=args.quant_group,
         engine_kv_quantize=args.kv_quantize,
         engine_slots=args.slots,
         engine_max_seq=args.max_seq,

@@ -155,9 +155,54 @@ class LLMConfig(BaseModel):
     # Engine placement / serving shape
     mesh_shape: Optional[Dict[str, int]] = None  # e.g. {"data": 1, "model": 8}
     dtype: str = "bfloat16"
-    # Weight-only quantization for serving ("int8" or None). Halves the
+    # Weight-only quantization for serving — legacy spelling, kept as an
+    # alias for ``engine_quant`` ("int8"/"int4" or None). Shrinks the
     # per-token HBM weight stream that bounds decode (models/quant.py).
     quantize: Optional[str] = None
+    # Weight quantization mode ("none" | "int8" | "int4"; None = follow
+    # the ``quantize`` alias above). int8 halves the decode weight
+    # stream with per-output-channel scales; int4 halves it AGAIN with
+    # packed nibbles + per-group scales (``engine_quant_group``), with
+    # quantization-sensitive fallbacks: lm_head stays int8, the MoE
+    # router stays dense. Greedy output of the packed path is
+    # byte-identical to an unpacked int4-dequant reference
+    # (tests/test_quant_parity.py).
+    engine_quant: Optional[str] = None
+
+    @field_validator("quantize")
+    @classmethod
+    def _valid_quantize(cls, v: Optional[str]) -> Optional[str]:
+        # Same value set as engine_quant — the fields are aliases.
+        if v not in (None, "none", "int8", "int4"):
+            raise ValueError(
+                f"unknown quantize mode {v!r}; "
+                "supported: 'none', 'int8', 'int4'"
+            )
+        return v
+
+    @field_validator("engine_quant")
+    @classmethod
+    def _valid_engine_quant(cls, v: Optional[str]) -> Optional[str]:
+        if v not in (None, "none", "int8", "int4"):
+            raise ValueError(
+                "engine_quant must be 'none', 'int8' or 'int4'"
+            )
+        return v
+    # int4 scale-group width over the contraction axis (rows per shared
+    # scale). Smaller groups bound quantization error tighter at
+    # 4/group extra bits per weight; 128 is the standard trade. Also
+    # part of the page-strip autotune key — a winner timed under one
+    # quantization shape is never silently reused under another.
+    engine_quant_group: int = Field(default=128, ge=1)
+    # Fused decode epilogue (engine/decode.py:fused_greedy_epilogue):
+    # when every occupied slot is greedy (temperature 0) and
+    # unconstrained (no JSON/schema grammar), the logits projection and
+    # sampling fuse into one vocab-tiled argmax — the [B, V] fp32
+    # logits never round-trip HBM and the sampler's full-vocab sort
+    # masks are skipped. Byte-identical on/off (the non-fusable shapes
+    # — JSON/schema decoding, sampled slots — take the unfused path per
+    # dispatch automatically).
+    engine_fused_epilogue: bool = True
     engine_slots: int = Field(default=8, ge=1)       # continuous-batching slots
     # Admission group width: prompts prefilled per fused admission
     # dispatch (padded to this, so compile variants stay bounded). A full

@@ -93,6 +93,7 @@ from pilottai_tpu.engine.page_prefix import PagePrefixIndex
 from pilottai_tpu.engine.prefix_cache import PrefixStore
 from pilottai_tpu.engine.sampling import SamplingState
 from pilottai_tpu.models.common import ModelConfig
+from pilottai_tpu.models.quant import weight_stream_bytes
 from pilottai_tpu.ops.kvcache import KVCache, free_slots
 from pilottai_tpu.ops.paged import PageAllocator, PagedKVCache
 from pilottai_tpu.ops.pallas.decode_attention import decode_shapes_ok
@@ -342,10 +343,35 @@ class ContinuousBatcher:
                                         # floor; 0 = no aging)
         prefix_min_len: Optional[int] = None,  # dense-store entry floor
                                                # (None = min_bucket)
+        weight_quant: str = "none",     # weight quantization mode the
+                                        # params carry ("none"|"int8"|
+                                        # "int4") — autotune keys and the
+                                        # QUANT bench read it here
+        quant_group: int = 128,         # int4 scale-group width (part of
+                                        # the autotune key)
+        fused_epilogue: bool = True,    # fuse projection+greedy sampling
+                                        # on all-greedy non-JSON chunks
     ) -> None:
         self.cfg = cfg
         self.params = params
         self.n_slots = n_slots
+        # Weight-quantization bookkeeping (ISSUE 14): the mode/group ride
+        # the page-strip autotune key (a winner timed under bf16 weights
+        # must never be reused under int4 — different HBM contention
+        # around the kernel), and the measured weight-stream bytes land
+        # in gauges so the bytes-halved claim is a series, not a
+        # docstring. Gauge values are GLOBAL logical bytes (divide by
+        # the TP shard count for per-chip).
+        self.weight_quant = weight_quant
+        self.quant_group = int(quant_group)
+        self.fused_epilogue = bool(fused_epilogue)
+        wb = weight_stream_bytes(params)
+        self.weight_bytes = wb["total"]
+        self.weight_bytes_per_token = wb["per_token"]
+        global_metrics.set_gauge("engine.weight_bytes", float(wb["total"]))
+        global_metrics.set_gauge(
+            "engine.weight_bytes_per_token", float(wb["per_token"])
+        )
         self.PIPELINE_DEPTH = max(1, pipeline_depth)
         self.max_seq_len = min(max_seq_len or cfg.max_seq_len, cfg.max_seq_len)
         self.min_bucket = min_bucket
@@ -980,6 +1006,45 @@ class ContinuousBatcher:
             strip //= 2
         return strip
 
+    def _strip_autotune_keys(self) -> Tuple[str, str]:
+        """(key, wide_key) for the persisted page-strip winner. The
+        WEIGHT quantization mode (and the int4 scale-group width) is
+        part of both: the strip timing runs with the weight set resident
+        in HBM, so a winner timed under bf16 weights reflects different
+        bandwidth contention than one under int4 — reusing it silently
+        across a quant-mode change was the ISSUE 14 satellite bug.
+        'none' adds no tag, so pre-existing cache entries stay valid for
+        unquantized deployments."""
+        mesh_tag = (
+            ":mesh" + "x".join(
+                f"{a}{s}" for a, s in sorted(dict(self.kv_mesh.shape).items())
+                if s > 1
+            )
+            if self.kv_mesh is not None else ""
+        )
+        # The scale group only shapes int4 weights — tagging it under
+        # int8 would spuriously invalidate cached winners when an
+        # operator carries a group setting across modes.
+        if self.weight_quant == "int4":
+            wq_tag = f":wq{self.weight_quant}:g{self.quant_group}"
+        elif self.weight_quant not in (None, "none"):
+            wq_tag = f":wq{self.weight_quant}"
+        else:
+            wq_tag = ""
+        key = (
+            f"paged_strip:{self.cfg.name}:P{self.page_size}"
+            f":nb{self.max_pages_per_slot}:K{self.cfg.n_kv_heads}"
+            f":H{self.cfg.head_dim}:hd{self.cfg.n_heads}"
+            f":q{int(self.kv_quantize)}:B{self.n_slots}{mesh_tag}{wq_tag}"
+        )
+        wide_key = (
+            f"paged_strip:{self.cfg.name}:P{self.page_size}"
+            f":K{self.cfg.n_kv_heads}:H{self.cfg.head_dim}"
+            f":hd{self.cfg.n_heads}:q{int(self.kv_quantize)}"
+            f":B{self.n_slots}{mesh_tag}{wq_tag}"
+        )
+        return key, wide_key
+
     def _autotune_page_strip(self) -> None:
         """Pick the paged-kernel strip width by timing the real kernel on
         the real pool (device thread idle — called from warmup before the
@@ -1004,25 +1069,7 @@ class ContinuousBatcher:
         # per-shard heads/slots — a different launch grid than single
         # chip, so the winner is keyed by mesh shape (empty off-mesh:
         # existing single-chip cache entries stay valid).
-        mesh_tag = (
-            ":mesh" + "x".join(
-                f"{a}{s}" for a, s in sorted(dict(self.kv_mesh.shape).items())
-                if s > 1
-            )
-            if self.kv_mesh is not None else ""
-        )
-        key = (
-            f"paged_strip:{self.cfg.name}:P{self.page_size}"
-            f":nb{self.max_pages_per_slot}:K{self.cfg.n_kv_heads}"
-            f":H{self.cfg.head_dim}:hd{self.cfg.n_heads}"
-            f":q{int(self.kv_quantize)}:B{self.n_slots}{mesh_tag}"
-        )
-        wide_key = (
-            f"paged_strip:{self.cfg.name}:P{self.page_size}"
-            f":K{self.cfg.n_kv_heads}:H{self.cfg.head_dim}"
-            f":hd{self.cfg.n_heads}:q{int(self.kv_quantize)}"
-            f":B{self.n_slots}{mesh_tag}"
-        )
+        key, wide_key = self._strip_autotune_keys()
         cached = load_autotune(key)
         if cached is None:
             cached = load_autotune(wide_key)
@@ -3166,6 +3213,29 @@ class ContinuousBatcher:
                 for s in self._slots
             ) else None
         )
+        # Fused decode epilogue (ISSUE 14): when every OCCUPIED slot is
+        # greedy and unconstrained, the chunk's sampler fuses into the
+        # vocab-tiled projection+argmax (engine/decode.py). Same
+        # lock-free slot read as the table gating above — slots install
+        # on this thread, so a sampled/JSON occupant is always seen; the
+        # reader only clears, worst case one conservative (unfused)
+        # chunk. Static flag → at most one extra executable per decode
+        # variant, compiled at warmup (warmup traffic is greedy).
+        # NOTE: gate on the REQUESTS, not on chunk_json/chunk_schema —
+        # byte tokenizers constrain through the built-in byte automaton
+        # with json_tables=None, so "no tables riding" does NOT imply
+        # "no constrained slot".
+        fused_now = (
+            self.fused_epilogue
+            and all(
+                s is None or (
+                    s.request.temperature <= 0.0
+                    and not s.request.json_mode
+                    and s.request.json_schema_id < 0
+                )
+                for s in self._slots
+            )
+        )
         # Degrade rung 1+ (reliability/degrade.py): speculative MODEL
         # drafting off — n-gram drafts only. The mode vector is a traced
         # input, so an all-False vector reuses the compiled executable
@@ -3199,6 +3269,7 @@ class ContinuousBatcher:
                         jnp.asarray(draft_vec)
                         if self.draft_layers else None
                     ),
+                    fused_epilogue=fused_now,
                 )
             else:
                 toks, valid, self.cache, self.dstate, self.sampling = (
@@ -3212,6 +3283,7 @@ class ContinuousBatcher:
                             self.kv_mesh
                             if self.paged and use_pallas_now else None
                         ),
+                        fused_epilogue=fused_now,
                     )
                 )
         # Start the D2H transfer the moment the chunk is enqueued: the
@@ -3905,6 +3977,16 @@ class ContinuousBatcher:
                 "priority_aged": global_metrics.get("sched.priority_aged"),
                 "prewarms": global_metrics.get("sched.prewarms"),
                 "prewarm_hits": global_metrics.get("sched.prewarm_hits"),
+            },
+            # Weight quantization (ISSUE 14): mode, int4 scale group,
+            # measured weight-stream bytes (the gauges set at boot) and
+            # whether the fused greedy epilogue is enabled.
+            "quant": {
+                "weight_quant": self.weight_quant,
+                "quant_group": self.quant_group,
+                "weight_bytes": self.weight_bytes,
+                "weight_bytes_per_token": self.weight_bytes_per_token,
+                "fused_epilogue": self.fused_epilogue,
             },
             "overlap_admission": self.overlap_admission,
             "pipeline_depth": self.PIPELINE_DEPTH,

@@ -29,8 +29,15 @@ from typing import Any, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from pilottai_tpu.engine.sampling import SamplingState, admit_sampling, sample_core
+from pilottai_tpu.engine.sampling import (
+    SamplingState,
+    admit_sampling,
+    sample_core,
+    split_step_keys,
+)
 from pilottai_tpu.models.common import ModelConfig, rms_norm, rope_tables
+from pilottai_tpu.models.qmatmul import qmatmul
+from pilottai_tpu.models.quant import Q4Tensor, QTensor
 from pilottai_tpu.models.transformer import (
     _attn_out,
     _embed,
@@ -209,6 +216,92 @@ def _layer_tail(cfg: ModelConfig, lp, x: jax.Array, attn: jax.Array) -> jax.Arra
     return x + out
 
 
+# --------------------------------------------------------------------- #
+# Fused greedy epilogue (ISSUE 14): logits projection + sampling as one
+# vocab-tiled reduction for the common all-greedy, non-JSON dispatch.
+# --------------------------------------------------------------------- #
+
+# Vocab tile for the fused epilogue: big enough that the projection
+# matmul stays MXU-shaped, small enough that a [B, tile] fp32 logits
+# block lives in registers/VMEM instead of round-tripping HBM.
+EPILOGUE_VOCAB_TILE = 8192
+
+
+def _head_tile(params, off: int, end: int):
+    """Columns [off, end) of the unembedding head, preserving the
+    weight's quantized representation (the tile's HBM read stays
+    int8/int4-sized). The head is ``lm_head`` when untied — possibly a
+    ``QTensor`` (int4 mode falls the head back to int8) — else the
+    transposed tied embedding."""
+    if "lm_head" in params:
+        head = params["lm_head"]
+        if isinstance(head, QTensor):
+            return QTensor(
+                q=jax.lax.slice_in_dim(head.q, off, end, axis=-1),
+                s=jax.lax.slice_in_dim(head.s, off, end, axis=-1),
+            )
+        if isinstance(head, Q4Tensor):
+            return Q4Tensor(
+                q=jax.lax.slice_in_dim(head.q, off, end, axis=-1),
+                s=jax.lax.slice_in_dim(head.s, off, end, axis=-1),
+                in_dim=head.in_dim, group=head.group,
+            )
+        return jax.lax.slice_in_dim(head, off, end, axis=-1)
+    return jax.lax.slice_in_dim(params["embed"], off, end, axis=0).T
+
+
+def fused_greedy_epilogue(
+    cfg: ModelConfig, params, h: jax.Array,
+    tile: int = EPILOGUE_VOCAB_TILE,
+) -> jax.Array:
+    """Greedy sampling fused into the logits projection: final-normed
+    hidden states ``h`` [B, T, E] → argmax token ids [B, T] int32,
+    byte-identical to ``argmax(_unembed(cfg, params, h), -1)``.
+
+    The projection runs tile-by-tile over the vocab with a running
+    (max, argmax) carry, so the [B, T, V] fp32 logits buffer — 16 MB+
+    per step at a 128K vocab, written and immediately re-read by the
+    sampler — never materializes in HBM, and the separate sampler
+    small-ops (two full-vocab sorts for the top-k/top-p masks that
+    greedy slots never use) disappear entirely. Per-element dot
+    products are unchanged (tiling splits the *output* axis, never the
+    contraction), softcap applies per tile (same monotonic values), and
+    ties resolve to the lowest index exactly like ``jnp.argmax``: the
+    in-tile argmax picks the first max and the cross-tile carry only
+    replaces on a strictly greater max."""
+    B, T, E = h.shape
+    V = cfg.vocab_size
+    x = h.reshape(B * T, E)
+    best = jnp.full((B * T,), -jnp.inf, jnp.float32)
+    idx = jnp.zeros((B * T,), jnp.int32)
+    for off in range(0, V, tile):
+        end = min(off + tile, V)
+        logits_t = qmatmul(
+            x, _head_tile(params, off, end),
+            preferred_element_type=jnp.float32,
+        )
+        if cfg.logit_softcap > 0.0:
+            logits_t = (
+                jnp.tanh(logits_t / cfg.logit_softcap) * cfg.logit_softcap
+            )
+        m = jnp.max(logits_t, axis=-1)
+        a = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
+        better = m > best
+        idx = jnp.where(better, off + a, idx)
+        best = jnp.where(better, m, best)
+    return idx.reshape(B, T)
+
+
+def _advance_keys(sampling: SamplingState) -> SamplingState:
+    """PRNG parity with ``sample_core`` for the fused epilogue: the
+    SAME key split per step (``sampling.split_step_keys``), keys
+    carried, step keys discarded (greedy slots never consume them) —
+    the sampling-state trajectory stays bit-identical to the unfused
+    path by sharing the scheme, not by copying it."""
+    _, carry_keys = split_step_keys(sampling.key)
+    return sampling._replace(key=carry_keys)
+
+
 class DecodeState(NamedTuple):
     """Per-slot generation state living on device across chunks."""
 
@@ -348,7 +441,7 @@ def _combine_stats(acc_a, m_a, l_a, acc_b, m_b, l_b):
     jax.jit,
     static_argnames=(
         "cfg", "n_steps", "use_pallas", "prefix_bound", "page_strip",
-        "kv_mesh",
+        "kv_mesh", "fused_epilogue",
     ),
     donate_argnames=("cache", "dstate", "sampling"),
 )
@@ -372,6 +465,10 @@ def decode_chunk(
                           # runs per-shard under shard_map (pool kv-heads
                           # over 'model', slots over 'data'); None = the
                           # single-chip dispatch
+    fused_epilogue: bool = False,  # static — all slots greedy + non-JSON
+                          # (the batcher checks at dispatch): sampling
+                          # fuses into a vocab-tiled projection and the
+                          # [B, V] logits never materialize
 ) -> Tuple[jax.Array, jax.Array, KVCache, DecodeState, SamplingState]:
     """Run ``n_steps`` decode steps for every slot in one dispatch.
 
@@ -518,13 +615,21 @@ def decode_chunk(
             new_rings.append((rk, rv))
 
         h = rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps, cfg.rms_offset)
-        logits = _unembed(cfg, params, h)[:, 0]           # [B, V] fp32
-
-        sampled, sampling = sample_core(
-            logits, sampling, json_remaining=budget,
-            json_token_tables=json_tables,
-            json_schema_tables=schema_tables,
-        )
+        if fused_epilogue:
+            # All-greedy non-JSON dispatch: argmax fused into the
+            # vocab-tiled projection (byte-identical to the unfused
+            # sampler for these slots — the JSON mask is the identity
+            # when no slot enables it, and greedy never reads the
+            # step key; the key split still advances for state parity).
+            sampled = fused_greedy_epilogue(cfg, params, h)[:, 0]
+            sampling = _advance_keys(sampling)
+        else:
+            logits = _unembed(cfg, params, h)[:, 0]       # [B, V] fp32
+            sampled, sampling = sample_core(
+                logits, sampling, json_remaining=budget,
+                json_token_tables=json_tables,
+                json_schema_tables=schema_tables,
+            )
         new_budget = budget - active.astype(jnp.int32)
         hit_eos = (sampling.eos_id >= 0) & (sampled == sampling.eos_id)
         ctx_full = (pos + 1) >= (S - 1)
@@ -922,7 +1027,7 @@ def _spec_block_attn(
     jax.jit,
     static_argnames=(
         "cfg", "n_steps", "draft_len", "prefix_bound", "use_pallas",
-        "draft_layers", "page_strip", "kv_mesh",
+        "draft_layers", "page_strip", "kv_mesh", "fused_epilogue",
     ),
     donate_argnames=("cache", "dstate", "sampling", "history"),
 )
@@ -948,6 +1053,10 @@ def decode_chunk_spec(
     page_strip: int = 1,     # static — pages per paged-kernel grid cell
     kv_mesh: Any = None,     # static — serving mesh for the per-shard
                              # paged kernel (see decode_chunk)
+    fused_epilogue: bool = False,  # static — all slots greedy + non-JSON:
+                             # row 0's sampler AND the verify rows fuse
+                             # into one vocab-tiled argmax (see
+                             # decode_chunk)
 ) -> Tuple[jax.Array, jax.Array, KVCache, DecodeState, SamplingState, jax.Array]:
     """Speculative fused chunk: ``n_steps`` verify-blocks of ``draft_len``
     tokens per dispatch. Same contract as ``decode_chunk`` except the
@@ -1110,28 +1219,40 @@ def decode_chunk_spec(
             new_rings.append((blk_k, blk_v))
 
         h = rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps, cfg.rms_offset)
-        logits = _unembed(cfg, params, h)                 # [B, D, V] fp32
 
         # ---- verify ---------------------------------------------------
-        # Row 0 runs the full sampler (mask + greedy/sample + key + json
-        # advance) — identical per-token semantics to the plain chunk.
-        pre_row0 = sampling
-        tok0, sampling = sample_core(
-            logits[:, 0], sampling, json_remaining=budget,
-            json_token_tables=json_tables,
-            json_schema_tables=schema_tables,
-        )
-        # Rows 1..D-1: masked greedy with coords advanced along the DRAFT
-        # path (rows only matter while drafts keep being accepted, and
-        # then draft == emitted, so the draft-path coords are the right
-        # ones). One fused mask+argmax across all verify rows — the
-        # per-row dispatch loop was the sampler small-op floor
-        # (sampling.fused_verify_rows; byte-identical per row).
-        verify = fused_verify_rows(
-            logits[:, 1:], blk[:, 1:], pre_row0, budget,
-            token_tables=json_tables, schema_tables=schema_tables,
-        )
-        emitted = jnp.concatenate([tok0[:, None], verify], axis=1)  # [B, D]
+        if fused_epilogue:
+            # All-greedy non-JSON dispatch: row 0's sampler AND every
+            # verify row reduce to argmax (the grammar mask is the
+            # identity with no JSON slot), so all D rows fuse into one
+            # vocab-tiled projection+argmax and the [B, D, V] fp32
+            # logits never land in HBM. One key split preserves the
+            # plain sampler's one-advance-per-block PRNG trajectory.
+            emitted = fused_greedy_epilogue(cfg, params, h)    # [B, D]
+            sampling = _advance_keys(sampling)
+        else:
+            logits = _unembed(cfg, params, h)             # [B, D, V] fp32
+            # Row 0 runs the full sampler (mask + greedy/sample + key +
+            # json advance) — identical per-token semantics to the
+            # plain chunk.
+            pre_row0 = sampling
+            tok0, sampling = sample_core(
+                logits[:, 0], sampling, json_remaining=budget,
+                json_token_tables=json_tables,
+                json_schema_tables=schema_tables,
+            )
+            # Rows 1..D-1: masked greedy with coords advanced along the
+            # DRAFT path (rows only matter while drafts keep being
+            # accepted, and then draft == emitted, so the draft-path
+            # coords are the right ones). One fused mask+argmax across
+            # all verify rows — the per-row dispatch loop was the
+            # sampler small-op floor (sampling.fused_verify_rows;
+            # byte-identical per row).
+            verify = fused_verify_rows(
+                logits[:, 1:], blk[:, 1:], pre_row0, budget,
+                token_tables=json_tables, schema_tables=schema_tables,
+            )
+            emitted = jnp.concatenate([tok0[:, None], verify], axis=1)
 
         # Leading-match acceptance (greedy slots only).
         match = emitted[:, : D - 1] == blk[:, 1:]         # [B, D-1]
@@ -1169,17 +1290,21 @@ def decode_chunk_spec(
         )
 
         # Json coords: row 0 already advanced inside sample_core; advance
-        # by the remaining emitted tokens.
-        for j in range(1, D):
-            stepped = _advance_json(
-                sampling, emitted[:, j], json_tables, schema_tables
-            )
-            take = emit_mask[:, j]
-            sampling = sampling._replace(
-                json_state=jnp.where(take, stepped.json_state, sampling.json_state),
-                json_stack=jnp.where(take, stepped.json_stack, sampling.json_stack),
-                json_depth=jnp.where(take, stepped.json_depth, sampling.json_depth),
-            )
+        # by the remaining emitted tokens. Skipped under the fused
+        # epilogue — with no JSON-enabled slot every advance is the
+        # identity (``_advance_json`` gates on ``json_enabled``), so the
+        # sampling-state trajectory is unchanged by construction.
+        if not fused_epilogue:
+            for j in range(1, D):
+                stepped = _advance_json(
+                    sampling, emitted[:, j], json_tables, schema_tables
+                )
+                take = emit_mask[:, j]
+                sampling = sampling._replace(
+                    json_state=jnp.where(take, stepped.json_state, sampling.json_state),
+                    json_stack=jnp.where(take, stepped.json_stack, sampling.json_stack),
+                    json_depth=jnp.where(take, stepped.json_depth, sampling.json_depth),
+                )
 
         # History: emitted token j lives at position pos + 1 + j.
         hpos = jnp.where(emit_mask, pos[:, None] + 1 + jj, S)

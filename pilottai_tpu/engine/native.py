@@ -72,6 +72,9 @@ class NativeEngine(LLMBackend):
             )
         dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
         self.model_cfg = self.model_cfg.replace(dtype=dtype)
+        # Weight quantization mode: engine_quant wins; the legacy
+        # ``quantize`` field is an alias ("int8"/"int4"); "none" = dense.
+        self.quant_mode = config.engine_quant or config.quantize or "none"
         self.mesh = None
         # Subword JSON grammar tables (built lazily at start; None = byte
         # automaton or tokenizer can't derive token bytes).
@@ -158,6 +161,12 @@ class NativeEngine(LLMBackend):
             # 16 GB chip before quantize_params could shrink it. Multi-
             # chip: init dense and shard first (per-chip shards fit), then
             # the quantize pass below shrinks the sharded leaves.
+            # int4 always quantizes FROM the dense init (no eager int8
+            # intermediate): the packed values must match across the
+            # single-chip and sharded boot paths for the byte-identity
+            # matrix (tests/test_multichip.py) — a random-init 8B that
+            # cannot hold the dense tree on one chip should load a
+            # checkpoint or serve int8.
             single = len(devices) == 1
             if single:
                 # Eager init ops follow the DEFAULT backend, which is not
@@ -167,7 +176,7 @@ class NativeEngine(LLMBackend):
                 with jax.default_device(devices[0]):
                     params = init_params(
                         self.model_cfg, jax.random.PRNGKey(self.config.seed),
-                        quantize=(self.config.quantize == "int8"),
+                        quantize=(self.quant_mode == "int8"),
                     )
                 # Commit (default_device arrays are uncommitted and jit
                 # would migrate them back to the default backend).
@@ -179,21 +188,26 @@ class NativeEngine(LLMBackend):
                 params = shard_params(
                     params, param_logical_axes(self.model_cfg), self.mesh
                 )
-        if self.config.quantize == "int8":
+        if self.quant_mode in ("int8", "int4"):
             from pilottai_tpu.models.quant import quantize_params
 
-            # Weight-only int8 on device: halves the decode weight stream
-            # AND the params' HBM footprint (already-quantized leaves from
-            # the init path pass through untouched; donation keeps the 8B
-            # tree from being double-resident).
+            # Weight-only quantization on device: shrinks the decode
+            # weight stream AND the params' HBM footprint (already-
+            # quantized leaves from the init path pass through untouched;
+            # donation keeps the 8B tree from being double-resident).
+            # int4 packs two nibbles per byte with per-group scales and
+            # falls sensitive leaves back (lm_head → int8, router →
+            # dense); see models/quant.py.
             params = quantize_params(
-                params, dtype=self.model_cfg.dtype, donate=True
+                params, dtype=self.model_cfg.dtype, donate=True,
+                bits=4 if self.quant_mode == "int4" else 8,
+                group=self.config.engine_quant_group,
             )
-            self._log.info("quantized matmul weights to int8 (weight-only)")
-        elif self.config.quantize:
-            raise ValueError(
-                f"unknown quantize mode {self.config.quantize!r}; "
-                "supported: 'int8'"
+            self._log.info(
+                "quantized matmul weights to %s (weight-only%s)",
+                self.quant_mode,
+                f", group {self.config.engine_quant_group}"
+                if self.quant_mode == "int4" else "",
             )
         # Subword vocab → precompute the token→byte product tables so
         # json_mode works for real checkpoints' tokenizers, not just the
@@ -258,6 +272,11 @@ class NativeEngine(LLMBackend):
             priority_aging_s=self.config.engine_priority_aging_s,
             prefix_min_len=self.config.engine_prefix_min_len,
             kv_quantize=self.config.engine_kv_quantize == "int8",
+            # Weight quantization bookkeeping + the fused greedy
+            # epilogue knob (ISSUE 14).
+            weight_quant=self.quant_mode,
+            quant_group=self.config.engine_quant_group,
+            fused_epilogue=self.config.engine_fused_epilogue,
             draft_layers=self.config.engine_draft_layers,
             pipeline_depth=self.config.engine_pipeline,
             overlap_admission=self.config.engine_overlap_admission,

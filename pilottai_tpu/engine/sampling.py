@@ -236,6 +236,17 @@ def fused_verify_rows(
     return jnp.argmax(masked, axis=-1).astype(jnp.int32).reshape(B, Dm1)
 
 
+def split_step_keys(keys: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """One sampling step's PRNG advance: per-slot ``(step_keys,
+    carry_keys)`` from ``[B, 2]`` keys. THE key-split scheme — shared by
+    ``sample_core`` and the fused greedy epilogue
+    (engine/decode.py:_advance_keys), whose bit-identity contract
+    requires both paths to advance keys identically; change it here or
+    nowhere."""
+    new_keys = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+    return new_keys[:, 0], new_keys[:, 1]
+
+
 def sample_core(
     logits: jax.Array,  # [B, V] fp32
     state: SamplingState,
@@ -260,8 +271,7 @@ def sample_core(
     def sample_row(key, row):
         return jax.random.categorical(key, row)
 
-    new_keys = jax.vmap(lambda k: jax.random.split(k, 2))(state.key)
-    step_keys, carry_keys = new_keys[:, 0], new_keys[:, 1]
+    step_keys, carry_keys = split_step_keys(state.key)
     sampled = jax.vmap(sample_row)(step_keys, scaled)
 
     tokens = jnp.where(state.temperature <= 0.0, greedy, sampled).astype(

@@ -22,7 +22,7 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
-from pilottai_tpu.models.quant import dequant
+from pilottai_tpu.models.qmatmul import qmatmul
 
 from pilottai_tpu.parallel.sharding import with_logical_constraint
 
@@ -57,11 +57,13 @@ def moe_mlp(
     aux_loss = X * jnp.sum(frac_routed * mean_prob)
 
     # All experts, all tokens; expert axis sharded -> each device computes
-    # its local experts only.
-    gate = activation(jnp.einsum("bte,xef->btxf", x, dequant(p["wg"])))
-    up = jnp.einsum("bte,xef->btxf", x, dequant(p["wu"]))
+    # its local experts only. Expert matmuls go through the qmatmul
+    # dispatch point with their einsum specs — the batched expert axis
+    # keeps them on the fused-dequant arm for now (models/qmatmul.py).
+    gate = activation(qmatmul(x, p["wg"], spec="bte,xef->btxf"))
+    up = qmatmul(x, p["wu"], spec="bte,xef->btxf")
     h = gate * up
     h = with_logical_constraint(h, ("batch", "seq", "expert", None))
-    y = jnp.einsum("btxf,xfe->btxe", h, dequant(p["wd"]))              # [B, T, X, E]
+    y = qmatmul(h, p["wd"], spec="btxf,xfe->btxe")              # [B, T, X, E]
     out = jnp.einsum("btxe,btx->bte", y, combine.astype(y.dtype))
     return out, aux_loss

@@ -1,24 +1,41 @@
-"""Weight-only int8 quantization for serving.
+"""Weight-only quantization for serving: int8 and packed int4.
 
 Decode is HBM-bound on the weight stream (the whole model is read every
-token); storing matmul weights as int8 with per-output-channel bf16
-scales halves that traffic. XLA fuses the in-jit dequant
+token); storing matmul weights below compute precision shrinks that
+traffic proportionally. XLA fuses the in-jit dequant
 (``q.astype(bf16) * s``) into the matmul's operand read — measured on
-v5e: 26 µs vs 47 µs per [2048, 8192] layer matmul (647 GB/s effective on
-half the bytes), a 1.8× step-time win with zero custom kernels.
+v5e for int8: 26 µs vs 47 µs per [2048, 8192] layer matmul (647 GB/s
+effective on half the bytes), a 1.8× step-time win with zero custom
+kernels. int4 (ISSUE 14) halves the stream again: two nibbles per int8
+byte along the contraction axis, unpacked with two shifts in-jit so the
+HBM read stays the packed buffer.
 
-Scheme: symmetric per-output-channel over the contraction axis
-(``axis=-2`` of the stacked ``[L, in, out]`` layer weights), the standard
-weight-only recipe (~negligible quality delta at 8 bits). Norms, embeds
-and rope tables stay in the compute dtype — they are <1% of bytes.
+Schemes:
+
+* **int8** (``QTensor``): symmetric per-output-channel over the
+  contraction axis (``axis=-2`` of the stacked ``[L, in, out]`` layer
+  weights), the standard weight-only recipe (~negligible quality delta
+  at 8 bits).
+* **int4** (``Q4Tensor``): symmetric per-**group** scales over the
+  contraction axis (``engine_quant_group`` rows per scale, default
+  128) — at 4 bits a single whole-column scale visibly hurts quality;
+  group scales bound the error to the group's own dynamic range at a
+  cost of ``4/group`` extra bits per weight. Quantization-sensitive
+  leaves fall back: ``lm_head`` stays int8 (logit argmax decides the
+  token) and the MoE router stays dense (its logits pick *which*
+  experts run).
+
+Norms, embeds and rope tables stay in the compute dtype — they are <1%
+of bytes.
 
 Serving-only: the trainer keeps full-precision weights; the engine
-quantizes once at load (``NativeEngine.start``), which also halves the
+quantizes once at load (``NativeEngine.start``), which also shrinks the
 params' HBM footprint.
 
 No reference counterpart (the reference computes no attention at all —
 SURVEY.md §2.13); this is TPU-first engineering for the ≤500 ms p50
-agent-step target (BASELINE.md).
+agent-step target (BASELINE.md) and the ≥0.15 MFU 8B decode target
+(ROADMAP item 3).
 """
 
 from __future__ import annotations
@@ -39,61 +56,247 @@ class QTensor(NamedTuple):
     s: jax.Array  # compute dtype, shape [..., 1, out]
 
 
+@jax.tree_util.register_pytree_node_class
+class Q4Tensor:
+    """Packed int4 weight: two nibbles per int8 byte along the
+    contraction axis (``axis=-2``), per-group scales.
+
+    ``q`` is int8 ``[..., ceil(in/2), out]`` — byte ``b`` holds the
+    nibble for row ``2b`` in its low bits and row ``2b+1`` in its high
+    bits (an odd trailing row pads with a zero nibble). ``s`` is the
+    compute-dtype scale ``[..., n_groups, out]`` with
+    ``n_groups = ceil(in/group)``. The true contraction length and the
+    group width ride as static pytree aux data, so stacked-layer
+    slicing and ``lax.scan`` carry the tensor exactly like ``QTensor``
+    (aux is layer-invariant — slicing the leading layer axis never
+    changes the contraction length)."""
+
+    def __init__(self, q: jax.Array, s: jax.Array, in_dim: int, group: int):
+        self.q = q
+        self.s = s
+        self.in_dim = int(in_dim)
+        self.group = int(group)
+
+    def tree_flatten(self):
+        return (self.q, self.s), (self.in_dim, self.group)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], aux[1])
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"Q4Tensor(q={getattr(self.q, 'shape', None)}, "
+            f"s={getattr(self.s, 'shape', None)}, in_dim={self.in_dim}, "
+            f"group={self.group})"
+        )
+
+
+def pack_int4(q8: jax.Array) -> jax.Array:
+    """Pack int8 values in [-8, 7] into nibbles along ``axis=-2``:
+    ``[..., in, out]`` → ``[..., ceil(in/2), out]``. Row ``2b`` lands in
+    the byte's low nibble, ``2b+1`` in the high nibble; an odd trailing
+    row is padded with zero. All arithmetic stays in int8 (left shifts
+    wrap, which is exactly two's-complement nibble packing)."""
+    if q8.shape[-2] % 2:
+        pad = [(0, 0)] * q8.ndim
+        pad[-2] = (0, 1)
+        q8 = jnp.pad(q8, pad)
+    lo = q8[..., 0::2, :]
+    hi = q8[..., 1::2, :]
+    return ((hi << 4) | (lo & 0xF)).astype(jnp.int8)
+
+
+def unpack_int4(packed: jax.Array, in_dim: int) -> jax.Array:
+    """Inverse of ``pack_int4``: int8 nibbles back to int8 values in
+    [-8, 7], trimmed to the true contraction length. Sign recovery is
+    two arithmetic shifts (``<<4 >>4`` for the low nibble, ``>>4`` for
+    the high one) — in-jit these fuse into the consumer, so the HBM
+    read of a packed weight stays the packed buffer."""
+    lo = ((packed << 4) >> 4).astype(jnp.int8)
+    hi = (packed >> 4).astype(jnp.int8)
+    both = jnp.stack([lo, hi], axis=-2)            # [..., P, 2, out]
+    shape = packed.shape[:-2] + (2 * packed.shape[-2], packed.shape[-1])
+    return both.reshape(shape)[..., :in_dim, :]
+
+
 def dequant(w: Any) -> jax.Array:
-    """QTensor -> dense weight in the scale's dtype; pass-through for
-    plain arrays. Call at the matmul site — inside jit XLA fuses the
-    convert+mul into the operand read, so no dense copy lands in HBM."""
+    """QTensor/Q4Tensor -> dense weight in the scale's dtype;
+    pass-through for plain arrays. Call at the matmul site — inside jit
+    XLA fuses the convert+mul (and the int4 nibble shifts) into the
+    operand read, so no dense copy lands in HBM."""
     if isinstance(w, QTensor):
         return w.q.astype(w.s.dtype) * w.s
+    if isinstance(w, Q4Tensor):
+        q = unpack_int4(w.q, w.in_dim)
+        # Per-group scales broadcast back over the contraction axis
+        # (the last group may be a remainder — trim after the repeat).
+        s = jnp.repeat(w.s, w.group, axis=-2)[..., : w.in_dim, :]
+        return q.astype(w.s.dtype) * s
     return w
 
 
-def quantize_array(w: jax.Array, dtype=jnp.bfloat16) -> QTensor:
-    """Symmetric per-output-channel int8 over the contraction axis
-    (axis=-2). ``w`` is [..., in, out]."""
-    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True)
-    scale = jnp.maximum(amax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
-    return QTensor(q=q.astype(jnp.int8), s=scale.astype(dtype))
+def quantize_array(
+    w: jax.Array, dtype=jnp.bfloat16, bits: int = 8, group: int = 128
+) -> Any:
+    """Symmetric weight-only quantization over the contraction axis
+    (axis=-2). ``w`` is [..., in, out].
+
+    * ``bits=8``: per-output-channel scales → ``QTensor``.
+    * ``bits=4``: per-(group × output-channel) scales → packed
+      ``Q4Tensor``. Groups of ``group`` contraction rows share one
+      scale; a non-dividing trailing group is simply smaller (its amax
+      runs over the real rows only — zero padding never inflates it).
+    """
+    if bits == 8:
+        amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True)
+        scale = jnp.maximum(amax, 1e-8) / 127.0
+        q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+        return QTensor(q=q.astype(jnp.int8), s=scale.astype(dtype))
+    if bits != 4:
+        raise ValueError(f"unsupported weight quantization bits={bits}")
+    group = max(1, int(group))
+    in_dim = w.shape[-2]
+    n_groups = -(-in_dim // group)
+    wf = w.astype(jnp.float32)
+    if n_groups * group != in_dim:
+        pad = [(0, 0)] * wf.ndim
+        pad[-2] = (0, n_groups * group - in_dim)
+        wf = jnp.pad(wf, pad)
+    grouped = wf.reshape(wf.shape[:-2] + (n_groups, group, wf.shape[-1]))
+    amax = jnp.max(jnp.abs(grouped), axis=-2, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 7.0          # [..., G, 1, out]
+    q = jnp.clip(jnp.round(grouped / scale), -8, 7).astype(jnp.int8)
+    q = q.reshape(wf.shape[:-2] + (n_groups * group, wf.shape[-1]))
+    q = q[..., :in_dim, :]
+    return Q4Tensor(
+        q=pack_int4(q), s=scale[..., 0, :].astype(dtype),
+        in_dim=in_dim, group=group,
+    )
 
 
-def quantize_params(params: Any, dtype=jnp.bfloat16, donate: bool = False) -> Any:
+def _is_quantized(x: Any) -> bool:
+    return isinstance(x, (QTensor, Q4Tensor))
+
+
+def quantize_params(
+    params: Any,
+    dtype=jnp.bfloat16,
+    donate: bool = False,
+    bits: int = 8,
+    group: int = 128,
+) -> Any:
     """Quantize every stacked matmul weight (ndim >= 3 under ``layers``,
     plus an untied ``lm_head``). Embeds/norms stay dense. Runs under jit
-    so the int8 tensors are produced on device and the full-precision
-    originals can be freed.
+    so the quantized tensors are produced on device and the
+    full-precision originals can be freed.
+
+    ``bits=4`` packs layer matmuls as ``Q4Tensor`` with the
+    quantization-sensitive fallbacks: ``lm_head`` stays **int8** (its
+    argmax picks the emitted token — the one matmul where 4-bit noise
+    changes outputs rather than just values, and it is a small share of
+    the per-token bytes) and the MoE router stays **dense** for the
+    same selection-sensitivity reason int8 already left it dense.
+    Already-int8 ``QTensor`` leaves (the eager-init / checkpoint path)
+    re-quantize from their dequantized values — deterministic, and the
+    dequant fuses into the group-amax/round consumers so the dense fp32
+    stack never materializes whole.
 
     ``donate=True`` consumes the input tree: untouched leaves (norms,
-    embeds, already-quantized QTensors) alias through instead of being
+    embeds, already-quantized tensors) alias through instead of being
     copied — without this the pass-through copy of an 8B tree doubles
     HBM and OOMs a v5e. The caller's reference becomes invalid."""
 
     from jax.tree_util import tree_map_with_path
 
     def _quant_leaf(path, a):
-        if isinstance(a, QTensor):  # already quantized (init-time path)
-            return a
         keys = {getattr(k, "key", None) for k in path}
-        # Norm scales are 2D-stacked (skip by ndim); the MoE router stays
-        # dense — its logits drive top-k expert selection, the one matmul
-        # where 8-bit error changes *which* weights run, not just their
-        # values. It is also a tiny fraction of the bytes.
-        if "router" in keys or a.ndim < 3:
+        if bits == 8:
+            if _is_quantized(a):   # already quantized (init-time path)
+                return a
+            # Norm scales are 2D-stacked (skip by ndim); the MoE router
+            # stays dense — its logits drive top-k expert selection, the
+            # one matmul where 8-bit error changes *which* weights run,
+            # not just their values. It is also a tiny fraction of the
+            # bytes.
+            if "router" in keys or a.ndim < 3:
+                return a
+            return quantize_array(a, dtype)
+        # bits == 4
+        if isinstance(a, Q4Tensor):
             return a
-        return quantize_array(a, dtype)
+        if "router" in keys:
+            return dequant(a) if isinstance(a, QTensor) else a
+        if isinstance(a, QTensor):
+            return quantize_array(dequant(a), dtype, bits=4, group=group)
+        if a.ndim < 3:
+            return a
+        return quantize_array(a, dtype, bits=4, group=group)
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def _quant(p):
         out = dict(p)
         out["layers"] = tree_map_with_path(
-            _quant_leaf, p["layers"],
-            is_leaf=lambda x: isinstance(x, QTensor),
+            _quant_leaf, p["layers"], is_leaf=_is_quantized,
         )
-        if "lm_head" in p and not isinstance(p["lm_head"], QTensor):
-            out["lm_head"] = quantize_array(p["lm_head"], dtype)
+        if "lm_head" in p:
+            head = p["lm_head"]
+            # int4 fallback: the head quantizes to int8 in BOTH modes.
+            if isinstance(head, Q4Tensor):
+                head = quantize_array(dequant(head), dtype)
+            elif isinstance(head, QTensor):
+                pass
+            else:
+                head = quantize_array(head, dtype)
+            out["lm_head"] = head
         return out
 
     return _quant(params)
 
 
-__all__ = ["QTensor", "dequant", "quantize_array", "quantize_params"]
+def weight_stream_bytes(params: Any) -> dict:
+    """Measured byte accounting for the decode weight stream (the
+    ``engine.weight_bytes*`` gauges — ISSUE 14 makes the bytes-halved
+    claim a measured series, not a docstring).
+
+    * ``total``: resident bytes of the whole parameter tree (global
+      logical bytes — divide by the TP shard count for per-chip).
+    * ``per_token``: bytes streamed from HBM per decode token — every
+      layer weight, the final norm, and the unembedding head (the tied
+      ``embed`` matrix streams whole through the logits projection;
+      an untied head counts ``lm_head`` and the embed table drops out,
+      as decode's embedding lookup gathers a single row).
+
+    MoE note: this repo's MoE uses dense dispatch (every expert
+    computes every token — models/moe.py), so *all* expert bytes
+    stream per token and are counted as such.
+    """
+    def _tree_bytes(tree) -> int:
+        total = 0
+        for leaf in jax.tree.leaves(tree, is_leaf=_is_quantized):
+            if _is_quantized(leaf):
+                total += int(leaf.q.size)  # int8 storage, 1 byte each
+                total += int(leaf.s.size) * jnp.dtype(leaf.s.dtype).itemsize
+            else:
+                total += int(leaf.size) * jnp.dtype(leaf.dtype).itemsize
+        return total
+
+    total = _tree_bytes(params)
+    per_token = _tree_bytes(
+        {k: v for k, v in params.items() if k != "embed"}
+    )
+    if "lm_head" not in params and "embed" in params:
+        per_token += _tree_bytes(params["embed"])
+    return {"total": int(total), "per_token": int(per_token)}
+
+
+__all__ = [
+    "QTensor",
+    "Q4Tensor",
+    "dequant",
+    "pack_int4",
+    "unpack_int4",
+    "quantize_array",
+    "quantize_params",
+    "weight_stream_bytes",
+]

@@ -29,7 +29,7 @@ from pilottai_tpu.ops.attention import (
     flash_shapes_ok,
 )
 from pilottai_tpu.ops.pallas.flash_attention import flash_sharding_ok
-from pilottai_tpu.models.quant import dequant
+from pilottai_tpu.models.qmatmul import qmatmul
 from pilottai_tpu.ops.kvcache import KVCache
 from pilottai_tpu.parallel.sharding import with_logical_constraint
 
@@ -53,9 +53,9 @@ def _mlp(
 
         return moe_mlp(cfg, lp["moe"], x, lambda h: _activation(cfg, h))
     p = lp["mlp"]
-    gate = _activation(cfg, x @ dequant(p["wg"]))
-    up = x @ dequant(p["wu"])
-    return (gate * up) @ dequant(p["wd"]), jnp.zeros((), jnp.float32)
+    gate = _activation(cfg, qmatmul(x, p["wg"]))
+    up = qmatmul(x, p["wu"])
+    return qmatmul(gate * up, p["wd"]), jnp.zeros((), jnp.float32)
 
 
 def _qkv(
@@ -66,9 +66,9 @@ def _qkv(
     cos: jax.Array,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     B, T, _ = x.shape
-    q = (x @ dequant(p["wq"])).reshape(B, T, cfg.n_heads, cfg.head_dim)
-    k = (x @ dequant(p["wk"])).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
-    v = (x @ dequant(p["wv"])).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    q = qmatmul(x, p["wq"]).reshape(B, T, cfg.n_heads, cfg.head_dim)
+    k = qmatmul(x, p["wk"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    v = qmatmul(x, p["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
     q = apply_rope(q, sin, cos)
     k = apply_rope(k, sin, cos)
     return q, k, v
@@ -76,7 +76,7 @@ def _qkv(
 
 def _attn_out(cfg: ModelConfig, p: Dict[str, Any], attn: jax.Array) -> jax.Array:
     B, T = attn.shape[:2]
-    return attn.reshape(B, T, cfg.q_dim) @ dequant(p["wo"])
+    return qmatmul(attn.reshape(B, T, cfg.q_dim), p["wo"])
 
 
 def _embed(cfg: ModelConfig, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
@@ -87,14 +87,10 @@ def _embed(cfg: ModelConfig, params: Dict[str, Any], tokens: jax.Array) -> jax.A
 
 
 def _unembed(cfg: ModelConfig, params: Dict[str, Any], x: jax.Array) -> jax.Array:
-    head = (
-        dequant(params["lm_head"])
-        if "lm_head" in params
-        else params["embed"].T
-    )
-    logits = jnp.einsum(
-        "...e,ev->...v", x, head, preferred_element_type=jnp.float32
-    )
+    head = params["lm_head"] if "lm_head" in params else params["embed"].T
+    # No spec: the logits projection is the plain last-axis contraction,
+    # so a quantized head keeps the native integer-operand lowering.
+    logits = qmatmul(x, head, preferred_element_type=jnp.float32)
     if cfg.logit_softcap > 0.0:
         logits = jnp.tanh(logits / cfg.logit_softcap) * cfg.logit_softcap
     return logits

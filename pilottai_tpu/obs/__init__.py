@@ -75,6 +75,14 @@ global_flight.add_finish_listener(global_dag.observe_flight)
 from pilottai_tpu.utils.metrics import global_metrics as _gm
 
 _gm.declare("engine.queue_depth", "gauge")
+# Decode weight stream (ISSUE 14): resident weight bytes and the bytes
+# streamed from HBM per decode token, set at engine start from the
+# quantized parameter tree (models/quant.py:weight_stream_bytes) — the
+# QUANT bench section reads these so "int4 halves the stream" is a
+# measured series. Global logical bytes; divide by the TP shard count
+# for per-chip.
+_gm.declare("engine.weight_bytes", "gauge")
+_gm.declare("engine.weight_bytes_per_token", "gauge")
 # Engine fault domain (reliability/{watchdog,degrade}.py + batcher):
 # declared at boot so dashboards and the health surface can alert on
 # zero-valued gauges before the first fault ever happens.

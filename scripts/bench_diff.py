@@ -14,10 +14,12 @@ It walks the top level, every ``models.<section>`` block, every
 ``SLO.classes.<class>`` / ``CELL.classes.<class>`` block and the
 ``RECOVERY``, ``KVCACHE``, ``CELL``, ``SCHED`` (scheduler-on /
 scheduler-off sub-blocks; straggler_frac and — in this section only —
-critical_path_frac are down-good) and ``MULTICHIP`` (per-chip steps/s,
+critical_path_frac are down-good), ``MULTICHIP`` (per-chip steps/s,
 MFU and per_chip_efficiency up-good; ``collective_frac*`` /
 ``collective_ms*`` down-good; the single-device reference under
-``multichip.single``) blocks, compares numeric
+``multichip.single``) and ``QUANT`` (per-quant-mode sub-blocks:
+steps/s and MFU up-good, ``weight_bytes*`` / the bytes-per-token
+ratio down-good) blocks, compares numeric
 metrics whose direction it knows (steps/s, MFU, attainment, busy_frac,
 recovered_frac, prefix_hit_rate, affinity_hit_rate,
 prefill_tokens_saved up = good; p50/p99, host_gap, burn_rate,
@@ -69,6 +71,11 @@ LOWER_BETTER = (
     # collective_frac, collective_frac_model/.data and — via "_ms" —
     # collective_ms_per_step; must precede any up-good "frac" rule).
     "collective",
+    # QUANT section (ISSUE 14): the decode weight stream is the cost —
+    # matches weight_bytes, weight_bytes_per_token and the
+    # bytes_per_token_int4_vs_int8 / quant_bytes_per_token_ratio
+    # headlines.
+    "weight_bytes", "bytes_per_token",
 )
 
 
@@ -147,7 +154,7 @@ def _from_tail(tail: str) -> Dict[str, Any]:
     doc: Dict[str, Any] = {}
     remainder = tail
     for block in ("models", "SLO", "phases", "KVCACHE", "CELL", "SCHED",
-                  "MULTICHIP"):
+                  "MULTICHIP", "QUANT"):
         marker = f'"{block}": '
         at = remainder.find(marker)
         if at < 0:
@@ -194,7 +201,7 @@ def _sections(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     out: Dict[str, Dict[str, Any]] = {"top": {}}
     for key, value in doc.items():
         if key in ("models", "SLO", "phases", "RECOVERY", "KVCACHE",
-                   "CELL", "SCHED", "MULTICHIP"):
+                   "CELL", "SCHED", "MULTICHIP", "QUANT"):
             continue
         num = _numeric(value)
         if num is not None:
@@ -256,6 +263,21 @@ def _sections(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
                 k: n for k, v in single.items()
                 if (n := _numeric(v)) is not None
             }
+    quant = doc.get("QUANT")
+    if isinstance(quant, dict):
+        # Section-root scalars (the bytes ratio, the quant group echo is
+        # skipped by direction) plus one sub-block per quantization mode
+        # with steps/s, MFU and the measured weight-stream bytes.
+        out["quant"] = {
+            k: n for k, v in quant.items()
+            if (n := _numeric(v)) is not None
+        }
+        for mode, block in (quant.get("modes") or {}).items():
+            if isinstance(block, dict):
+                out[f"quant.{mode}"] = {
+                    k: n for k, v in block.items()
+                    if (n := _numeric(v)) is not None
+                }
     for name, block in (doc.get("models") or {}).items():
         if isinstance(block, dict):
             out[f"models.{name}"] = {

@@ -438,7 +438,7 @@ PROMPTS = [
 
 
 async def _generate_all(mesh_shape, *, paged, speculate, kv_int8,
-                        max_new=8):
+                        max_new=8, weight_quant=None):
     import asyncio
 
     from pilottai_tpu.core.config import LLMConfig
@@ -456,6 +456,7 @@ async def _generate_all(mesh_shape, *, paged, speculate, kv_int8,
         engine_paged_kv=paged,
         engine_page_size=16,
         engine_kv_quantize="int8" if kv_int8 else None,
+        engine_quant=weight_quant,
         dtype="float32",  # greedy argmax parity across shardings
     )
     handler = LLMHandler(cfg)
@@ -503,6 +504,27 @@ async def test_sharded_greedy_byte_identity(paged, speculate, kv_int8):
     )
     assert meshed == single
     assert any(s for s in single)  # non-vacuous
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("paged", [False, True], ids=["dense", "paged"])
+@pytest.mark.asyncio
+async def test_sharded_int4_greedy_byte_identity(paged):
+    """ISSUE 14: packed int4 weights compose with the sharded mesh path
+    — Q4Tensor leaves shard like QTensor (q + group scales placed by
+    the same logical axes) and greedy output on {'model':2,'data':2}
+    stays byte-identical to the single-device int4 engine. Both boot
+    paths quantize FROM the dense init, so the packed values match by
+    construction (engine/native.py)."""
+    single = await _generate_all(
+        {"data": 1}, paged=paged, speculate=4, kv_int8=False,
+        weight_quant="int4",
+    )
+    meshed = await _generate_all(
+        MESH, paged=paged, speculate=4, kv_int8=False, weight_quant="int4",
+    )
+    assert meshed == single
+    assert any(s for s in single)
 
 
 # --------------------------------------------------------------------- #
