@@ -1410,6 +1410,41 @@ def _multichip_subprocess(timeout_s: float = 2400.0):
     raise RuntimeError("multichip subprocess produced no JSON")
 
 
+def _chaos_subprocess(timeout_s: float = 900.0, seed: int = 16):
+    """Run the CHAOS section (scripts/chaos_soak.py) in a child process
+    with a forced 8-device host platform — the soak's survivor-ladder
+    meshes need a virtual multichip topology, which is fixed at jax's
+    first import (same constraint as _multichip_subprocess). A
+    violation exit still yields the summary: the section records the
+    red soak instead of erasing it."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts",
+        "chaos_soak.py",
+    )
+    proc = subprocess.run(
+        [sys.executable, script, "--seed", str(seed),
+         "--budget-s", str(timeout_s * 0.8)],
+        capture_output=True, text=True, timeout=timeout_s, env=env,
+    )
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(
+        f"chaos subprocess rc={proc.returncode} produced no JSON: "
+        f"{(proc.stderr or '')[-400:]}"
+    )
+
+
 async def run_multichip_cli():
     """``python bench.py --multichip``: the MULTICHIP section alone,
     one JSON line on stdout (the parent bench embeds it; the committed
@@ -1871,6 +1906,23 @@ async def run_bench():
         _note("quant FAILED", {"error": str(exc)})
         sec_quant = {"quant_error": str(exc)}
 
+    # Section 13: CHAOS (ISSUE 16) — the cross-subsystem chaos soak
+    # (scripts/chaos_soak.py): a seeded randomized fault schedule
+    # (shard loss + KV corruption + step/prefill faults + latency
+    # blips) against a 2-replica serving cell on survivor-ladder
+    # meshes. Like MULTICHIP on CPU it needs 8 virtual devices, so it
+    # always runs as a fresh subprocess. Invariant headlines:
+    # recovered_frac, byte_identity_ok, corruptions detected vs
+    # injected, stuck_flights.
+    sec_chaos = None
+    try:
+        loop = asyncio.get_running_loop()
+        sec_chaos = await loop.run_in_executor(None, _chaos_subprocess)
+        _note("chaos", sec_chaos)
+    except Exception as exc:  # noqa: BLE001 — keep earlier sections
+        _note("chaos FAILED", {"error": str(exc)})
+        sec_chaos = {"chaos_error": str(exc)}
+
     headline = sec_8b or sec_1b
     out = {
         "metric": "agent_steps_per_sec_per_chip",
@@ -1984,6 +2036,17 @@ async def run_bench():
             if sec_quant else None
         ),
         "QUANT": sec_quant,
+        # Chaos-soak headlines (ISSUE 16): every request survived the
+        # fault schedule, every probe wave stayed byte-identical, and
+        # every injected corruption was detected (full schedule +
+        # invariant breakdown under CHAOS).
+        "chaos_recovered_frac": (
+            sec_chaos.get("recovered_frac") if sec_chaos else None
+        ),
+        "chaos_byte_identity_ok": (
+            sec_chaos.get("byte_identity_ok") if sec_chaos else None
+        ),
+        "CHAOS": sec_chaos,
         **sec_pipeline,
         **(sec_swarm or {}),
         # Orchestrator-path phase percentiles: traffic since the last

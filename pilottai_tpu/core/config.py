@@ -154,6 +154,15 @@ class LLMConfig(BaseModel):
 
     # Engine placement / serving shape
     mesh_shape: Optional[Dict[str, int]] = None  # e.g. {"data": 1, "model": 8}
+    # Degraded-mesh ladder (parallel/meshplan.py): the ordered list of
+    # mesh plans the engine may re-plan onto when a shard is lost
+    # mid-serving. "auto" derives a halving ladder from the boot plan
+    # (parallel axes halve first, model last, down to single-chip);
+    # "off" disables shard-loss re-planning (a lost device fails over
+    # PR 8's generic recovery path instead); an explicit list of plan
+    # dicts (e.g. [{"model": 4, "data": 2}, {"model": 4}, {"model": 2}])
+    # pins the rungs — every rung must fit the boot device set.
+    engine_mesh_ladder: Any = "auto"
     dtype: str = "bfloat16"
     # Weight-only quantization for serving — legacy spelling, kept as an
     # alias for ``engine_quant`` ("int8"/"int4" or None). Shrinks the
@@ -188,6 +197,34 @@ class LLMConfig(BaseModel):
                 "engine_quant must be 'none', 'int8' or 'int4'"
             )
         return v
+
+    @field_validator("engine_mesh_ladder")
+    @classmethod
+    def _valid_mesh_ladder(cls, v: Any) -> Any:
+        if isinstance(v, str):
+            if v not in ("auto", "off"):
+                raise ValueError(
+                    "engine_mesh_ladder must be 'auto', 'off' or a "
+                    "list of mesh-plan dicts"
+                )
+            return v
+        if isinstance(v, (list, tuple)):
+            for plan in v:
+                if not isinstance(plan, dict) or not all(
+                    isinstance(a, str)
+                    and isinstance(n, int) and n >= 1
+                    for a, n in plan.items()
+                ):
+                    raise ValueError(
+                        "engine_mesh_ladder rungs must be dicts of "
+                        "axis name -> positive int, e.g. "
+                        "[{'model': 4, 'data': 2}, {'model': 2}]"
+                    )
+            return list(v)
+        raise ValueError(
+            "engine_mesh_ladder must be 'auto', 'off' or a list of "
+            "mesh-plan dicts"
+        )
     # int4 scale-group width over the contraction axis (rows per shared
     # scale). Smaller groups bound quantization error tighter at
     # 4/group extra bits per weight; 128 is the standard trade. Also

@@ -63,6 +63,7 @@ from pilottai_tpu.distributed.router import (
     route_key,
 )
 from pilottai_tpu.engine.handler import LLMHandler
+from pilottai_tpu.engine.kvcache.integrity import KV_FRAME_VERSION
 from pilottai_tpu.obs import DEFAULT_CLASS, SLOTracker
 from pilottai_tpu.reliability import (
     CircuitOpenError,
@@ -70,6 +71,7 @@ from pilottai_tpu.reliability import (
     EngineOverloaded,
     global_engine_health,
 )
+from pilottai_tpu.reliability.inject import global_injector
 from pilottai_tpu.utils.logging import get_logger
 from pilottai_tpu.utils.metrics import MetricsRegistry, global_metrics
 
@@ -142,6 +144,7 @@ class CellReplica:
             queue_depth=depth,
             queue_frac=queue_frac,
             degrade_level=int(sig.get("degrade_level", 0)),
+            mesh_rung=int(sig.get("mesh_rung", 0)),
             burn_rate=burn,
             healthy=healthy,
             breaker_open=breaker_open,
@@ -289,6 +292,13 @@ class ServingCell:
         global_metrics.set_gauge(
             "cell.replicas_routable",
             float(sum(s.routable() for s in sigs)),
+        )
+        # Replicas serving on a degraded mesh rung (shard loss survived
+        # via re-plan): still routable, but the router down-scores them
+        # and rebalance_degraded migrates sessions off.
+        global_metrics.set_gauge(
+            "cell.degraded_replicas",
+            float(sum(s.mesh_rung > 0 for s in sigs)),
         )
         global_metrics.set_gauge("cell.sessions", float(len(self.sessions)))
         lookups = global_metrics.get("cell.affinity_lookups")
@@ -506,8 +516,11 @@ class ServingCell:
     # ------------------------------------------------------------------ #
 
     def _pick_target(self, exclude: Sequence[str]) -> str:
-        """Migration target: the least-loaded ROUTABLE sibling. This is
-        a control-plane move, not an admission — class shed thresholds
+        """Migration target: the least-loaded ROUTABLE sibling, full-
+        mesh replicas before degraded ones (a replica surviving shard
+        loss on a sub-mesh rung is a worse home for a session than an
+        intact sibling, whatever its queue says). This is a
+        control-plane move, not an admission — class shed thresholds
         don't apply (a saturated-but-healthy sibling still accepts a
         session's KV; it just serves the next turn slower)."""
         excluded = set(exclude)
@@ -520,7 +533,8 @@ class ServingCell:
                 "no routable replica to migrate the session to"
             )
         return min(
-            candidates, key=lambda s: (s.queue_frac, s.replica_id)
+            candidates,
+            key=lambda s: (s.mesh_rung > 0, s.queue_frac, s.replica_id),
         ).replica_id
 
     async def migrate_session(
@@ -548,6 +562,33 @@ class ServingCell:
             export = await loop.run_in_executor(None, exporter, session_id)
         accepted = 0
         tokens = 0
+        rejected = 0
+        n_entries = len(export["entries"]) if export else 0
+        if export:
+            # The spill format is the transfer format, and the WIRE form
+            # is its canonical frame: round-trip every migration through
+            # it (even in-process) so the integrity framing — per-entry
+            # header+CRC sealed at export, top-level frame version — is
+            # exercised on the path that matters, and so the
+            # ``cell.migrate.corrupt`` chaos point has a real payload to
+            # rot. A corrupted or version-drifted frame rejects cleanly
+            # at import (counted, dropped, session re-prefills on the
+            # target) — never lands as silent wrong KV.
+            wire = session_kv_to_wire(export)
+            if global_injector.fire("cell.migrate.corrupt"):
+                corrupt_wire_payload(wire)
+            try:
+                export = session_kv_from_wire(wire)
+            except ValueError as exc:
+                self._log.warning(
+                    "migration frame for session %s rejected: %s",
+                    session_id, exc,
+                )
+                export = None
+                rejected = n_entries
+                global_metrics.inc(
+                    "engine.kvcache.integrity_failures", n_entries
+                )
         if export:
             importer = getattr(dst.handler.backend, "import_session_kv", None)
             if callable(importer):
@@ -558,26 +599,65 @@ class ServingCell:
                 # copies and will re-prefill, and the metric must not
                 # claim otherwise.
                 tokens = int(landed.get("tokens", 0))
+                rejected = int(landed.get("rejected", 0))
         self.sessions[session_id] = target_id
         wall_ms = (time.perf_counter() - t0) * 1e3
         global_metrics.inc("cell.migrations")
         global_metrics.inc("cell.migrated_entries", accepted)
         global_metrics.inc("cell.migrated_tokens", tokens)
+        if rejected:
+            global_metrics.inc("cell.migrate_rejected", rejected)
         global_metrics.observe("cell.migration_ms", wall_ms)
         self._log.info(
-            "migrated session %s: %s -> %s (%d/%d entries, %d tokens, "
-            "%.1f ms)",
-            session_id, src_id, target_id, accepted,
-            len(export["entries"]) if export else 0, tokens, wall_ms,
+            "migrated session %s: %s -> %s (%d/%d entries, %d rejected, "
+            "%d tokens, %.1f ms)",
+            session_id, src_id, target_id, accepted, n_entries, rejected,
+            tokens, wall_ms,
         )
         return {
             "session_id": session_id,
             "from": src_id,
             "to": target_id,
-            "entries": len(export["entries"]) if export else 0,
+            "entries": n_entries,
             "accepted": accepted,
+            "rejected": rejected,
             "tokens": tokens,
             "migration_ms": round(wall_ms, 3),
+        }
+
+    async def rebalance_degraded(self) -> Dict[str, Any]:
+        """Migrate pinned sessions OFF replicas serving on a degraded
+        mesh rung, onto intact siblings — the second half of the
+        drain-then-restore runbook (degrade → rebalance → rebuild the
+        replica at full mesh → sessions migrate back on the next
+        rebalance). No-op when nothing is degraded or no full-mesh
+        routable sibling exists (migrating between two degraded
+        replicas helps nobody)."""
+        sigs = {s.replica_id: s for s in self.signals()}
+        degraded = sorted(
+            rid for rid, s in sigs.items() if s.mesh_rung > 0
+        )
+        intact = [
+            rid for rid, s in sigs.items()
+            if s.mesh_rung == 0 and s.routable()
+        ]
+        moved: List[Dict[str, Any]] = []
+        if degraded and intact:
+            for sid, owner in list(self.sessions.items()):
+                if owner not in degraded:
+                    continue
+                try:
+                    moved.append(await self.migrate_session(sid))
+                except Exception as exc:  # noqa: BLE001 — keep sweeping
+                    self._log.warning(
+                        "session %s could not rebalance off degraded "
+                        "replica %s: %s", sid, owner, exc,
+                    )
+        self._refresh_gauges()
+        return {
+            "degraded": degraded,
+            "moved": len(moved),
+            "migrations": moved,
         }
 
     async def drain(
@@ -735,7 +815,9 @@ class ServingCell:
             for name in (
                 "cell.affinity_lookups", "cell.affinity_hits",
                 "cell.affinity_hit_rate", "cell.rerouted",
-                "cell.migrations", "cell.migrated_tokens", "cell.drains",
+                "cell.migrations", "cell.migrated_tokens",
+                "cell.migrate_rejected", "cell.degraded_replicas",
+                "cell.drains",
             )
         }
         for cls in sorted(self._classes):
@@ -758,7 +840,11 @@ class ServingCell:
 def session_kv_to_wire(export: Dict[str, Any]) -> Dict[str, Any]:
     """JSON-safe form of ``export_session_kv``'s record: arrays as
     base64 + dtype + shape — the shape a control-plane frame can carry
-    to a remote worker's ``import_session_kv``."""
+    to a remote worker's ``import_session_kv``. The integrity frame
+    rides along verbatim: the top-level ``v`` (frame version) gates
+    interpretation at ``session_kv_from_wire``, and each entry's sealed
+    ``header``/``crc`` (from export) gate the bytes at import — a
+    flipped bit anywhere between the two replicas rejects cleanly."""
     def pack(a: np.ndarray) -> Dict[str, Any]:
         a = np.ascontiguousarray(a)
         return {
@@ -768,6 +854,7 @@ def session_kv_to_wire(export: Dict[str, Any]) -> Dict[str, Any]:
         }
 
     return {
+        "v": KV_FRAME_VERSION,
         "session_id": export["session_id"],
         "ids": list(export["ids"]),
         "entries": [
@@ -775,6 +862,7 @@ def session_kv_to_wire(export: Dict[str, Any]) -> Dict[str, Any]:
                 "key": list(e["key"]),
                 "tokens": e["tokens"], "rows": e["rows"],
                 "meta": e["meta"], "kind": e["kind"],
+                "header": e.get("header"), "crc": e.get("crc"),
                 "k": pack(e["k"]), "v": pack(e["v"]),
             }
             for e in export["entries"]
@@ -783,6 +871,18 @@ def session_kv_to_wire(export: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def session_kv_from_wire(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of :func:`session_kv_to_wire`. Raises ``ValueError`` on
+    an unknown frame version — a replica on a different wire format
+    must reject the whole payload before interpreting a byte (the
+    per-entry header/crc checks at ``import_session`` then catch
+    rot/drift inside a well-versioned frame)."""
+    v = payload.get("v", KV_FRAME_VERSION)
+    if v != KV_FRAME_VERSION:
+        raise ValueError(
+            f"unknown KV wire frame version {v!r} "
+            f"(expected {KV_FRAME_VERSION})"
+        )
+
     def unpack(p: Dict[str, Any]) -> np.ndarray:
         return np.frombuffer(
             base64.b64decode(p["data"]), dtype=np.dtype(p["dtype"])
@@ -796,6 +896,7 @@ def session_kv_from_wire(payload: Dict[str, Any]) -> Dict[str, Any]:
                 "key": list(e["key"]),
                 "tokens": e["tokens"], "rows": e["rows"],
                 "meta": e["meta"], "kind": e["kind"],
+                "header": e.get("header"), "crc": e.get("crc"),
                 "k": unpack(e["k"]), "v": unpack(e["v"]),
             }
             for e in payload["entries"]
@@ -803,9 +904,26 @@ def session_kv_from_wire(payload: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+def corrupt_wire_payload(wire: Dict[str, Any]) -> bool:
+    """Chaos helper for ``cell.migrate.corrupt``: flip one byte of the
+    first non-empty packed array IN the wire frame (after its CRC was
+    sealed at export) — the canonical 'frame rotted in transit'
+    injection. Returns True when a byte was flipped."""
+    for e in wire.get("entries", ()):
+        for part in ("k", "v"):
+            raw = bytearray(base64.b64decode(e[part]["data"]))
+            if not raw:
+                continue
+            raw[0] ^= 0xFF
+            e[part]["data"] = base64.b64encode(bytes(raw)).decode("ascii")
+            return True
+    return False
+
+
 __all__ = [
     "CellReplica",
     "ServingCell",
+    "corrupt_wire_payload",
     "session_kv_from_wire",
     "session_kv_to_wire",
 ]

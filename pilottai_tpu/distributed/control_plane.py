@@ -315,6 +315,7 @@ class RemoteAgent:
             "queue_frac": float(eng.get("queue_frac", 0.0) or 0.0),
             "degrade_level": int(eng.get("degrade_level", 0) or 0),
             "healthy": bool(eng.get("healthy", True)),
+            "mesh_rung": int(eng.get("mesh_rung", 0) or 0),
             "burn_rate": {
                 cls: float((v or {}).get("burn_rate", 0.0))
                 for cls, v in slo.items()
@@ -692,6 +693,10 @@ class AgentWorker:
                     depth / limit if limit else min(depth / 64.0, 2.0), 4
                 ),
                 "healthy": global_engine_health.healthy(),
+                # Degraded-mesh rung (engine.mesh_plan gauge): remote
+                # replicas serving on a survivor sub-mesh must rank
+                # below intact peers just like in-process ones do.
+                "mesh_rung": int(global_metrics.get("engine.mesh_plan") or 0),
             },
         }
 

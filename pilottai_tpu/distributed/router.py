@@ -62,6 +62,11 @@ class ReplicaSignals:
     #: admission control would shed interactive traffic.
     queue_frac: float = 0.0
     degrade_level: int = 0
+    #: active mesh-ladder rung (parallel/meshplan.py): 0 = full boot
+    #: mesh, higher = serving degraded on a surviving sub-mesh after
+    #: shard loss. The router down-scores degraded replicas and the
+    #: cell prefers migrating sessions off them.
+    mesh_rung: int = 0
     #: per-class error-budget burn rate (PR 6); missing classes read 0.
     burn_rate: Dict[str, float] = field(default_factory=dict)
     healthy: bool = True          # watchdog / EngineHealth verdict
@@ -78,6 +83,7 @@ class ReplicaSignals:
             "queue_depth": self.queue_depth,
             "queue_frac": round(self.queue_frac, 4),
             "degrade_level": self.degrade_level,
+            "mesh_rung": self.mesh_rung,
             "burn_rate": {k: round(v, 4) for k, v in self.burn_rate.items()},
             "healthy": self.healthy,
             "breaker_open": self.breaker_open,
@@ -91,6 +97,7 @@ class ReplicaSignals:
             queue_depth=int(payload.get("queue_depth", 0) or 0),
             queue_frac=float(payload.get("queue_frac", 0.0) or 0.0),
             degrade_level=int(payload.get("degrade_level", 0) or 0),
+            mesh_rung=int(payload.get("mesh_rung", 0) or 0),
             burn_rate={
                 str(k): float(v)
                 for k, v in (payload.get("burn_rate") or {}).items()
@@ -221,6 +228,10 @@ class ReplicaRouter:
         slo_weight: float = 1.0,
         queue_weight: float = 1.0,
         degrade_weight: float = 0.5,
+        #: penalty per mesh-ladder rung: a replica serving degraded on a
+        #: surviving sub-mesh keeps taking traffic (it's correct, just
+        #: slower), but loses ties against full-mesh peers.
+        mesh_weight: float = 0.5,
         batch_shed_frac: float = 0.75,
         #: degrade rung at or past which a replica sheds batch traffic
         #: itself (reliability/degrade.py SHED_BATCH) — the router skips
@@ -232,6 +243,7 @@ class ReplicaRouter:
         self.slo_weight = slo_weight
         self.queue_weight = queue_weight
         self.degrade_weight = degrade_weight
+        self.mesh_weight = mesh_weight
         self.batch_shed_frac = batch_shed_frac
         self.batch_shed_level = batch_shed_level
         self._rr = 0  # tiebreak rotation
@@ -280,6 +292,7 @@ class ReplicaRouter:
             + self.slo_weight * headroom
             - self.queue_weight * min(s.queue_frac, 2.0)
             - self.degrade_weight * s.degrade_level
+            - self.mesh_weight * s.mesh_rung
         )
 
     def pick(

@@ -99,6 +99,13 @@ from pilottai_tpu.ops.paged import PageAllocator, PagedKVCache
 from pilottai_tpu.ops.pallas.decode_attention import decode_shapes_ok
 from pilottai_tpu.ops.pallas.paged_attention import paged_sharding_ok
 from pilottai_tpu.parallel.collectives import CollectiveModel
+from pilottai_tpu.parallel.meshplan import (
+    MeshLadderExhausted,
+    MeshPlanLadder,
+    ShardLossError,
+    classify_device_error,
+    plan_label,
+)
 from pilottai_tpu.parallel.sharding import kv_shard_axes, place_kv_cache
 from pilottai_tpu.obs import (
     global_attribution,
@@ -327,6 +334,10 @@ class ContinuousBatcher:
                                          # exception wins (0 = off)
         watchdog_stall_s: Optional[float] = None,  # heartbeat-staleness
                                                    # bound (None = no dog)
+        mesh_ladder: Any = "auto",      # degraded-mesh plans: "auto"
+                                        # (halving ladder), "off", or an
+                                        # explicit list of plan dicts
+                                        # (parallel/meshplan.py)
         degrade: Optional[DegradeLadder] = None,  # capability ladder
                                                   # (None = default knobs)
         batch_shed_frac: float = 0.5,   # batch-class shed depth as a
@@ -521,75 +532,33 @@ class ContinuousBatcher:
                     )
                 )
         self.use_pallas = use_pallas
-        # Multi-chip serving mesh: prefill's flash kernel runs per-shard
-        # under shard_map (ops/pallas/flash_attention.py). One device →
-        # plain single-chip dispatch inside _full_seq_block.
-        self.flash_mesh = (
-            mesh if mesh is not None and mesh.devices.size > 1 else None
-        )
-        # Tensor-parallel serving state (ISSUE 13). ``mesh`` drives four
-        # things beyond the flash prefill:
-        # * the KV pool / dense cache panels are CREATED on their
-        #   sharded layout (_rebuild_device_state → place_kv_cache):
-        #   kv-heads over 'model', dense slots over 'data' — the paged
-        #   8B pool stops being resident whole on any one chip;
-        # * the paged Pallas decode kernel runs per-shard under
-        #   shard_map (kv_mesh → decode_chunk/decode_chunk_spec);
-        # * admission replicates over the 'data' axis: slots partition
-        #   into ``data_groups`` contiguous groups (the same split the
-        #   batch-dim sharding uses) and _free_slot_indices interleaves
-        #   selection across them, so a {'model':M,'data':D} engine
-        #   serves D balanced decode groups;
-        # * per-dispatch collective time is attributed per axis
-        #   (parallel/collectives.py → engine.collective_frac[.axis]).
-        self.mesh = self.flash_mesh
-        kv_axes = kv_shard_axes(
-            self.mesh, n_kv_heads=cfg.n_kv_heads, n_slots=n_slots
-        )
-        self.kv_heads_sharded = kv_axes["heads"] is not None
-        self.data_groups = int(kv_axes["data_groups"])
-        # The dense Pallas decode kernel (opt-in A/B path,
-        # PILOTTAI_DECODE_PALLAS) has no shard_map wrapper: on a mesh
-        # whose dense panels shard it cannot lower per-shard — demote
-        # to the XLA dense path, which GSPMD partitions fine (and which
-        # beats the kernel at serving sizes anyway; see use_pallas
-        # resolution above).
-        if (
-            self.mesh is not None and not paged and self.use_pallas
-            and (kv_axes["heads"] is not None or kv_axes["slots"] is not None)
-        ):
-            self.use_pallas = False
-        self.kv_mesh = None
-        if (
-            self.mesh is not None and paged and self.use_pallas
-            and paged_sharding_ok(self.mesh, n_slots, cfg.n_kv_heads)
-        ):
-            self.kv_mesh = self.mesh
-        # KV placement mesh: the pool/panels shard per kv_shard_axes —
-        # EXCEPT when the paged Pallas kernel will run but cannot run
-        # sharded (slots don't divide the data axes, or a seq axis is
-        # present): a model-sharded pool under the UNWRAPPED kernel
-        # would force a whole-pool gather (or fail to lower) on every
-        # dispatch, so the pool stays replicated and only the weights
-        # shard. The XLA fallback path partitions any layout.
-        self._kv_place_mesh = self.mesh
-        if paged and self.use_pallas and self.kv_mesh is None:
-            self._kv_place_mesh = None
-            if self.kv_heads_sharded:
-                # Report the EFFECTIVE placement: an operator debugging
-                # HBM pressure must not be told the pool is split across
-                # TP shards while it is resident whole on every chip.
-                self.kv_heads_sharded = False
-                get_logger("engine.batcher").warning(
-                    "paged Pallas kernel cannot run sharded on this "
-                    "mesh; KV pool stays replicated — only weights shard"
-                )
-        self.collective_model = CollectiveModel.for_mesh(
-            self.mesh, cfg,
-            platform="tpu" if self.on_tpu else "cpu",
-            paged=paged, kv_quantize=self.kv_quantize,
-        )
+        # Multi-chip serving mesh (ISSUE 13) + degraded-mesh fault
+        # domain (ISSUE 16). All mesh-derived state — flash/kv meshes,
+        # kv-head sharding, data groups, the collective model and the
+        # attribution config — is computed by _apply_mesh_plan so a
+        # shard-loss rebuild can re-derive it for the surviving
+        # sub-mesh exactly the way boot derived it for the full one.
         self._log = get_logger("engine.batcher")
+        self._apply_mesh_plan(mesh, paged=paged)
+        # Degraded-mesh ladder: the ordered mesh plans this engine may
+        # fall back to when a shard dies (parallel/meshplan.py). Only a
+        # real multi-chip mesh gets one — a single-chip engine has no
+        # rung to fall to, and "off" pins the boot plan (a shard loss
+        # then follows the plain PR 8 device_loop_error path).
+        self._mesh_ladder: Optional[MeshPlanLadder] = None
+        if (
+            mesh is not None and mesh.devices.size > 1
+            and mesh_ladder != "off"
+        ):
+            self._mesh_ladder = MeshPlanLadder(
+                mesh,
+                rungs=(
+                    mesh_ladder
+                    if isinstance(mesh_ladder, (list, tuple)) else None
+                ),
+                name=cfg.name,
+            )
+            global_metrics.set_gauge("engine.mesh_plan", 0.0)
         # Subword JSON grammar tables (token_bytes [V, L], token_len [V])
         # from json_mask.token_byte_table — None for byte tokenizers,
         # whose 256-entry byte mask is cheaper.
@@ -820,19 +789,9 @@ class ContinuousBatcher:
         # (accumulated here between folds, under the lock).
         self._last_attr_mark: Optional[float] = None
         self._prefill_since_fold = 0.0
-        # Live MFU/attribution gauges: the model's FLOPs formula, the
-        # platform peak and the mesh shape — the same
-        # ModelConfig.flops_per_token() bench.py uses, so live and bench
-        # MFU reconcile by construction.
-        global_attribution.configure(
-            flops_per_token=cfg.flops_per_token(),
-            platform="tpu" if self.on_tpu else "cpu",
-            n_chips=int(mesh.devices.size) if mesh is not None else 1,
-            mesh_axes=(
-                tuple(str(a) for a in mesh.axis_names)
-                if mesh is not None else ()
-            ),
-        )
+        # (Live MFU/attribution gauges configure inside _apply_mesh_plan
+        # — the FLOPs formula is constant but n_chips/mesh_axes follow
+        # the ACTIVE plan across degradations.)
         # (engine.queue_depth is declared at obs import — the exported
         # surface exists from process boot; the batcher only sets it.)
         if self.max_queue_depth is not None:
@@ -955,10 +914,35 @@ class ContinuousBatcher:
     def _beat(self) -> None:
         """Progress heartbeat: folds, prefill installs and segment
         advances call this so the watchdog can tell a hung dispatch from
-        a healthy slow one (any thread; a plain float store)."""
+        a healthy slow one (any thread; a plain float store). The mesh
+        ladder's per-shard table beats alongside: a completed fold
+        proves the whole active mesh answered, so a shard whose stamp
+        stops moving (frozen by the mesh.shard_loss hang variant, or a
+        real per-device probe) stands out against beating siblings."""
         wd = self._watchdog
         if wd is not None:
             wd.beat()
+        ladder = self._mesh_ladder
+        if ladder is not None:
+            ladder.beat_all()
+            # Shard-stale triage on the HEALTHY path too: a shard whose
+            # stamp stopped moving while the engine keeps folding (the
+            # chip stopped answering but nothing wedged — the hang
+            # variant of mesh.shard_loss, or a production per-device
+            # probe) never trips the engine watchdog, so the fold
+            # heartbeat is where it stands out against its siblings.
+            if wd is not None:
+                stale = ladder.stale(wd.stall_s)
+                if stale and len(stale) < len(ladder.surviving()):
+                    for idx in stale:
+                        ladder.mark_lost(idx)
+                        global_metrics.inc("engine.shard_losses")
+                    self._log.error(
+                        "shard heartbeat(s) %s stale while the engine "
+                        "keeps serving — treating as shard loss", stale,
+                    )
+                    self._rebuild_requested = "shard_loss"
+                    self._wake.set()
 
     def _watchdog_has_work(self) -> bool:
         """Anything in flight or queued? (watchdog thread; lock-free
@@ -985,10 +969,182 @@ class ContinuousBatcher:
     def _on_watchdog_stall(self, info: Dict[str, Any]) -> None:
         """Stall diagnostics (watchdog thread): the black-box dump is
         the flight recorder for "what was the engine doing when it
-        hung"; the ladder counts the stall as a fault."""
+        hung"; the ladder counts the stall as a fault.
+
+        Per-shard triage (ISSUE 16): when the mesh ladder's heartbeat
+        table shows SOME shards stale while siblings kept beating, the
+        stall is a shard loss, not a whole-engine hang — mark the stale
+        shards lost and request a shard_loss rebuild. The device thread
+        consumes the request at its next cycle (when the hung dispatch
+        resolves or raises); until then the watchdog's normal 503
+        containment holds."""
+        ladder = self._mesh_ladder
+        if ladder is not None and self._watchdog is not None:
+            stale = ladder.stale(self._watchdog.stall_s)
+            info = dict(info, stale_shards=stale)
+            if stale and len(stale) < len(ladder.surviving()):
+                for idx in stale:
+                    ladder.mark_lost(idx)
+                    global_metrics.inc("engine.shard_losses")
+                self._log.error(
+                    "watchdog: shard heartbeat(s) %s stale while "
+                    "siblings beat — treating as shard loss", stale,
+                )
+                self._rebuild_requested = "shard_loss"
         global_steps.record("engine.watchdog_stall", **info)
         global_blackbox.dump("watchdog_stall", **info)
         self.degrade.record_fault("stall")
+
+    # ------------------------------------------------------------------ #
+    # Mesh plan (ISSUE 13 boot layout + ISSUE 16 degraded re-planning)
+    # ------------------------------------------------------------------ #
+
+    def _apply_mesh_plan(self, mesh: Optional[Any],
+                         paged: Optional[bool] = None) -> None:
+        """Derive every mesh-dependent piece of engine state from
+        ``mesh`` — at boot (the ISSUE 13 layout rules) and again on a
+        shard-loss re-plan, so the surviving sub-mesh is configured by
+        exactly the code path that configured the boot mesh.
+
+        ``mesh`` drives four things beyond the flash prefill:
+        * the KV pool / dense cache panels are CREATED on their
+          sharded layout (_rebuild_device_state → place_kv_cache):
+          kv-heads over 'model', dense slots over 'data' — the paged
+          8B pool stops being resident whole on any one chip;
+        * the paged Pallas decode kernel runs per-shard under
+          shard_map (kv_mesh → decode_chunk/decode_chunk_spec);
+        * admission replicates over the 'data' axis: slots partition
+          into ``data_groups`` contiguous groups and
+          _free_slot_indices interleaves selection across them;
+        * per-dispatch collective time is attributed per axis
+          (parallel/collectives.py → engine.collective_frac[.axis]).
+        """
+        if paged is None:
+            paged = self.paged
+        cfg = self.cfg
+        # Prefill's flash kernel runs per-shard under shard_map
+        # (ops/pallas/flash_attention.py). One device → plain
+        # single-chip dispatch inside _full_seq_block.
+        self.flash_mesh = (
+            mesh if mesh is not None and mesh.devices.size > 1 else None
+        )
+        self.mesh = self.flash_mesh
+        kv_axes = kv_shard_axes(
+            self.mesh, n_kv_heads=cfg.n_kv_heads, n_slots=self.n_slots
+        )
+        self.kv_heads_sharded = kv_axes["heads"] is not None
+        self.data_groups = int(kv_axes["data_groups"])
+        # The dense Pallas decode kernel (opt-in A/B path,
+        # PILOTTAI_DECODE_PALLAS) has no shard_map wrapper: on a mesh
+        # whose dense panels shard it cannot lower per-shard — demote
+        # to the XLA dense path, which GSPMD partitions fine (and which
+        # beats the kernel at serving sizes anyway). The demotion only
+        # ever turns the kernel OFF, so re-applying on a smaller mesh
+        # never resurrects it mid-serving.
+        if (
+            self.mesh is not None and not paged and self.use_pallas
+            and (kv_axes["heads"] is not None or kv_axes["slots"] is not None)
+        ):
+            self.use_pallas = False
+        self.kv_mesh = None
+        if (
+            self.mesh is not None and paged and self.use_pallas
+            and paged_sharding_ok(self.mesh, self.n_slots, cfg.n_kv_heads)
+        ):
+            self.kv_mesh = self.mesh
+        # KV placement mesh: the pool/panels shard per kv_shard_axes —
+        # EXCEPT when the paged Pallas kernel will run but cannot run
+        # sharded (slots don't divide the data axes, or a seq axis is
+        # present): a model-sharded pool under the UNWRAPPED kernel
+        # would force a whole-pool gather (or fail to lower) on every
+        # dispatch, so the pool stays replicated and only the weights
+        # shard. The XLA fallback path partitions any layout.
+        self._kv_place_mesh = self.mesh
+        if paged and self.use_pallas and self.kv_mesh is None:
+            self._kv_place_mesh = None
+            if self.kv_heads_sharded:
+                # Report the EFFECTIVE placement: an operator debugging
+                # HBM pressure must not be told the pool is split across
+                # TP shards while it is resident whole on every chip.
+                self.kv_heads_sharded = False
+                self._log.warning(
+                    "paged Pallas kernel cannot run sharded on this "
+                    "mesh; KV pool stays replicated — only weights shard"
+                )
+        self.collective_model = CollectiveModel.for_mesh(
+            self.mesh, cfg,
+            platform="tpu" if self.on_tpu else "cpu",
+            paged=paged, kv_quantize=self.kv_quantize,
+        )
+        # Live MFU/attribution gauges: the model's FLOPs formula, the
+        # platform peak and the ACTIVE mesh shape — the same
+        # ModelConfig.flops_per_token() bench.py uses, so live and
+        # bench MFU reconcile by construction, and a degraded engine's
+        # MFU is normalized to the chips it still has.
+        global_attribution.configure(
+            flops_per_token=cfg.flops_per_token(),
+            platform="tpu" if self.on_tpu else "cpu",
+            n_chips=(
+                int(self.mesh.devices.size) if self.mesh is not None else 1
+            ),
+            mesh_axes=(
+                tuple(str(a) for a in self.mesh.axis_names)
+                if self.mesh is not None else ()
+            ),
+        )
+
+    def _replan_mesh(self) -> None:
+        """Shard-loss re-plan (device thread, inside the rebuild):
+        walk the ladder to the first rung fitting the surviving
+        devices, re-derive all mesh state for it and re-place the
+        weights on the new plan. Raises ``MeshLadderExhausted`` when no
+        rung fits — the caller's recovery contract already failed the
+        in-flight requests with the original exception by then.
+
+        Weight re-placement re-uses each leaf's own partition spec on
+        the new mesh (axis names are constant across rungs). Under
+        simulated loss (CPU virtual devices, chaos tests) every shard
+        is still readable and the device_put is a plain reshard; a
+        production backend that lost the only holder of a 'model' shard
+        must reload those leaves from the host checkpoint instead —
+        see SERVING.md's failure-domain table."""
+        ladder = self._mesh_ladder
+        assert ladder is not None
+        t0 = time.perf_counter()
+        old_plan = plan_label(ladder.plan())
+        new_mesh = ladder.replan()
+        self._apply_mesh_plan(new_mesh)
+        from jax.sharding import NamedSharding
+
+        def _put(leaf):
+            spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+            if spec is not None:
+                return jax.device_put(leaf, NamedSharding(new_mesh, spec))
+            # No NamedSharding → the leaf was never committed to the
+            # old mesh (boot leaves params uncommitted and lets GSPMD
+            # place them). Leave it uncommitted: committing it to any
+            # single device here would conflict with the new mesh's
+            # committed cache at the next jit dispatch.
+            return leaf
+
+        self.params = jax.tree_util.tree_map(_put, self.params)
+        # Dense device-resident prefix panels live on the OLD mesh's
+        # layout (possibly on the dead shard) — drop them; the host
+        # tier's entries survive and restore onto the new layout via
+        # _restore_place. (The paged index is cleared by every rebuild
+        # already.)
+        if self.prefix_store is not None:
+            # clear(), not eviction: spilling would D2H panels resident
+            # on a device that may be the dead one.
+            self.prefix_store.clear()
+        global_metrics.set_gauge("engine.mesh_plan", float(ladder.rung))
+        global_metrics.observe(
+            "engine.mesh_rebuild_ms", (time.perf_counter() - t0) * 1e3
+        )
+        self._log.warning(
+            "mesh degraded: %s -> %s (rung %d, lost=%s)",
+            old_plan, plan_label(ladder.plan()), ladder.rung, ladder.lost(),
+        )
 
     def _max_safe_strip(self, want: int) -> int:
         """Largest strip ≤ ``want`` whose double-buffered K/V blocks stay
@@ -1223,6 +1379,13 @@ class ContinuousBatcher:
             "queue_frac": depth / max(limit, 1),
             "degrade_level": self.degrade.level(),
             "healthy": self._watchdog is None or not self._watchdog.stalled,
+            # Degraded-mesh rung (0 = boot plan): the cell's router
+            # down-scores replicas serving on a sub-mesh, and the cell
+            # prefers migrating sessions off them.
+            "mesh_rung": (
+                self._mesh_ladder.rung
+                if self._mesh_ladder is not None else 0
+            ),
         }
 
     def export_session_kv(self, session_id: str):
@@ -1242,7 +1405,7 @@ class ContinuousBatcher:
         restores here instead of re-prefilling. Returns the accepted
         entry/token counts (budget pressure may reject some)."""
         if self.kvcache is None or self.kvcache.host is None or not export:
-            return {"accepted": 0, "tokens": 0}
+            return {"accepted": 0, "tokens": 0, "rejected": 0}
         with self._lock:
             return self.kvcache.import_session(export)
 
@@ -3149,6 +3312,24 @@ class ContinuousBatcher:
         # occupants (re-admission after rebuild) or, strikes exhausted,
         # fails them with this exception; queued requests are untouched.
         global_injector.fire("engine.step")
+        # Chaos point: a serving-mesh device fails mid-decode. value=
+        # the boot-order device index — the dispatch raises
+        # ShardLossError, the device-loop boundary classifies it and
+        # the rebuild re-plans onto the surviving sub-mesh. The dict
+        # form {"device": i, "hang": True} freezes that shard's
+        # heartbeat instead (no raise): the per-shard watchdog triage
+        # is then the only detector, exactly like a chip that stops
+        # answering without erroring.
+        loss = global_injector.fire("mesh.shard_loss")
+        if loss is not None:
+            if isinstance(loss, dict) and loss.get("hang"):
+                if self._mesh_ladder is not None:
+                    self._mesh_ladder.freeze(int(loss.get("device", 0)))
+            else:
+                raise ShardLossError(
+                    0 if isinstance(loss, bool) else int(loss),
+                    detail="injected",
+                )
         # Chaos point: a STUCK dispatch — delay= pins the device thread
         # here without raising, exactly the shape of a hung XLA call or
         # a wedged collective. Nothing downstream ever observes it; the
@@ -3637,6 +3818,18 @@ class ContinuousBatcher:
             # Chaos point: a rebuild that itself fails (exc=) — retried
             # next device-loop cycle via _rebuild_requested.
             global_injector.fire("engine.rebuild", reason=reason)
+        if reason == "shard_loss" and self._mesh_ladder is not None:
+            # Degraded-mesh rebuild (ISSUE 16): re-plan onto the
+            # surviving sub-mesh and re-place the weights BEFORE the
+            # pool is recreated, so place_kv_cache below lays the fresh
+            # KV out on the new plan. The occupants were already swept
+            # into recovery by the failure arm; their re-prefill runs
+            # on the degraded mesh and greedy output stays
+            # byte-identical (nothing trusts the old pool). Raises
+            # MeshLadderExhausted only if the ladder emptied between
+            # the failure arm's viable() check and here — the caller's
+            # retry path handles it like any failed rebuild.
+            self._replan_mesh()
         if self.paged:
             cache = PagedKVCache.create(
                 self.cfg.n_layers, self.n_slots, self.num_pages,
@@ -3722,7 +3915,8 @@ class ContinuousBatcher:
         return False
 
     def _fail_occupied_slots(
-        self, exc: Exception, record_fault: bool = True
+        self, exc: Exception, record_fault: bool = True,
+        allow_recovery: bool = True,
     ) -> None:
         """Contain a device/transfer failure to the ENGINE, not its
         requests (either thread). Every occupied slot's progress —
@@ -3739,7 +3933,13 @@ class ContinuousBatcher:
         fail immediately (the JSON automaton's state is derived from
         the position *after the prompt*, so a spliced replay prompt
         would constrain against the wrong state — restart-from-scratch
-        is only transparent when nothing was emitted)."""
+        is only transparent when nothing was emitted).
+
+        ``allow_recovery=False`` ends the containment contract: every
+        occupant fails with the original exception regardless of
+        remaining strikes — the mesh ladder exhausted, so there is no
+        device state left to recover ONTO (PR 8's strikes-exhausted
+        semantics, reached structurally instead of by count)."""
         now = time.monotonic()
         t_snap = time.perf_counter()
         recovered: List[GenRequest] = []
@@ -3756,6 +3956,10 @@ class ContinuousBatcher:
                 if req.future.done():
                     continue
                 replay = list(slot.generated)
+                if not allow_recovery:
+                    req.future.set_exception(exc)
+                    failed += 1
+                    continue
                 json_bound = req.json_mode or req.json_schema_id >= 0
                 if json_bound and replay and req.on_tokens is not None:
                     # Streamed grammar-constrained output can neither be
@@ -3901,7 +4105,28 @@ class ContinuousBatcher:
                     self._wake.clear()
             except Exception as exc:  # noqa: BLE001 — device loop boundary
                 self._log.error("device loop error: %s", exc, exc_info=True)
-                self._fail_occupied_slots(exc)
+                # Shard-loss triage (ISSUE 16): an error that names a
+                # failed DEVICE is a loss of that shard, not a generic
+                # dispatch failure — mark it lost and rebuild onto the
+                # surviving sub-mesh. When the ladder has no rung left
+                # for the survivors, the containment contract ends and
+                # the occupants fail with the original exception (the
+                # PR 8 strikes-exhausted semantics).
+                reason = "device_loop_error"
+                recover = True
+                ladder = self._mesh_ladder
+                if isinstance(exc, MeshLadderExhausted):
+                    recover = False
+                elif ladder is not None:
+                    dev = classify_device_error(exc)
+                    if dev is not None:
+                        ladder.mark_lost(dev)
+                        global_metrics.inc("engine.shard_losses")
+                        if ladder.viable():
+                            reason = "shard_loss"
+                        else:
+                            recover = False
+                self._fail_occupied_slots(exc, allow_recovery=recover)
                 # Conservative containment: a dispatch that raised
                 # mid-flight may have partially mutated device state even
                 # when the donated buffers survived — rebuild fresh so
@@ -3910,7 +4135,16 @@ class ContinuousBatcher:
                 # byte-identical by construction: everything re-prefills
                 # from the tokens, nothing trusts the old pool.)
                 try:
-                    self._rebuild_device_state(reason="device_loop_error")
+                    self._rebuild_device_state(reason=reason)
+                except MeshLadderExhausted as rexc:
+                    # Raced to exhaustion after the viable() check:
+                    # nothing to rebuild onto — fail anything that
+                    # slipped into recovery and stop re-planning.
+                    self._log.error("mesh ladder exhausted: %s", rexc)
+                    self._fail_occupied_slots(
+                        exc, record_fault=False, allow_recovery=False
+                    )
+                    self._rebuild_requested = "rebuild_retry"
                 except Exception as rexc:  # noqa: BLE001 — retry next cycle
                     self._log.error(
                         "device-state rebuild failed: %s", rexc,
@@ -4007,15 +4241,38 @@ class ContinuousBatcher:
             "collective_frac": round(
                 global_metrics.get("engine.collective_frac"), 4
             ),
+            # ACTIVE mesh plan, not the boot plan: after a shard-loss
+            # re-plan this reports the rung the engine is actually
+            # serving on (the single-chip rung sets self.mesh = None,
+            # so the ladder — which remembers the boot set — keeps the
+            # section alive with shape {} / n_chips 1).
             **(
                 {"mesh": {
-                    "shape": {
-                        str(a): int(s) for a, s in self.mesh.shape.items()
-                        if int(s) > 1
-                    },
-                    "n_chips": int(self.mesh.devices.size),
+                    "shape": (
+                        {
+                            str(a): int(s)
+                            for a, s in self.mesh.shape.items()
+                            if int(s) > 1
+                        }
+                        if self.mesh is not None else {}
+                    ),
+                    "n_chips": (
+                        int(self.mesh.devices.size)
+                        if self.mesh is not None else 1
+                    ),
                     "kv_heads_sharded": self.kv_heads_sharded,
                     "data_groups": self.data_groups,
+                    **(
+                        {
+                            "rung": self._mesh_ladder.rung,
+                            "plan": plan_label(self._mesh_ladder.plan()),
+                            "lost_devices": self._mesh_ladder.lost(),
+                            "shard_losses": global_metrics.get(
+                                "engine.shard_losses"
+                            ),
+                        }
+                        if self._mesh_ladder is not None else {}
+                    ),
                     "collective_frac_model": round(
                         global_metrics.get("engine.collective_frac.model"),
                         4,
@@ -4025,7 +4282,8 @@ class ContinuousBatcher:
                         4,
                     ),
                 }}
-                if self.mesh is not None else {}
+                if self.mesh is not None or self._mesh_ladder is not None
+                else {}
             ),
             **(
                 {"max_queue_depth": self.max_queue_depth,

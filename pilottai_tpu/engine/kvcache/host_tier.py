@@ -39,11 +39,17 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from pilottai_tpu.engine.kvcache.integrity import (
+    corrupt_arrays,
+    entry_header,
+    kv_checksum,
+)
 from pilottai_tpu.engine.kvcache.policy import (
     eviction_score,
     validate_policy,
 )
 from pilottai_tpu.engine.kvcache.radix import RadixTree
+from pilottai_tpu.reliability.inject import global_injector
 from pilottai_tpu.utils.metrics import global_metrics
 
 
@@ -53,13 +59,26 @@ class SpillCopy:
     test) asks — by then the transfer has long landed, so ``wait`` is a
     host-side materialize, not a fresh blocking round trip. Mirrors
     ``engine/batcher.py:_HostCopy``; the AST tripwire
-    (tests/test_no_blocking_hotpath.py) sanctions exactly this shape."""
+    (tests/test_no_blocking_hotpath.py) sanctions exactly this shape.
 
-    __slots__ = ("_arrays", "_host")
+    With ``integrity=True`` (the host-tier entries — NOT the batcher's
+    fold-path token reads, which alias this class as ``_HostCopy``), a
+    CRC-32 **digest** seals at first materialization — the earliest
+    moment the bytes are host-resident — and ``verify()`` recomputes it
+    at every restore, so anything that rots the host copy between spill
+    and restore (the ``kvcache.spill.corrupt`` chaos point simulates
+    exactly this window) is detected instead of restored as silent
+    wrong KV. The chaos point is gated on the same flag: corrupting a
+    fold read would poison the TOKEN stream, which is the
+    ``engine.fold.corrupt`` point's job, not this one's."""
 
-    def __init__(self, arrays) -> None:
+    __slots__ = ("_arrays", "_host", "_digest", "_integrity")
+
+    def __init__(self, arrays, integrity: bool = False) -> None:
         self._arrays = tuple(arrays)
         self._host: Optional[List[np.ndarray]] = None
+        self._digest: Optional[int] = None
+        self._integrity = bool(integrity)
         for a in self._arrays:
             try:
                 a.copy_to_host_async()
@@ -70,7 +89,34 @@ class SpillCopy:
         if self._host is None:
             self._host = [np.asarray(a) for a in self._arrays]
             self._arrays = ()  # drop device refs once materialized
+            if self._integrity:
+                self._digest = kv_checksum(self._host)
+                # Chaos point: bytes rot in host RAM AFTER the digest
+                # sealed — the exact window verify() exists to catch.
+                if global_injector.fire("kvcache.spill.corrupt") is not None:
+                    self._host = [
+                        np.array(h, copy=True) for h in self._host
+                    ]
+                    corrupt_arrays(self._host)
         return self._host
+
+    def digest(self) -> int:
+        """The sealed CRC-32 (materializes on first call; forces the
+        integrity frame on for copies created without one)."""
+        if self._digest is None:
+            self._integrity = True
+            self.wait()
+            if self._digest is None:  # already materialized unsealed
+                self._digest = kv_checksum(self._host)
+        return self._digest  # type: ignore[return-value]
+
+    def verify(self) -> bool:
+        """Recompute the CRC over the current host bytes against the
+        sealed digest. Cheap next to the H2D upload it gates."""
+        host = self.wait()
+        if self._digest is None:  # unframed copy: nothing to verify
+            return True
+        return kv_checksum(host) == self._digest
 
 
 def _nbytes(arrays) -> int:
@@ -88,9 +134,10 @@ class HostEntry:
     the eviction-score bookkeeping."""
 
     __slots__ = ("key", "copy", "nbytes", "tokens", "rows", "meta",
-                 "kind", "stamp")
+                 "kind", "stamp", "header")
 
-    def __init__(self, key, copy, nbytes, tokens, rows, meta, kind):
+    def __init__(self, key, copy, nbytes, tokens, rows, meta, kind,
+                 header=None):
         self.key = key          # Tuple[int, ...] — the covered prefix
         self.copy = copy        # SpillCopy (or pre-materialized arrays)
         self.nbytes = nbytes
@@ -99,6 +146,10 @@ class HostEntry:
         self.meta = meta        # dense: p_bucket; paged: block index
         self.kind = kind        # "dense" | "page"
         self.stamp = 0
+        # Layout/quant/version frame (kvcache/integrity.py), sealed at
+        # put time from the device arrays' metadata; restore verifies
+        # the materialized bytes still match it.
+        self.header = header
 
 
 class HostTier:
@@ -162,7 +213,7 @@ class HostTier:
         if self.budget_bytes <= 0 or nbytes > self.budget_bytes:
             return False
         key = tuple(key)
-        copy = SpillCopy(arrays)
+        copy = SpillCopy(arrays, integrity=True)
         with self._lock:
             old = self._tree.get(key)
             if old is not None:
@@ -173,6 +224,7 @@ class HostTier:
             entry = HostEntry(
                 key, copy, nbytes, tokens,
                 rows if rows is not None else tokens, meta, kind,
+                header=entry_header(arrays, kind),
             )
             self._clock += 1
             entry.stamp = self._clock

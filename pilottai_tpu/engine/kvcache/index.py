@@ -44,8 +44,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from pilottai_tpu.engine.kvcache.host_tier import HostTier
+from pilottai_tpu.engine.kvcache.integrity import (
+    corrupt_arrays,
+    entry_header,
+    header_matches,
+    kv_checksum,
+)
 from pilottai_tpu.ops.kvcache import dequantize_kv
 from pilottai_tpu.ops.paged import write_prompts_paged
+from pilottai_tpu.reliability.inject import global_injector
 from pilottai_tpu.utils.metrics import global_metrics
 
 # Donated pool scatter for restored page chains: same op the paged
@@ -209,6 +216,36 @@ class KVCacheIndex:
         )
 
     # ------------------------------------------------------------------ #
+    # Integrity gate (ISSUE 16)
+    # ------------------------------------------------------------------ #
+
+    def _entry_ok(self, entry) -> bool:
+        """Verify a host entry's frame before any restored byte is
+        consumed: layout header against the materialized arrays, then
+        the sealed CRC against the current host bytes. A failed check
+        is a contained fault — the CALLER drops the entry and falls
+        back to re-prefill (correct, never wrong); this method only
+        verifies and counts ``engine.kvcache.integrity_failures``."""
+        copy = entry.copy
+        arrays = copy.wait() if hasattr(copy, "wait") else list(copy)
+        # Chaos point: bytes rot between materialization and THIS
+        # restore (distinct from kvcache.spill.corrupt, which rots at
+        # spill time — both must be caught here).
+        if global_injector.fire("kvcache.restore.corrupt") is not None:
+            arrays[:] = [np.array(a, copy=True) for a in arrays]
+            corrupt_arrays(arrays)
+        ok = True
+        if entry.header is not None and not header_matches(
+            entry.header, arrays
+        ):
+            ok = False
+        if ok and hasattr(copy, "verify"):
+            ok = copy.verify()
+        if not ok:
+            global_metrics.inc("engine.kvcache.integrity_failures")
+        return ok
+
+    # ------------------------------------------------------------------ #
     # Lookup (the ONE entry point for all traffic)
     # ------------------------------------------------------------------ #
 
@@ -271,6 +308,14 @@ class KVCacheIndex:
             or (entry is not None and lcp <= len(entry.ids))
             or (fits is not None and not fits(lcp, p_bucket))
         ):
+            if entry is not None and count:
+                global_metrics.inc("engine.kvcache.hits")
+            return entry
+        if not self._entry_ok(h):
+            # Corrupt host entry: drop it (it can never verify) and
+            # serve whatever the hot store had — the caller re-prefills
+            # the rest, so output stays byte-identical, just slower.
+            self.host.take(h.key)
             if entry is not None and count:
                 global_metrics.inc("engine.kvcache.hits")
             return entry
@@ -359,6 +404,19 @@ class KVCacheIndex:
             # copies for KV the next lookup can't see.
             max_blocks = min(max_blocks, depth + index.capacity)
         ents = self.host.extension_blocks(ids, depth, P, max_blocks)
+        if ents:
+            # Integrity gate per block: the chain must stay contiguous,
+            # so the first corrupt link truncates it — blocks past it
+            # cannot restore without the dropped one, and the tail
+            # re-prefills instead.
+            good: List[Any] = []
+            for e in ents:
+                if self._entry_ok(e):
+                    good.append(e)
+                else:
+                    self.host.take(e.key)
+                    break
+            ents = good
         total_need = alloc.pages_needed(min(need_tokens, max_seq_len))
         if ents and alloc.free_pages < max(total_need - depth, 0):
             # The request can't admit on this pool state anyway —
@@ -468,13 +526,25 @@ class KVCacheIndex:
             if key in have or not key:
                 return
             have.add(key)
+            k_np = np.asarray(k_np)
+            v_np = np.asarray(v_np)
+            # Integrity frame sealed at pack time: the importer (and
+            # the wire layer in between) verifies header + CRC before
+            # a single byte lands in its host tier.
             entries.append({
-                "key": list(key), "k": np.asarray(k_np),
-                "v": np.asarray(v_np), "tokens": int(tokens),
-                "rows": int(rows), "meta": meta, "kind": kind,
+                "key": list(key), "k": k_np, "v": v_np,
+                "tokens": int(tokens), "rows": int(rows),
+                "meta": meta, "kind": kind,
+                "header": entry_header((k_np, v_np), kind),
+                "crc": kv_checksum((k_np, v_np)),
             })
 
         for e in self.host.prefix_entries(ids):
+            # A host entry that no longer verifies must not migrate —
+            # exporting rot just moves the fault to another replica.
+            if not self._entry_ok(e):
+                self.host.take(e.key)
+                continue
             arrays = e.copy.wait() if hasattr(e.copy, "wait") else list(e.copy)
             add(e.key, arrays[0], arrays[1], e.tokens, e.rows, e.meta, e.kind)
         store = self.prefix_store
@@ -520,14 +590,34 @@ class KVCacheIndex:
         actually landed — budget pressure may reject some (the resume
         then re-prefills those spans, correct but slower; the source
         still holds its copy), and the metrics must not report KV as
-        moved that was dropped."""
+        moved that was dropped.
+
+        Framed entries (``header``/``crc``, sealed at export) verify
+        BEFORE landing: a checksum mismatch, an unknown frame version
+        or a layout/quant drift (dtype doubles as the quant mode — an
+        int8 source migrating into a bf16 target rejects here, not as
+        garbage panels at restore) drops that entry, counts
+        ``engine.kvcache.integrity_failures`` and rides the
+        ``rejected`` count back to the caller."""
         if self.host is None or not export:
-            return {"accepted": 0, "tokens": 0}
+            return {"accepted": 0, "tokens": 0, "rejected": 0}
         accepted = 0
         tokens = 0
+        rejected = 0
         for e in export.get("entries", ()):
+            arrays = (np.asarray(e["k"]), np.asarray(e["v"]))
+            crc = e.get("crc")
+            if crc is not None and kv_checksum(arrays) != int(crc):
+                rejected += 1
+                global_metrics.inc("engine.kvcache.integrity_failures")
+                continue
+            header = e.get("header")
+            if header is not None and not header_matches(header, arrays):
+                rejected += 1
+                global_metrics.inc("engine.kvcache.integrity_failures")
+                continue
             if self.host.put(
-                tuple(e["key"]), (e["k"], e["v"]),
+                tuple(e["key"]), arrays,
                 tokens=e["tokens"], rows=e["rows"], meta=e.get("meta"),
                 kind=e.get("kind", "dense"), count=False,
             ):
@@ -536,7 +626,8 @@ class KVCacheIndex:
         self.host.note_session(
             export.get("session_id"), tuple(export.get("ids") or ())
         )
-        return {"accepted": accepted, "tokens": tokens}
+        return {"accepted": accepted, "tokens": tokens,
+                "rejected": rejected}
 
     # ------------------------------------------------------------------ #
     # Restore apply (device thread only)

@@ -1,0 +1,112 @@
+"""KV integrity framing: checksum + layout/version headers end to end.
+
+The recovery paths the fault domain leans on (host-tier restore after
+eviction, cross-replica session migration) previously trusted their
+payloads blindly: a bit flipped in host RAM between spill and restore,
+or a corrupted migration frame, restored as *silent wrong KV* — decode
+then produced confidently wrong tokens with no contained fault anywhere.
+
+This module gives every host-tier entry and every wire payload a frame:
+
+* a **CRC-32 checksum** over the raw K/V bytes, sealed at the moment the
+  data becomes host-resident (spill materialize / export pack) and
+  re-verified at every consumption (restore, import);
+* a **layout header** (``version`` / ``kind`` / per-array dtype+shape —
+  dtype doubles as the quant mode: an int8 entry IS a quantized entry)
+  checked before any byte is interpreted, so a version or quant-mode
+  mismatch between replicas rejects cleanly instead of reshaping noise.
+
+A failed check is a *contained* fault: the consumer drops the entry,
+counts ``engine.kvcache.integrity_failures`` and falls back to
+re-prefill (correct by construction — slower, never wrong). Checksums
+are integrity framing against rot and truncation, not authentication.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+KV_FRAME_VERSION = 1
+
+
+def _byte_view(a: np.ndarray) -> np.ndarray:
+    """Flat uint8 view of an array's raw bytes (copy only when the
+    dtype's buffer can't reinterpret — ml_dtypes like bfloat16 can)."""
+    b = np.ascontiguousarray(a)
+    try:
+        return b.view(np.uint8).reshape(-1)
+    except (TypeError, ValueError):
+        return np.frombuffer(b.tobytes(), np.uint8)
+
+
+def kv_checksum(arrays: Sequence[Any], crc: int = 0) -> int:
+    """CRC-32 over the concatenated raw bytes of host arrays."""
+    for a in arrays:
+        crc = zlib.crc32(_byte_view(np.asarray(a)), crc)
+    return crc & 0xFFFFFFFF
+
+
+def entry_header(arrays: Sequence[Any], kind: str) -> Dict[str, Any]:
+    """Layout/quant/version header for one entry's K/V arrays. Reads
+    only dtype/shape metadata — safe on device arrays pre-transfer."""
+    return {
+        "v": KV_FRAME_VERSION,
+        "kind": kind,
+        # dtype doubles as the quant mode: int8 panels ARE the
+        # quantized layout; bf16/f32 the unquantized one.
+        "dtype": [str(np.dtype(a.dtype)) for a in arrays],
+        "shape": [tuple(int(d) for d in a.shape) for a in arrays],
+    }
+
+
+def header_matches(
+    header: Optional[Dict[str, Any]], arrays: Sequence[Any]
+) -> bool:
+    """Does a sealed header describe these (host) arrays? False on
+    unknown version, kind-less frames, or any dtype/shape drift —
+    the caller must reject before interpreting a byte."""
+    if not isinstance(header, dict):
+        return False
+    if header.get("v") != KV_FRAME_VERSION:
+        return False
+    dtypes = header.get("dtype")
+    shapes = header.get("shape")
+    if not isinstance(dtypes, (list, tuple)) or len(dtypes) != len(arrays):
+        return False
+    if not isinstance(shapes, (list, tuple)) or len(shapes) != len(arrays):
+        return False
+    for a, dt, sh in zip(arrays, dtypes, shapes):
+        a = np.asarray(a)
+        if str(np.dtype(a.dtype)) != dt:
+            return False
+        if tuple(int(d) for d in a.shape) != tuple(int(d) for d in sh):
+            return False
+    return True
+
+
+def corrupt_arrays(arrays: Sequence[np.ndarray]) -> None:
+    """Chaos helper: flip one byte of the first non-empty array IN
+    PLACE — the canonical 'host RAM rotted' injection the
+    ``kvcache.*.corrupt`` fault points use."""
+    for a in arrays:
+        a = np.asarray(a)
+        if a.size == 0:
+            continue
+        view = a.view(np.uint8) if a.flags["C_CONTIGUOUS"] else None
+        if view is None:
+            continue
+        flat = view.reshape(-1)
+        flat[0] ^= 0xFF
+        return
+
+
+__all__ = [
+    "KV_FRAME_VERSION",
+    "kv_checksum",
+    "entry_header",
+    "header_matches",
+    "corrupt_arrays",
+]

@@ -288,6 +288,7 @@ class NativeEngine(LLMBackend):
             # (when configured) the device watchdog.
             recovery_max_attempts=self.config.reliability.recovery_max_attempts,
             watchdog_stall_s=self.config.reliability.watchdog_stall_s,
+            mesh_ladder=self.config.engine_mesh_ladder,
             batch_shed_frac=self.config.reliability.batch_shed_frac,
             degrade=DegradeLadder(
                 fault_threshold=self.config.reliability.degrade_fault_threshold,

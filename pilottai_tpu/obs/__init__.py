@@ -113,6 +113,13 @@ _gm.declare("engine.kvcache.restore_ms", "histogram")  # host-side staging
 _gm.declare("engine.kvcache.host_bytes", "gauge")
 _gm.declare("engine.kvcache.host_entries", "gauge")
 _gm.declare("engine.kvcache.sessions", "gauge")      # live session pins
+# Degraded-mesh fault domain (parallel/meshplan.py + batcher, ISSUE 16):
+# the shard-loss / re-plan / KV-integrity surface, declared at boot so a
+# dashboard can alert on the zero-valued gauges before the first loss.
+_gm.declare("engine.mesh_plan", "gauge")             # active ladder rung
+_gm.declare("engine.shard_losses", "counter")        # devices marked lost
+_gm.declare("engine.mesh_rebuild_ms", "histogram")   # re-plan → serving
+_gm.declare("engine.kvcache.integrity_failures", "counter")
 # Serving cell (distributed/cell.py + router.py, ISSUE 11): the cell
 # front door's routed/shed/affinity/migration surface. Per-class
 # routed/shed counters are declared for the DEFAULT classes here;
@@ -131,6 +138,8 @@ _gm.declare("cell.rerouted", "counter")              # fault/drain re-admits
 _gm.declare("cell.migrations", "counter")
 _gm.declare("cell.migrated_entries", "counter")
 _gm.declare("cell.migrated_tokens", "counter")
+_gm.declare("cell.migrate_rejected", "counter")      # integrity rejections
+_gm.declare("cell.degraded_replicas", "gauge")       # serving on sub-mesh
 _gm.declare("cell.migration_ms", "histogram")        # export→import wall
 _gm.declare("cell.drains", "counter")
 _gm.declare("cell.drain_s", "histogram")             # full drain wall

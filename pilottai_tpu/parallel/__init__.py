@@ -7,11 +7,21 @@ engine the way the reference's remote-API path never could. As of
 ISSUE 13 it also owns the serving KV-cache shardings
 (``kv_shard_axes``/``place_kv_cache``) and the per-axis collective-time
 attribution model (``collectives.CollectiveModel``) behind
-``engine.collective_frac[.axis]``.
+``engine.collective_frac[.axis]``. ISSUE 16 adds the degraded-mesh
+fault domain (``meshplan``): an ordered ladder of viable mesh plans the
+engine re-plans onto when a shard is lost mid-serving.
 """
 
 from pilottai_tpu.parallel.collectives import CollectiveModel, collective_ops
 from pilottai_tpu.parallel.mesh import MeshConfig, best_mesh_config, create_mesh
+from pilottai_tpu.parallel.meshplan import (
+    MeshLadderExhausted,
+    MeshPlanLadder,
+    ShardLossError,
+    classify_device_error,
+    default_ladder,
+    plan_label,
+)
 from pilottai_tpu.parallel.ring_attention import ring_attention
 from pilottai_tpu.parallel.sharding import (
     kv_shard_axes,
@@ -25,10 +35,16 @@ from pilottai_tpu.parallel.sharding import (
 __all__ = [
     "CollectiveModel",
     "MeshConfig",
+    "MeshLadderExhausted",
+    "MeshPlanLadder",
+    "ShardLossError",
     "best_mesh_config",
+    "classify_device_error",
     "collective_ops",
     "create_mesh",
+    "default_ladder",
     "kv_shard_axes",
+    "plan_label",
     "logical_to_spec",
     "place_kv_cache",
     "ring_attention",

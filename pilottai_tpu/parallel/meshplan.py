@@ -1,0 +1,324 @@
+"""Degraded-mesh planning: survive shard loss by re-planning the mesh.
+
+PR 8 contained device *failures* (rebuild + snapshot/re-admit recovery)
+and PR 12 made the serving mesh first-class — but both assume the device
+set that booted is the device set that serves. A chip or ICI failure
+inside a ``{'model':M,'data':D}`` mesh previously had no recovery path
+short of killing the replica. This module makes the **mesh plan** the
+unit of survival instead of the process:
+
+* ``MeshPlanLadder`` owns an ordered ladder of viable mesh plans for
+  the boot device set (e.g. ``{'model':4,'data':2}`` →
+  ``{'model':4,'data':1}`` → ``{'model':2,'data':1}`` → single-chip),
+  the set of devices marked lost, and a per-shard heartbeat table that
+  rides the PR 8 watchdog (a shard whose heartbeat freezes while its
+  siblings keep beating is a *loss*, not a stall).
+* ``classify_device_error`` maps a device-loop exception to the boot
+  index of the shard it names (None → not a shard loss; the generic
+  PR 8 rebuild path handles it).
+* ``replan()`` walks the ladder from the active rung down and builds a
+  ``jax.sharding.Mesh`` over the surviving devices for the first rung
+  that fits — or raises ``MeshLadderExhausted``, at which point the
+  PR 8 contract ends and in-flight requests fail with the original
+  exception.
+
+The ladder sheds replica-style axes first (``seq``, ``data``, ``fsdp``
+— capacity, not layout) and the ``model`` axis last, because dropping a
+``model`` rung changes every weight shard's layout while dropping a
+``data`` rung only shrinks the admission groups.
+
+Degradation is NOT data recovery: the KV pool resident on a lost shard
+is gone, and recovery re-prefills it from the snapshotted tokens (the
+host tier's spilled entries survive in host RAM and restore onto the
+new layout). Weight re-placement after a loss assumes the surviving
+devices can reconstruct every shard — true under simulated loss (all
+physical devices still answer) and under replicated axes; a production
+deployment that loses the only holder of a ``model`` shard must reload
+those weights from the host checkpoint first (see SERVING.md).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from pilottai_tpu.parallel.mesh import AXIS_NAMES, MeshConfig, create_mesh
+from pilottai_tpu.utils.logging import get_logger
+
+
+class MeshLadderExhausted(RuntimeError):
+    """No rung of the mesh-plan ladder fits the surviving device set."""
+
+
+class ShardLossError(RuntimeError):
+    """A device of the serving mesh failed (chip or ICI link).
+
+    Raised by the ``mesh.shard_loss`` chaos point and recognized by
+    ``classify_device_error`` — the canonical in-tree shape of a
+    per-device failure. Real backends surface device loss as free-form
+    runtime errors; the classifier's patterns cover the common ones.
+    """
+
+    def __init__(self, device_index: int, detail: str = "") -> None:
+        self.device_index = int(device_index)
+        msg = f"lost shard: device {self.device_index} failed"
+        if detail:
+            msg += f" ({detail})"
+        super().__init__(msg)
+
+
+# Deliberately narrow: a stray "device 0" inside an ordinary XLA error
+# must NOT degrade the mesh — misclassifying a transient dispatch error
+# as a shard loss permanently sheds capacity. Only phrasings that name
+# a device AND assert its failure match.
+_DEVICE_PATTERNS = (
+    re.compile(r"lost shard: device (\d+)"),
+    re.compile(r"device (\d+) (?:failed|lost|unavailable|unreachable|"
+               r"is unhealthy|not responding)", re.I),
+    re.compile(r"lost device (\d+)", re.I),
+    re.compile(r"DATA_LOSS[^0-9]*device[^0-9]*(\d+)"),
+)
+
+
+def classify_device_error(exc: BaseException) -> Optional[int]:
+    """Boot-order device index an exception names as failed, or None.
+
+    None means "not a shard loss" — the caller falls back to the
+    generic PR 8 device-loop recovery (same-mesh rebuild).
+    """
+    if isinstance(exc, ShardLossError):
+        return exc.device_index
+    text = str(exc)
+    for pat in _DEVICE_PATTERNS:
+        m = pat.search(text)
+        if m:
+            return int(m.group(1))
+    return None
+
+
+def default_ladder(plan: Dict[str, int]) -> List[Dict[str, int]]:
+    """Halving ladder from a boot plan down to single-chip.
+
+    Replica-style axes shed first (``seq`` → ``data`` → ``fsdp``: each
+    rung halves capacity but keeps every weight shard's layout), the
+    ``model`` axis last (halving it re-lays-out every parameter).
+    ``{'model':4,'data':2}`` → ``[{'model':4,'data':2},
+    {'model':4,'data':1}, {'model':2,'data':1}, {'model':1,'data':1}]``.
+    """
+    cur = {a: max(1, int(plan.get(a, 1))) for a in AXIS_NAMES}
+    rungs = [dict(cur)]
+    for axis in ("seq", "data", "fsdp"):
+        while cur[axis] > 1:
+            cur[axis] //= 2
+            rungs.append(dict(cur))
+    while cur["model"] > 1:
+        cur["model"] //= 2
+        rungs.append(dict(cur))
+    return rungs
+
+
+def _plan_devices(plan: Dict[str, int]) -> int:
+    n = 1
+    for a in AXIS_NAMES:
+        n *= max(1, int(plan.get(a, 1)))
+    return n
+
+
+def plan_label(plan: Dict[str, int]) -> str:
+    """Human shape: axes of extent 1 dropped (``model=2,data=1`` →
+    ``"model2"``; the all-ones rung is ``"single"``)."""
+    parts = [
+        f"{a}{int(plan[a])}" for a in AXIS_NAMES
+        if int(plan.get(a, 1)) > 1
+    ]
+    return "x".join(parts) if parts else "single"
+
+
+class MeshPlanLadder:
+    """Ordered mesh plans for one boot device set + loss bookkeeping.
+
+    Thread model: ``mark_lost``/``replan`` run on the batcher's device
+    thread (inside the failure arms); ``beat_all`` runs on the fold
+    path (reader thread, lock-free plain stores — same contract as the
+    watchdog's ``beat()``); ``stale``/``rung``/``plan`` are read from
+    the watchdog and metrics threads.
+    """
+
+    def __init__(
+        self,
+        mesh: Any,
+        rungs: Optional[Sequence[Dict[str, int]]] = None,
+        name: str = "engine",
+    ) -> None:
+        self._devices: List[Any] = list(mesh.devices.flat)
+        boot = {str(a): int(s) for a, s in mesh.shape.items()}
+        plans = (
+            [dict(r) for r in rungs] if rungs else default_ladder(boot)
+        )
+        # The boot plan is always rung 0 — an explicit ladder that
+        # omits it would otherwise report a degraded rung at boot.
+        if not plans or _plan_devices(plans[0]) != _plan_devices(boot) or {
+            a: int(plans[0].get(a, 1)) for a in AXIS_NAMES
+        } != {a: int(boot.get(a, 1)) for a in AXIS_NAMES}:
+            plans.insert(0, boot)
+        for p in plans:
+            if _plan_devices(p) > len(self._devices):
+                raise ValueError(
+                    f"mesh ladder rung {p} needs {_plan_devices(p)} "
+                    f"devices; boot set has {len(self._devices)}"
+                )
+        self._plans = plans
+        self._rung = 0
+        self._lost: set = set()
+        self._frozen: set = set()
+        self._exhausted = False
+        self._lock = threading.Lock()
+        self._mesh = mesh
+        self._beats: List[float] = [time.monotonic()] * len(self._devices)
+        self._log = get_logger("parallel.meshplan")
+        self.name = name
+
+    # ------------------------------------------------------------------ #
+    # Introspection
+    # ------------------------------------------------------------------ #
+
+    @property
+    def rung(self) -> int:
+        """Active ladder rung (0 = boot plan; the gauge value of
+        ``engine.mesh_plan``)."""
+        return self._rung
+
+    @property
+    def exhausted(self) -> bool:
+        return self._exhausted
+
+    @property
+    def mesh(self) -> Any:
+        """The active ``jax.sharding.Mesh`` (boot mesh until the first
+        successful ``replan``)."""
+        return self._mesh
+
+    def plan(self) -> Dict[str, int]:
+        return dict(self._plans[self._rung])
+
+    def plans(self) -> List[Dict[str, int]]:
+        return [dict(p) for p in self._plans]
+
+    def lost(self) -> List[int]:
+        with self._lock:
+            return sorted(self._lost)
+
+    def surviving(self) -> List[Any]:
+        with self._lock:
+            return [
+                d for i, d in enumerate(self._devices) if i not in self._lost
+            ]
+
+    def viable(self) -> bool:
+        """Would a ``replan()`` right now find a rung? (No mutation —
+        the failure arm asks this before deciding whether recovery or
+        fail-with-original-exception applies.)"""
+        n = len(self.surviving())
+        return n > 0 and any(
+            _plan_devices(p) <= n for p in self._plans[self._rung:]
+        )
+
+    # ------------------------------------------------------------------ #
+    # Loss bookkeeping + re-planning (device thread)
+    # ------------------------------------------------------------------ #
+
+    def mark_lost(self, device_index: int) -> None:
+        idx = int(device_index)
+        with self._lock:
+            if 0 <= idx < len(self._devices):
+                self._lost.add(idx)
+                self._frozen.discard(idx)
+
+    def replan(self) -> Any:
+        """Build a mesh over the surviving devices for the first rung
+        (from the active one down) that fits. Raises
+        ``MeshLadderExhausted`` when none does — the caller's recovery
+        contract ends and in-flight requests fail with the original
+        exception (PR 8 semantics)."""
+        surv = self.surviving()
+        with self._lock:
+            start = self._rung
+        for i in range(start, len(self._plans)):
+            p = self._plans[i]
+            need = _plan_devices(p)
+            if need > len(surv):
+                continue
+            cfg = MeshConfig.from_dict(
+                {a: int(p.get(a, 1)) for a in AXIS_NAMES}
+            )
+            # create_mesh reshapes exactly n_devices — hand it the
+            # first ``need`` survivors in boot order (deterministic,
+            # so two replicas degrading identically build identical
+            # meshes).
+            mesh = create_mesh(cfg, surv[:need])
+            with self._lock:
+                self._rung = i
+                self._mesh = mesh
+            if i != start or self._lost:
+                self._log.warning(
+                    "mesh re-planned to rung %d (%s) over %d surviving "
+                    "device(s); lost=%s", i, plan_label(p), len(surv),
+                    self.lost(),
+                )
+            return mesh
+        self._exhausted = True
+        raise MeshLadderExhausted(
+            f"no mesh rung fits {len(surv)} surviving device(s); "
+            f"ladder={[plan_label(p) for p in self._plans]}, "
+            f"lost={self.lost()}"
+        )
+
+    # ------------------------------------------------------------------ #
+    # Per-shard heartbeats (riding the PR 8 watchdog)
+    # ------------------------------------------------------------------ #
+
+    def beat_all(self) -> None:
+        """Fold-path heartbeat for every live, unfrozen shard (lock-free
+        plain stores — the watchdog contract). A fold completing proves
+        the whole active mesh answered; a *frozen* shard (the
+        ``mesh.shard_loss`` hang variant, or a real per-device probe in
+        a production backend) goes stale while its siblings keep
+        beating — the watchdog's stall hook reads ``stale()`` to tell a
+        shard loss from a whole-engine hang."""
+        now = time.monotonic()
+        frozen = self._frozen
+        lost = self._lost
+        beats = self._beats
+        for i in range(len(beats)):
+            if i not in frozen and i not in lost:
+                beats[i] = now
+
+    def freeze(self, device_index: int) -> None:
+        """Stop ``beat_all`` from refreshing one shard (chaos: a shard
+        that hangs instead of raising)."""
+        with self._lock:
+            idx = int(device_index)
+            if 0 <= idx < len(self._devices):
+                self._frozen.add(idx)
+
+    def stale(self, stall_s: float, now: Optional[float] = None) -> List[int]:
+        """Live shards whose heartbeat is older than ``stall_s``."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return [
+                i for i, t in enumerate(self._beats)
+                if i not in self._lost and now - t >= stall_s
+            ]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "rung": self._rung,
+                "plan": plan_label(self._plans[self._rung]),
+                "plans": [plan_label(p) for p in self._plans],
+                "lost": sorted(self._lost),
+                "devices": len(self._devices),
+                "exhausted": self._exhausted,
+            }

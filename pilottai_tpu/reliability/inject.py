@@ -30,6 +30,19 @@ Canonical points wired in-tree (callers may add more; names are free-form):
 ``agent.heartbeat.stall``    ``FaultTolerance._assess`` consumes ``value=``
                              seconds of injected heartbeat staleness
 ``checkpoint.write``         ``TaskJournal`` append (disk-full simulation)
+``mesh.shard_loss``          a serving-mesh device fails mid-decode —
+                             ``value=`` the boot-order device index (the
+                             dispatch raises ``ShardLossError``); a dict
+                             ``{"device": i, "hang": True}`` freezes that
+                             shard's heartbeat instead (the watchdog-path
+                             detector's target)
+``kvcache.spill.corrupt``    flips a byte of a host-tier entry AFTER its
+                             CRC sealed (host-RAM rot between spill and
+                             restore) — restore must detect + re-prefill
+``kvcache.restore.corrupt``  same rot, injected at the restore site
+                             (``KVCacheIndex._entry_ok``)
+``cell.migrate.corrupt``     flips a byte of a migration wire payload —
+                             the import must reject it cleanly
 ===========================  =============================================
 
 Triggering is count-based (``times=N`` fires, then auto-disarm; ``times=None``
@@ -37,6 +50,15 @@ fires until disarmed) and/or probability-based (``probability=p`` with a
 seeded per-registry RNG, so chaos soaks are reproducible). Fires are
 counted per point (``fired(name)``) and in ``global_metrics`` under
 ``fault.injected.<name>``.
+
+Thread safety: ``fire()`` is called concurrently from the batcher's
+prep, device and reader threads. Every counter transition — the
+``skip=N`` countdown, the probability draw, the ``fired`` increment and
+the ``times`` auto-disarm — happens under ONE registry lock, so an
+``arm(times=1, skip=2)`` fires exactly once after exactly two passes no
+matter how many threads race the point (pinned by
+tests/test_kv_integrity.py's hammer). Only the not-armed fast path and
+the post-decision effects (metrics, sleep, raise) run lock-free.
 """
 
 from __future__ import annotations
@@ -57,7 +79,12 @@ ExcSpec = Union[BaseException, Type[BaseException]]
 class Fault:
     """An armed failure point. ``exc``/``delay``/``value`` compose: a fire
     sleeps ``delay`` first, then raises ``exc`` (if set), else returns
-    ``value`` to the consuming site."""
+    ``value`` to the consuming site.
+
+    The mutable counters (``skip``, ``fired``) are transitioned ONLY
+    under the owning registry's lock — test code may read them freely
+    (torn reads of an int are impossible in CPython) but must never
+    write them while the point is armed."""
 
     name: str
     exc: Optional[ExcSpec] = None
@@ -121,7 +148,19 @@ class FaultInjector:
             self._fired.clear()
 
     def armed(self, name: str) -> bool:
+        # Lock-free read (CPython dict membership is atomic) — same
+        # contract as fire()'s fast path: a one-call-late answer is
+        # fine, a lock on every probe is not.
         return name in self._faults
+
+    def remaining(self, name: str) -> Optional[int]:
+        """Fires left before auto-disarm (None = unlimited or not
+        armed) — chaos-soak introspection."""
+        with self._lock:
+            fault = self._faults.get(name)
+            if fault is None or fault.times is None:
+                return None
+            return max(0, fault.times - fault.fired)
 
     def fired(self, name: str) -> int:
         """Times ``name`` actually triggered (survives auto-disarm)."""

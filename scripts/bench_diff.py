@@ -58,6 +58,11 @@ HIGHER_BETTER = (
     "tokens_saved",
     # MULTICHIP section (ISSUE 13): sharded-vs-single-device scaling.
     "per_chip_efficiency", "total_speedup",
+    # CHAOS section (ISSUE 16): invariant holds are up-good — probe
+    # waves matching the clean reference, injected rot detected, the
+    # post-soak migration landing every entry.
+    "byte_identity", "identical_waves", "corruptions_detected",
+    "export_completeness",
 )
 LOWER_BETTER = (
     "overhead_frac", "straggler_frac", "p50", "p90", "p99", "host_gap",
@@ -76,6 +81,10 @@ LOWER_BETTER = (
     # bytes_per_token_int4_vs_int8 / quant_bytes_per_token_ratio
     # headlines.
     "weight_bytes", "bytes_per_token",
+    # CHAOS section (ISSUE 16): permanent capacity shed, undetected-rot
+    # exposure and wedged work are all cost (client_errors matches
+    # "errors" above; recovered_frac is already up-good).
+    "shard_losses", "integrity_failures", "stuck_flights", "mesh_rungs",
 )
 
 
@@ -154,7 +163,7 @@ def _from_tail(tail: str) -> Dict[str, Any]:
     doc: Dict[str, Any] = {}
     remainder = tail
     for block in ("models", "SLO", "phases", "KVCACHE", "CELL", "SCHED",
-                  "MULTICHIP", "QUANT"):
+                  "MULTICHIP", "QUANT", "CHAOS"):
         marker = f'"{block}": '
         at = remainder.find(marker)
         if at < 0:
@@ -201,7 +210,7 @@ def _sections(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     out: Dict[str, Dict[str, Any]] = {"top": {}}
     for key, value in doc.items():
         if key in ("models", "SLO", "phases", "RECOVERY", "KVCACHE",
-                   "CELL", "SCHED", "MULTICHIP", "QUANT"):
+                   "CELL", "SCHED", "MULTICHIP", "QUANT", "CHAOS"):
             continue
         num = _numeric(value)
         if num is not None:
@@ -278,6 +287,16 @@ def _sections(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
                     k: n for k, v in block.items()
                     if (n := _numeric(v)) is not None
                 }
+    chaos = doc.get("CHAOS")
+    if isinstance(chaos, dict):
+        # Invariant scalars (recovered_frac, identical_waves,
+        # stuck_flights, corruptions detected vs injected, shard
+        # losses); the per-round injection schedule is a list and
+        # stays out of the numeric diff.
+        out["chaos"] = {
+            k: n for k, v in chaos.items()
+            if (n := _numeric(v)) is not None
+        }
     for name, block in (doc.get("models") or {}).items():
         if isinstance(block, dict):
             out[f"models.{name}"] = {

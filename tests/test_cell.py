@@ -499,7 +499,9 @@ def test_session_kv_wire_roundtrip():
     # JSON wire round-trip (the control-plane shape).
     wire = json.loads(json.dumps(session_kv_to_wire(export)))
     restored = session_kv_from_wire(wire)
-    assert dst.import_session(restored) == {"accepted": 1, "tokens": 70}
+    assert dst.import_session(restored) == {
+        "accepted": 1, "tokens": 70, "rejected": 0,
+    }
     entry = dst.host.get(key)
     assert entry is not None
     hk, hv = entry.copy.wait()
@@ -698,3 +700,102 @@ def test_paged_chain_migration_restores_on_target():
     finally:
         a.stop()
         b.stop()
+
+
+# --------------------------------------------------------------------- #
+# Degraded-mesh awareness (ISSUE 16): routing, rebalance runbook,
+# corrupted migration frames
+# --------------------------------------------------------------------- #
+
+def test_router_down_scores_degraded_mesh_rung():
+    """Same load, one replica on a survivor sub-mesh: fresh work routes
+    to the intact sibling; a degraded replica is still a last resort
+    (routable, never excluded — capacity at a worse rung beats a
+    shed)."""
+    r = ReplicaRouter()
+    sigs = [_sig("full"), _sig("deg", mesh_rung=1)]
+    assert {r.pick((1, 2, 3), sigs)[0] for _ in range(6)} == {"full"}
+    # Alone, the degraded replica still serves.
+    rid, _ = r.pick((1, 2, 3), [_sig("deg", mesh_rung=2)])
+    assert rid == "deg"
+    # The rung is a penalty, not a gate: a mildly degraded idle replica
+    # outranks an intact one drowning in queue.
+    sigs = [_sig("busy", queue_frac=0.9), _sig("deg", mesh_rung=1)]
+    assert r.pick((1, 2, 3), sigs)[0] == "deg"
+
+
+@pytest.mark.asyncio
+async def test_degraded_replica_rebalance_runbook():
+    """The drain-then-restore runbook end to end on mock replicas:
+    a replica degrades (mesh_rung > 0 in its signals) → the gauge and
+    router see it → ``rebalance_degraded`` migrates its pinned sessions
+    onto the intact sibling → the replica rebuilds at full mesh → the
+    next rebalance is a no-op and the cell reads fully intact again."""
+    cell = _mock_cell(n=2)
+    await cell.start()
+    try:
+        await cell.apredict("runbook turn one", session_id="s-rb")
+        owner = cell.sessions["s-rb"]
+        other = next(r for r in cell.replicas if r != owner)
+        # Degrade the owner: its engine now reports a survivor rung.
+        cell.replicas[owner].handler.backend.routing_signals = (
+            lambda: {"mesh_rung": 1}
+        )
+        assert cell.replicas[owner].signals().mesh_rung == 1
+        report = await cell.rebalance_degraded()
+        assert report["degraded"] == [owner]
+        assert report["moved"] == 1
+        assert cell.sessions["s-rb"] == other
+        assert global_metrics.get("cell.degraded_replicas") == 1.0
+        # Fresh sessions avoid the degraded replica while it lasts.
+        await cell.apredict("fresh while degraded", session_id="s-rb2")
+        assert cell.sessions["s-rb2"] == other
+        # Replica rebuilt at full mesh: rung back to 0, cell intact.
+        del cell.replicas[owner].handler.backend.routing_signals
+        report2 = await cell.rebalance_degraded()
+        assert report2["degraded"] == [] and report2["moved"] == 0
+        assert global_metrics.get("cell.degraded_replicas") == 0.0
+    finally:
+        await cell.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.asyncio
+async def test_corrupted_migration_frame_rejected_byte_identical(
+    reference_turns,
+):
+    """cell.migrate.corrupt rots the wire frame mid-migration: the
+    import rejects every entry (counted under cell.migrate_rejected +
+    integrity_failures), NO corrupt KV lands on the target — and the
+    session's next turn re-prefills there to byte-identical output."""
+    from pilottai_tpu.reliability.inject import global_injector
+
+    cell = ServingCell([LLMHandler(_engine_cfg()) for _ in range(2)])
+    await cell.start()
+    try:
+        p = GenerationParams(**GREEDY)
+        r1 = await cell.apredict(TURN1, params=p, session_id="s")
+        fails0 = global_metrics.get("engine.kvcache.integrity_failures")
+        global_injector.arm("cell.migrate.corrupt", value=True, times=1)
+        try:
+            report = await cell.migrate_session("s")
+        finally:
+            global_injector.reset()
+        assert report["entries"] >= 1
+        assert report["accepted"] == 0
+        assert report["rejected"] == report["entries"]
+        assert global_metrics.get("cell.migrate_rejected") >= 1
+        assert (
+            global_metrics.get("engine.kvcache.integrity_failures")
+            > fails0
+        )
+        r2 = await cell.apredict(
+            TURN1 + r1 + " user: second step?", params=p, session_id="s"
+        )
+        assert cell.sessions["s"] == report["to"]
+        assert (r1, r2) == reference_turns, (
+            "rejected migration changed greedy output"
+        )
+    finally:
+        await cell.stop()

@@ -1,0 +1,223 @@
+"""Degraded-mesh serving acceptance (ISSUE 16 tentpole).
+
+The engine-integrated half of tests/test_meshplan.py: kill a device of
+a ``{'model':2,'data':2}`` mesh mid-decode and pin the contract —
+the engine re-plans onto the survivor sub-mesh (default ladder rung
+``model2``), re-places weights/KV, drains the in-flight requests
+through snapshot/re-admit, and greedy output stays byte-identical.
+Fast tests cover the raise variant, the hang variant (per-shard
+heartbeat triage riding the PR 8 watchdog), and the ladder-exhausted
+contract (in-flight requests fail with the ORIGINAL exception). The
+slow matrix certifies byte-identity across dense/paged × spec ×
+int8-KV/int4-weights, same shape as tests/test_multichip.py.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pilottai_tpu.engine.batcher import ContinuousBatcher, GenRequest
+from pilottai_tpu.models.common import init_params
+from pilottai_tpu.models.registry import get_model_config
+from pilottai_tpu.parallel.mesh import MeshConfig, create_mesh
+from pilottai_tpu.reliability.inject import global_injector
+from pilottai_tpu.utils.metrics import global_metrics
+
+MESH = {"model": 2, "data": 2}
+
+
+def _mesh(shape=None):
+    return create_mesh(MeshConfig.from_dict(shape or MESH))
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    global_injector.reset()
+    yield
+    global_injector.reset()
+
+
+def _batcher(**overrides):
+    cfg = get_model_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    kwargs = dict(
+        n_slots=2, max_seq_len=64, cache_dtype=jnp.float32, chunk_size=4,
+        mesh=_mesh(MESH), recovery_max_attempts=2, use_pallas=False,
+    )
+    kwargs.update(overrides)
+    return ContinuousBatcher(cfg, params, **kwargs)
+
+
+def _wave(b, max_new=12, timeout=300):
+    prompts = [[3, 4, 5], [6, 7]]
+    futs = [
+        b.submit(GenRequest(prompt_ids=list(p), max_new_tokens=max_new))
+        for p in prompts
+    ]
+    return [f.result(timeout=timeout) for f in futs]
+
+
+# --------------------------------------------------------------------- #
+# The acceptance bar — shard loss mid-decode, byte-identical. Real
+# 4-device engines on the shared-core virtual platform are minutes of
+# wall each, so these live in the chaos CI lane (slow+chaos), keeping
+# tier-1 at its seed runtime; the pure ladder logic stays in tier-1
+# via test_meshplan.py.
+# --------------------------------------------------------------------- #
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_shard_loss_mid_decode_replans_byte_identical():
+    """Device 1 of {'model':2,'data':2} raises mid-decode (skip=1: the
+    SECOND dispatch, so the wave is genuinely in flight): the engine
+    classifies the loss, re-plans to the model2 rung over the three
+    survivors, re-places weights/KV, re-admits from snapshots — and the
+    greedy output matches the unfaulted run byte for byte while every
+    degradation gauge tells the truth."""
+    b = _batcher()
+    b.start()
+    try:
+        ref = _wave(b)
+        losses = global_metrics.get("engine.shard_losses")
+        global_injector.arm("mesh.shard_loss", value=1, times=1, skip=1)
+        got = _wave(b)
+        assert got == ref
+        assert global_injector.fired("mesh.shard_loss") == 1
+
+        ladder = b._mesh_ladder
+        assert ladder is not None
+        assert ladder.rung == 1
+        assert ladder.lost() == [1]
+        assert global_metrics.get("engine.shard_losses") == losses + 1
+        assert global_metrics.get("engine.mesh_plan") == 1.0
+
+        mesh = b.get_metrics()["mesh"]
+        assert mesh["rung"] == 1
+        assert mesh["plan"] == "model2"
+        assert mesh["lost_devices"] == [1]
+        assert mesh["n_chips"] == 2
+        # The degraded rung rides routing_signals into the cell router.
+        assert b.routing_signals()["mesh_rung"] == 1
+
+        # The degraded engine keeps serving correctly after the drain.
+        assert _wave(b) == ref
+    finally:
+        b.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_hung_shard_detected_by_heartbeat_triage():
+    """The hang variant: the shard stops answering WITHOUT raising, and
+    the engine itself stays healthy — only the per-shard heartbeat
+    (fold-path ``beat_all`` vs the watchdog's staleness bar) tells a
+    frozen shard from its beating siblings and triggers the re-plan."""
+    b = _batcher(watchdog_stall_s=0.5)
+    b.start()
+    try:
+        ref = _wave(b)
+        global_injector.arm(
+            "mesh.shard_loss",
+            value={"hang": True, "device": 2},
+            times=1, skip=1,
+        )
+        assert _wave(b) == ref  # freezing the stamp wedges nothing
+        time.sleep(0.8)  # let the frozen stamp cross the staleness bar
+        ladder = b._mesh_ladder
+        deadline = time.monotonic() + 30
+        while ladder.rung == 0 and time.monotonic() < deadline:
+            _wave(b, max_new=4)  # folds run the triage
+            time.sleep(0.05)
+        assert ladder.rung == 1
+        assert ladder.lost() == [2]
+        assert _wave(b) == ref
+    finally:
+        b.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_ladder_exhausted_fails_inflight_with_original_exception():
+    """A one-rung ladder (boot plan only) has nowhere to go after a
+    loss: the recovery contract ends and the in-flight requests fail
+    with the ORIGINAL shard-loss exception — no silent retry loop, no
+    wrong-layout serving."""
+    b = _batcher(mesh_ladder=[{"model": 2, "data": 2}])
+    b.start()
+    try:
+        _wave(b)  # healthy first
+        global_injector.arm("mesh.shard_loss", value=0, times=1, skip=1)
+        futs = [
+            b.submit(GenRequest(prompt_ids=[3, 4, 5], max_new_tokens=12)),
+            b.submit(GenRequest(prompt_ids=[6, 7], max_new_tokens=12)),
+        ]
+        for f in futs:
+            with pytest.raises(RuntimeError, match="lost shard: device 0"):
+                f.result(timeout=300)
+        ladder = b._mesh_ladder
+        assert ladder.lost() == [0]
+        assert not ladder.viable()
+    finally:
+        b.stop()
+
+
+def test_mesh_ladder_off_disables_the_fault_domain():
+    """mesh_ladder='off': no ladder, no mesh-rung gauges — the PR 8
+    same-mesh rebuild is the only recovery (the pre-ISSUE 16 engine)."""
+    b = _batcher(mesh_ladder="off")
+    try:
+        assert b._mesh_ladder is None
+        assert "rung" not in b.get_metrics()["mesh"]
+        assert b.routing_signals()["mesh_rung"] == 0
+    finally:
+        b.stop()
+
+
+# --------------------------------------------------------------------- #
+# Slow: byte-identity matrix on the degraded path (chaos CI lane)
+# --------------------------------------------------------------------- #
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize(
+    "paged,speculate,kv_int8,weight_quant",
+    [
+        (False, 0, False, None),
+        (False, 4, True, None),
+        (True, 0, True, None),
+        (True, 4, False, None),
+        (False, 4, False, "int4"),
+        (True, 0, False, "int4"),
+    ],
+    ids=[
+        "dense", "dense-spec-int8kv", "paged-int8kv", "paged-spec",
+        "dense-spec-int4", "paged-int4",
+    ],
+)
+@pytest.mark.asyncio
+async def test_degraded_greedy_byte_identity_matrix(
+    paged, speculate, kv_int8, weight_quant,
+):
+    """Shard loss mid-decode across every cache/speculation/quant
+    combination the serving path has: greedy output on the degraded
+    engine byte-identical to the unfaulted sharded run."""
+    from tests.test_multichip import _generate_all
+
+    ref = await _generate_all(
+        MESH, paged=paged, speculate=speculate, kv_int8=kv_int8,
+        weight_quant=weight_quant,
+    )
+    losses = global_metrics.get("engine.shard_losses")
+    global_injector.arm("mesh.shard_loss", value=1, times=1, skip=1)
+    try:
+        got = await _generate_all(
+            MESH, paged=paged, speculate=speculate, kv_int8=kv_int8,
+            weight_quant=weight_quant,
+        )
+    finally:
+        global_injector.reset()
+    assert got == ref
+    assert any(s for s in ref)
+    assert global_metrics.get("engine.shard_losses") == losses + 1

@@ -1,0 +1,204 @@
+"""KV integrity framing end to end (ISSUE 16 tentpole).
+
+Unit half: the CRC/header primitives in engine/kvcache/integrity.py.
+Engine half: a corrupted host-tier entry (spill-time and restore-time
+chaos points) is DETECTED — counted under
+``engine.kvcache.integrity_failures`` — and the session re-prefills to
+byte-identical output instead of decoding silent wrong KV. Wire half:
+a tampered migration frame rejects cleanly at import. Also home of the
+injector thread-safety hammer the inject.py docstring points at.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from pilottai_tpu.distributed.cell import (
+    corrupt_wire_payload,
+    session_kv_from_wire,
+    session_kv_to_wire,
+)
+from pilottai_tpu.engine.kvcache.index import KVCacheIndex
+from pilottai_tpu.engine.kvcache.integrity import (
+    KV_FRAME_VERSION,
+    corrupt_arrays,
+    entry_header,
+    header_matches,
+    kv_checksum,
+)
+from pilottai_tpu.reliability.inject import global_injector
+from pilottai_tpu.utils.metrics import global_metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    global_injector.reset()
+    yield
+    global_injector.reset()
+
+
+def _arrays(seed=0, n=48):
+    rng = np.random.RandomState(seed)
+    ks = rng.randn(2, 2, n, 4).astype(np.float32)
+    vs = rng.randn(2, 2, n, 4).astype(np.float32)
+    return ks, vs
+
+
+# --------------------------------------------------------------------- #
+# Unit: the framing primitives
+# --------------------------------------------------------------------- #
+
+def test_kv_checksum_detects_single_byte_flip():
+    ks, vs = _arrays()
+    crc = kv_checksum([ks, vs])
+    assert crc == kv_checksum([ks.copy(), vs.copy()])  # content, not id
+    corrupt_arrays([vs])
+    assert kv_checksum([ks, vs]) != crc
+
+
+def test_entry_header_round_trip_and_drift():
+    ks, vs = _arrays()
+    h = entry_header([ks, vs], kind="dense")
+    assert h["v"] == KV_FRAME_VERSION and h["kind"] == "dense"
+    assert header_matches(h, [ks, vs])
+    # dtype doubles as the quant mode: an int8 panel against a float32
+    # header is a quant-mode mismatch, not a reshape opportunity.
+    assert not header_matches(h, [ks.astype(np.int8), vs])
+    assert not header_matches(h, [ks[:, :, :24], vs])  # shape drift
+    assert not header_matches(h, [ks])  # arity drift
+    assert not header_matches({**h, "v": KV_FRAME_VERSION + 1}, [ks, vs])
+    assert not header_matches(None, [ks, vs])
+
+
+def test_corrupt_arrays_flips_first_nonempty_in_place():
+    ks, vs = _arrays()
+    empty = np.zeros((0,), np.float32)
+    before = ks.copy()
+    corrupt_arrays([empty, ks, vs])
+    assert not np.array_equal(ks, before)  # skipped the empty one
+    assert (ks.view(np.uint8).reshape(-1) != before.view(
+        np.uint8).reshape(-1)).sum() == 1  # exactly one byte
+
+
+def test_host_tier_entry_sealed_at_spill():
+    idx = KVCacheIndex(host_bytes=1 << 20)
+    ks, vs = _arrays(n=48)
+    key = tuple(range(48))
+    assert idx.host.put(key, (ks, vs), tokens=48, rows=48, kind="dense")
+    e = idx.host.get(key)
+    assert header_matches(e.header, e.copy.wait())
+    assert e.copy.verify()
+    # Rot the host-resident bytes: the sealed digest catches it.
+    corrupt_arrays(list(e.copy.wait()))
+    assert not e.copy.verify()
+
+
+# --------------------------------------------------------------------- #
+# Wire: tampered or mismatched migration frames reject cleanly
+# --------------------------------------------------------------------- #
+
+def _export_one(session="sess-i"):
+    src = KVCacheIndex(host_bytes=1 << 20)
+    ks, vs = _arrays(seed=3, n=70)
+    key = tuple(range(70, 140))
+    assert src.host.put(key, (ks, vs), tokens=70, rows=70, kind="dense")
+    src.host.note_session(session, key + (7, 8))
+    export = src.export_session(session)
+    assert export is not None
+    return export
+
+
+def test_wire_tamper_rejected_at_import():
+    export = _export_one()
+    wire = json.loads(json.dumps(session_kv_to_wire(export)))
+    assert corrupt_wire_payload(wire)
+    fails = global_metrics.get("engine.kvcache.integrity_failures")
+    dst = KVCacheIndex(host_bytes=1 << 20)
+    got = dst.import_session(session_kv_from_wire(wire))
+    assert got == {"accepted": 0, "tokens": 0, "rejected": 1}
+    assert len(dst.host) == 0  # nothing restored from the rotten frame
+    assert (
+        global_metrics.get("engine.kvcache.integrity_failures") == fails + 1
+    )
+
+
+def test_wire_version_mismatch_raises():
+    wire = session_kv_to_wire(_export_one())
+    wire["v"] = KV_FRAME_VERSION + 1
+    with pytest.raises(ValueError, match="version"):
+        session_kv_from_wire(wire)
+
+
+def test_import_rejects_header_drift():
+    """A frame whose header disagrees with its arrays (quant-mode or
+    layout skew between replicas) rejects before interpreting bytes."""
+    export = _export_one()
+    export["entries"][0]["header"]["dtype"] = ["int8", "int8"]
+    dst = KVCacheIndex(host_bytes=1 << 20)
+    got = dst.import_session(export)
+    assert got["accepted"] == 0 and got["rejected"] == 1
+
+
+# --------------------------------------------------------------------- #
+# Engine: corruption detected, session re-prefills byte-identical
+# --------------------------------------------------------------------- #
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize(
+    "point", ["kvcache.spill.corrupt", "kvcache.restore.corrupt"],
+    ids=["spill", "restore"],
+)
+def test_corrupted_host_entry_reprefills_byte_identical(point):
+    """The PR 9 spill→evict→restore sequence with host RAM rot injected
+    at the named point: the frame check catches it, the entry drops,
+    ``integrity_failures`` counts it — and the resumed session falls
+    back to re-prefill, so output matches the clean run byte for byte
+    (slower, never wrong)."""
+    from tests.test_multichip import _run_session_seq
+
+    clean = _run_session_seq(None, paged=False)
+    fails = global_metrics.get("engine.kvcache.integrity_failures")
+    global_injector.arm(point, value=True, times=1)
+    try:
+        got = _run_session_seq(None, paged=False)
+        fired = global_injector.fired(point)
+    finally:
+        global_injector.reset()
+    assert got == clean
+    assert fired == 1
+    assert (
+        global_metrics.get("engine.kvcache.integrity_failures") >= fails + 1
+    )
+
+
+# --------------------------------------------------------------------- #
+# Injector thread-safety hammer (referenced by inject.py's docstring)
+# --------------------------------------------------------------------- #
+
+@pytest.mark.chaos
+def test_injector_skip_and_times_exact_under_contention():
+    """arm(times=1, skip=2): exactly one fire at the THIRD call site
+    crossing, no matter how many threads race the counters."""
+    global_injector.arm("test.hammer", value="hit", times=1, skip=2)
+    hits, lock = [], threading.Lock()
+    start = threading.Barrier(8)
+
+    def worker():
+        start.wait()
+        for _ in range(10):
+            got = global_injector.fire("test.hammer")
+            if got is not None:
+                with lock:
+                    hits.append(got)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert hits == ["hit"]
+    assert global_injector.fired("test.hammer") == 1
+    assert not global_injector.armed("test.hammer")

@@ -1,0 +1,174 @@
+"""Degraded-mesh planning unit tests (ISSUE 16 tentpole, fast lane).
+
+Pure ladder/classifier arithmetic on the virtual 8-device CPU mesh
+(tests/conftest.py) — no engine boot. The engine-integrated shard-loss
+acceptance lives in tests/test_degraded_mesh.py.
+"""
+
+import threading
+import time
+
+import pytest
+
+from pilottai_tpu.parallel.mesh import MeshConfig, create_mesh
+from pilottai_tpu.parallel.meshplan import (
+    MeshLadderExhausted,
+    MeshPlanLadder,
+    ShardLossError,
+    classify_device_error,
+    default_ladder,
+    plan_label,
+)
+
+
+def _mesh(shape):
+    return create_mesh(MeshConfig.from_dict(shape))
+
+
+# --------------------------------------------------------------------- #
+# Ladder construction
+# --------------------------------------------------------------------- #
+
+def test_default_ladder_sheds_replica_axes_before_model():
+    """{'model':4,'data':2} halves data first (capacity), model last
+    (layout) — the documented shed order."""
+    rungs = default_ladder({"model": 4, "data": 2})
+    assert [(r["model"], r["data"]) for r in rungs] == [
+        (4, 2), (4, 1), (2, 1), (1, 1),
+    ]
+
+
+def test_default_ladder_single_chip_is_identity():
+    assert default_ladder({}) == [
+        {"data": 1, "fsdp": 1, "model": 1, "seq": 1}
+    ]
+
+
+def test_plan_label_drops_unit_axes():
+    assert plan_label({"model": 2, "data": 2}) == "data2xmodel2"
+    assert plan_label({"model": 2, "data": 1}) == "model2"
+    assert plan_label({"model": 1}) == "single"
+
+
+def test_boot_plan_always_rung_zero():
+    """An explicit ladder that omits the boot plan gets it inserted at
+    rung 0 — otherwise a fresh engine would report degraded at boot."""
+    ladder = MeshPlanLadder(
+        _mesh({"model": 2, "data": 2}), rungs=[{"model": 2}]
+    )
+    assert ladder.rung == 0
+    assert plan_label(ladder.plan()) == "data2xmodel2"
+    assert [plan_label(p) for p in ladder.plans()] == [
+        "data2xmodel2", "model2",
+    ]
+
+
+def test_oversized_rung_rejected():
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        MeshPlanLadder(
+            _mesh({"model": 2, "data": 2}),
+            rungs=[{"model": 2, "data": 2}, {"model": 4, "data": 4}],
+        )
+
+
+# --------------------------------------------------------------------- #
+# Error classification
+# --------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("exc,want", [
+    (ShardLossError(3), 3),
+    (RuntimeError("lost shard: device 2 failed"), 2),
+    (RuntimeError("device 5 unavailable during collective"), 5),
+    (RuntimeError("Lost device 1 (ICI link down)"), 1),
+    (RuntimeError("DATA_LOSS: device 7 returned garbage"), 7),
+    # Narrow on purpose: naming a device is not asserting its failure.
+    (RuntimeError("XLA compile error on device 0"), None),
+    (RuntimeError("out of memory"), None),
+    (ValueError("device 3"), None),
+])
+def test_classify_device_error(exc, want):
+    assert classify_device_error(exc) == want
+
+
+# --------------------------------------------------------------------- #
+# Loss bookkeeping + replan
+# --------------------------------------------------------------------- #
+
+def test_replan_walks_ladder_to_first_fitting_rung():
+    ladder = MeshPlanLadder(_mesh({"model": 2, "data": 2}))
+    assert ladder.viable()
+    ladder.mark_lost(1)
+    assert ladder.lost() == [1]
+    assert len(ladder.surviving()) == 3
+    assert ladder.viable()
+    mesh = ladder.replan()
+    # 3 survivors can't fit the 4-device boot rung; first fit is model2.
+    assert ladder.rung == 1
+    assert plan_label(ladder.plan()) == "model2"
+    assert mesh.devices.size == 2
+    snap = ladder.snapshot()
+    assert snap["rung"] == 1 and snap["lost"] == [1]
+    assert not snap["exhausted"]
+
+
+def test_replan_is_monotonic_down_the_ladder():
+    """Rungs never climb back up: after degrading to model2, a further
+    loss continues the walk from the active rung."""
+    ladder = MeshPlanLadder(_mesh({"model": 2, "data": 2}))
+    ladder.mark_lost(0)
+    ladder.replan()
+    assert ladder.rung == 1
+    ladder.mark_lost(2)
+    ladder.mark_lost(3)
+    ladder.replan()
+    assert plan_label(ladder.plan()) == "single"
+    assert ladder.mesh.devices.size == 1
+
+
+def test_ladder_exhausted_raises_and_sets_flag():
+    ladder = MeshPlanLadder(
+        _mesh({"model": 2, "data": 2}), rungs=[{"model": 2, "data": 2}]
+    )
+    ladder.mark_lost(2)
+    assert not ladder.viable()
+    with pytest.raises(MeshLadderExhausted, match="lost=\\[2\\]"):
+        ladder.replan()
+    assert ladder.exhausted
+
+
+# --------------------------------------------------------------------- #
+# Per-shard heartbeats
+# --------------------------------------------------------------------- #
+
+def test_frozen_shard_goes_stale_while_siblings_beat():
+    ladder = MeshPlanLadder(_mesh({"model": 2, "data": 2}))
+    ladder.freeze(2)
+    time.sleep(0.02)
+    ladder.beat_all()
+    assert ladder.stale(0.01) == [2]
+    # Marking it lost removes it from the stale set (it's accounted).
+    ladder.mark_lost(2)
+    assert ladder.stale(0.01) == []
+
+
+def test_beat_all_is_safe_under_concurrent_freeze():
+    """beat_all is lock-free by contract (fold path); hammer it against
+    freeze/mark_lost from another thread."""
+    ladder = MeshPlanLadder(_mesh({"model": 2, "data": 2}))
+    stop = threading.Event()
+
+    def beater():
+        while not stop.is_set():
+            ladder.beat_all()
+
+    t = threading.Thread(target=beater)
+    t.start()
+    try:
+        for i in range(4):
+            ladder.freeze(i % 4)
+            ladder.mark_lost(i % 4)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert not t.is_alive()
+    assert ladder.lost() == [0, 1, 2, 3]
