@@ -35,7 +35,7 @@ from pilottai_tpu.core.config import (
     ServeConfig,
 )
 
-__version__ = "0.16.0"  # kept in lockstep with pyproject.toml
+__version__ = "0.17.0"  # kept in lockstep with pyproject.toml
 
 # Lazy top-level exports; entries are added as the corresponding modules
 # land so the advertised API never points at missing modules.

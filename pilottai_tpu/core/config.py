@@ -579,6 +579,17 @@ class ScalingConfig(BaseModel):
     # runs without a shed limit (engine.max_queue_depth gauge absent):
     # this many queued-not-admitted requests read as 100% queue pressure.
     queue_depth_ref: int = Field(default=64, ge=1)
+    # Predictive autoscaling (obs/forecast.py): when the seasonal
+    # arrival forecaster has a full period of history, the load signal
+    # is boosted by forecast(now + forecast_lead_s) / current rate —
+    # capacity moves BEFORE the predicted ramp arrives instead of after
+    # burn rate crosses 1. Boost-only (a predicted lull never shrinks
+    # early) and capped at forecast_boost_cap so a cold forecaster or a
+    # spiky trace can't slam the pool to max. No-op until the forecaster
+    # is ready, so enabling it is safe on day one.
+    forecast_enabled: bool = True
+    forecast_lead_s: float = Field(default=120.0, ge=0)
+    forecast_boost_cap: float = Field(default=2.0, ge=1.0)
 
 
 class FaultToleranceConfig(BaseModel):

@@ -3663,6 +3663,13 @@ class ContinuousBatcher:
                 obs = accepted / active_blocks
                 obs = min(max(obs, 0.5), float(D))
                 self._spec_rate = 0.5 * self._spec_rate + 0.5 * obs
+                # Exported as a 0..1 acceptance fraction (EMA tokens
+                # per block over the draft depth) — the workload
+                # fingerprint reads this back to characterize how
+                # speculation-friendly the traffic is (obs/profile.py).
+                global_metrics.set_gauge(
+                    "engine.spec_acceptance", self._spec_rate / float(D)
+                )
         global_metrics.inc("engine.generated_tokens_device", accepted)
         # Host-gap bookkeeping: this chunk has left the pipeline; the
         # next dispatch measures its bubble from here.

@@ -317,7 +317,8 @@ class LLMHandler:
         trace_id, flight_id = params.trace_id, params.flight_id
         global_flight.start(
             flight_id, trace_id=trace_id, model=self.config.model_name,
-            slo_class=params.slo_class, **self._dag_context(),
+            slo_class=params.slo_class, session_id=params.session_id,
+            **self._dag_context(),
         )
 
         deadline = params.deadline
@@ -423,6 +424,14 @@ class LLMHandler:
                 )
                 global_metrics.inc(
                     "engine.completion_tokens", response.usage.completion_tokens
+                )
+                # Length shape for the workload profiler: the usage
+                # envelope is the only place prompt length is known, and
+                # start() is the idempotent attribute-merge hook.
+                global_flight.start(
+                    flight_id,
+                    prompt_tokens=response.usage.prompt_tokens,
+                    completion_tokens=response.usage.completion_tokens,
                 )
                 # Backends with no token visibility (mock, custom): model
                 # the tokens over the call envelope so TTFT/TPOT
@@ -542,7 +551,8 @@ class LLMHandler:
         global_flight.start(
             flight_id, trace_id=trace_id,
             model=self.config.model_name, stream=True,
-            slo_class=params.slo_class, **self._dag_context(),
+            slo_class=params.slo_class, session_id=params.session_id,
+            **self._dag_context(),
         )
 
         deadline = params.deadline
@@ -662,6 +672,13 @@ class LLMHandler:
                             info.get("completion_tokens"), int
                         ):
                             n_tok = info["completion_tokens"]
+                        if info is not None and isinstance(
+                            info.get("prompt_tokens"), int
+                        ):
+                            global_flight.start(
+                                flight_id,
+                                prompt_tokens=info["prompt_tokens"],
+                            )
                         if n_tok and first_delta_at is not None:
                             global_flight.set_token_envelope(
                                 flight_id, n_tok,

@@ -307,7 +307,45 @@ class NativeEngine(LLMBackend):
             from pilottai_tpu.sched import global_scheduler
 
             global_scheduler.attach_prewarm(id(self), self._sched_prewarm)
+        # Profile-guided configuration (obs/profile.py): tag the global
+        # workload profiler with this deployment's store key, and warn
+        # once if the active knob vector diverges from a stored
+        # recommendation for its recorded workload.
+        from pilottai_tpu.obs import global_profile
+
+        global_profile.configure(self.config.model_name)
+        self._warn_knob_divergence()
         self._log.info("engine up in %.1fs", time.perf_counter() - t0)
+
+    _warned_knob_divergence = False  # one-shot boot warning guard
+
+    def _warn_knob_divergence(self) -> None:
+        """One-shot boot warning when the active engine knob vector
+        diverges from the recommendation stored for this deployment's
+        profile (``scripts/recommend.py`` writes it into the profile
+        store next to ``autotune.json``). Mirrors the scheduler's
+        one-shot ``min_len`` floor warning: advisory, once, and silent
+        when no profile/recommendation is stored — a fresh deployment
+        must boot quietly."""
+        if self._warned_knob_divergence:
+            return
+        from pilottai_tpu.utils.compile_cache import load_profile
+
+        blob = load_profile(self.config.model_name) or {}
+        recommended = (blob.get("recommendation") or {}).get("knobs") or {}
+        diverged = []
+        for name, want in sorted(recommended.items()):
+            have = getattr(self.config, name, None)
+            if have != want:
+                diverged.append(f"{name}={have!r} (recommended {want!r})")
+        if diverged:
+            self._warned_knob_divergence = True
+            self._log.warning(
+                "knob vector diverges from the stored recommendation for "
+                "deployment %r: %s — scripts/recommend.py re-derives it "
+                "from the current workload profile",
+                self.config.model_name, ", ".join(diverged),
+            )
 
     def _sched_prewarm(self, prompt, session_id=None) -> bool:
         """Scheduler pre-warm entry point (any thread): render the

@@ -22,6 +22,13 @@ Correlates the three previously disconnected pieces — ``utils/metrics``
   ``engine.collective_frac`` gauges; fed per dispatch by the batcher.
 * ``blackbox`` — dump coordinator: last N steps + the affected request's
   span tree, journaled on deadline expiry / breaker open / errors.
+* ``profile`` — rolling per-deployment workload fingerprint (lengths,
+  arrival stats, class/session/DAG mix, spec acceptance, kv hit rate);
+  ``/profile.json`` + the profile store next to ``autotune.json``.
+* ``forecast`` — seasonal arrival-rate forecasting (EWMA level x
+  diurnal curve) feeding predictive autoscaling.
+* ``costmodel`` — knob-vector → predicted-metrics interpolation over
+  recorded ``bench_slo`` sample points; ``scripts/recommend.py``.
 * ``export``   — Prometheus text exposition, Chrome/Perfetto
   ``trace_event`` JSON, the shared ``metrics_snapshot``, the bench's
   ``phase_summary`` and the ``export_completeness`` wiring check.
@@ -51,6 +58,13 @@ from pilottai_tpu.obs.export import (
     prometheus_text,
 )
 from pilottai_tpu.obs.flight import FlightRecorder, RequestFlight, global_flight
+from pilottai_tpu.obs.forecast import (
+    ArrivalForecast,
+    burstiness_cv,
+    global_forecast,
+)
+from pilottai_tpu.obs.costmodel import CostModel, validate_knobs
+from pilottai_tpu.obs.profile import WorkloadProfiler, global_profile
 from pilottai_tpu.obs.ring import StepRing, global_steps
 from pilottai_tpu.obs.slo import (
     DEFAULT_CLASS,
@@ -67,6 +81,11 @@ global_flight.add_finish_listener(global_slo.observe_flight)
 # DAG (ambient dag context stamped at flight start; trace-id fallback),
 # so a task's breakdown can split LLM time into prefill/decode.
 global_flight.add_finish_listener(global_dag.observe_flight)
+# ... and the workload profiler (ISSUE 18): finished flights carry the
+# length/class/session/DAG shape, flight STARTS are the arrival events
+# the inter-arrival stats and the seasonal forecaster key on.
+global_flight.add_finish_listener(global_profile.observe_flight)
+global_flight.add_start_listener(global_profile.observe_start)
 
 # Engine admission-queue depth: maintained by the batcher (admit / fold /
 # shed paths) but declared HERE so the exported surface — and the
@@ -160,10 +179,20 @@ _gm.declare("sched.gang_partial", "counter")      # wait-bound fallbacks
 _gm.declare("sched.prewarms", "counter")          # pre-warm requests
 _gm.declare("sched.prewarm_hits", "counter")      # found KV (hot or host)
 _gm.declare("sched.prewarm_skipped", "counter")   # no tier / below floor
+# Profile-guided configuration (ISSUE 18): the speculation-acceptance
+# EMA the batcher maintains internally becomes an exported gauge (the
+# workload fingerprint reads it back), declared here so the surface is
+# complete before — or without — a speculating engine. The profile.*
+# gauges themselves are declared by WorkloadProfiler at construction
+# (import time for the global instance, same pattern as SLOTracker);
+# scaling.forecast_* by DynamicScaling, which owns the scaling surface.
+_gm.declare("engine.spec_acceptance", "gauge")
 
 __all__ = [
     "AgentOccupancy",
+    "ArrivalForecast",
     "BlackBox",
+    "CostModel",
     "DEFAULT_CLASS",
     "DagLedger",
     "DeviceTimeAttributor",
@@ -173,12 +202,16 @@ __all__ = [
     "SLOTracker",
     "StepRing",
     "TaskDag",
+    "WorkloadProfiler",
+    "burstiness_cv",
     "export_completeness",
     "global_attribution",
     "global_blackbox",
     "global_dag",
     "global_flight",
+    "global_forecast",
     "global_occupancy",
+    "global_profile",
     "global_slo",
     "global_steps",
     "metrics_snapshot",
@@ -186,4 +219,5 @@ __all__ = [
     "perfetto_trace",
     "phase_summary",
     "prometheus_text",
+    "validate_knobs",
 ]

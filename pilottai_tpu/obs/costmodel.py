@@ -1,0 +1,326 @@
+"""Deployment cost model: predict serving metrics from a knob vector.
+
+LLM-Pilot (arxiv 2410.02425) characterizes an inference service across
+configurations once, fits a predictive model, and answers "which config
+meets this SLO cheapest?" without re-benchmarking per deployment. This
+module is that loop over OUR knob space: ``bench_slo`` runs produce
+sample points — a knob vector (chunk, slots, speculation, kvcache MB,
+quant, scheduler, ...) plus the measured outcome (steps/s, TTFT/TPOT
+percentiles, attainment, burn) on a tagged workload — and
+:class:`CostModel` interpolates over them:
+
+* **exact** on recorded points (a recorded configuration predicts its
+  own measurement — anything else would be a model bug), and
+* **bounded + monotone between** recorded points: prediction is an
+  inverse-distance blend of the two nearest recorded neighbours, so a
+  query between two knob vectors lands between their measurements and
+  moves monotonically as the query slides from one to the other. No
+  fitted curve ever extrapolates outside observed outcomes — a cost
+  model that invents throughput cliffs is worse than none.
+
+``recommend()`` scores every *recorded* knob vector for a workload
+fingerprint (obs/profile.py) — attainment first, steps/s as tiebreak,
+canonical-JSON order as the final deterministic tiebreak — and returns
+the winner with predicted-vs-default deltas. Recommendations are always
+points the bench actually measured: interpolation ranks, measurement
+recommends. ``scripts/recommend.py`` is the CLI over this.
+
+Import cost: stdlib only (the obs constraint — no jax).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+_EPS = 1e-12
+
+# The knob space recommendations may range over, with the bounds the CI
+# gate enforces: numeric knobs as (lo, hi) inclusive, categorical knobs
+# as the allowed value set (mirrors core/config.py validators; upper
+# bounds are the widest values any committed bench has exercised).
+KNOB_BOUNDS: Dict[str, Any] = {
+    "engine_chunk": (1, 512),
+    "engine_chunk_policy": ("fixed", "adaptive"),
+    "engine_slots": (1, 256),
+    "engine_speculate": (0, 8),
+    "engine_page_strip": (1, 64),
+    "engine_page_size": (8, 1024),
+    "engine_overlap_admission": (False, True),
+    "engine_kvcache_host_mb": (0, 1 << 20),
+    "engine_kvcache_policy": ("cost", "lru"),
+    "engine_prefix_cache": (0, 4096),
+    "engine_quant": (None, "none", "int8", "int4"),
+    "engine_quant_group": (1, 4096),
+    "engine_sched_policy": ("fifo", "dag"),
+    "engine_pipeline": (1, 8),
+}
+
+
+def validate_knobs(knobs: Dict[str, Any]) -> List[str]:
+    """Violation strings for any knob outside :data:`KNOB_BOUNDS`
+    (empty list = in-bounds). Unknown knob names are violations too —
+    a recommendation must stay inside the modeled space."""
+    problems: List[str] = []
+    for name, value in sorted(knobs.items()):
+        bounds = KNOB_BOUNDS.get(name)
+        if bounds is None:
+            problems.append(f"{name}: not a modeled knob")
+            continue
+        if all(isinstance(b, bool) for b in bounds):
+            if not isinstance(value, bool):
+                problems.append(f"{name}={value!r}: expected bool")
+        elif all(isinstance(b, (int, float)) for b in bounds):
+            lo, hi = bounds
+            if not isinstance(value, (int, float)) or isinstance(value, bool):
+                problems.append(f"{name}={value!r}: expected number")
+            elif not (lo <= value <= hi):
+                problems.append(f"{name}={value!r}: outside [{lo}, {hi}]")
+        else:
+            if value not in bounds:
+                problems.append(f"{name}={value!r}: not in {bounds}")
+    return problems
+
+
+def _canon(knobs: Dict[str, Any]) -> str:
+    """Canonical (sorted-JSON) key for a knob vector — the dedup and
+    final-tiebreak key, so recommendation order never depends on dict
+    insertion order."""
+    return json.dumps(knobs, sort_keys=True, default=str)
+
+
+class CostModel:
+    """Interpolating model over recorded ``bench_slo`` sample points."""
+
+    def __init__(self, samples: Optional[List[Dict[str, Any]]] = None) -> None:
+        self._samples: List[Dict[str, Any]] = []
+        for s in samples or []:
+            self.add_sample(
+                s.get("knobs", {}), s.get("metrics", {}), s.get("workload")
+            )
+
+    def add_sample(
+        self,
+        knobs: Dict[str, Any],
+        metrics: Dict[str, float],
+        workload: Optional[str] = None,
+    ) -> None:
+        self._samples.append({
+            "knobs": dict(knobs),
+            "metrics": {k: float(v) for k, v in metrics.items()},
+            "workload": workload,
+            "_key": _canon(knobs),
+        })
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "CostModel":
+        return cls(samples=list(data.get("samples", [])))
+
+    @classmethod
+    def from_json(cls, path: str) -> "CostModel":
+        with open(path) as fh:
+            return cls.from_dict(json.load(fh))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "samples": [
+                {k: v for k, v in s.items() if not k.startswith("_")}
+                for s in self._samples
+            ]
+        }
+
+    @property
+    def samples(self) -> List[Dict[str, Any]]:
+        return list(self._samples)
+
+    # ------------------------------------------------------------------ #
+    # Distance
+    # ------------------------------------------------------------------ #
+
+    def _ranges(self, names: List[str]) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for name in names:
+            vals = [
+                s["knobs"][name] for s in self._samples
+                if isinstance(s["knobs"].get(name), (int, float))
+                and not isinstance(s["knobs"].get(name), bool)
+            ]
+            out[name] = (max(vals) - min(vals)) if len(vals) > 1 else 0.0
+        return out
+
+    def _distance(
+        self,
+        a: Dict[str, Any],
+        b: Dict[str, Any],
+        ranges: Dict[str, float],
+    ) -> float:
+        names = sorted(set(a) | set(b))
+        if not names:
+            return 0.0
+        total = 0.0
+        for name in names:
+            va, vb = a.get(name), b.get(name)
+            if va is None and vb is None:
+                continue
+            if va is None or vb is None:
+                total += 1.0
+            elif (
+                isinstance(va, (int, float)) and not isinstance(va, bool)
+                and isinstance(vb, (int, float)) and not isinstance(vb, bool)
+            ):
+                span = ranges.get(name, 0.0)
+                if span > _EPS:
+                    total += abs(va - vb) / span
+                elif abs(va - vb) > _EPS:
+                    total += 1.0
+            else:
+                total += 0.0 if va == vb else 1.0
+        return total
+
+    # ------------------------------------------------------------------ #
+    # Prediction
+    # ------------------------------------------------------------------ #
+
+    def predict(
+        self,
+        knobs: Dict[str, Any],
+        metric: str,
+        workload: Optional[str] = None,
+    ) -> Optional[float]:
+        """Predicted ``metric`` at ``knobs`` (None when no sample has
+        the metric). Exact on recorded points; otherwise the inverse-
+        distance blend of the TWO nearest recorded neighbours — a convex
+        combination, so between two adjacent recorded vectors along one
+        knob the prediction slides monotonically from one measurement to
+        the other and never leaves the observed range."""
+        pool = [s for s in self._samples if metric in s["metrics"]]
+        if workload is not None:
+            tagged = [s for s in pool if s["workload"] == workload]
+            if tagged:
+                pool = tagged
+        if not pool:
+            return None
+        key = _canon(knobs)
+        exact = [s for s in pool if s["_key"] == key]
+        if exact:
+            return sum(s["metrics"][metric] for s in exact) / len(exact)
+        ranges = self._ranges(
+            sorted({n for s in pool for n in s["knobs"]} | set(knobs))
+        )
+        scored: List[Tuple[float, str, Dict[str, Any]]] = sorted(
+            (self._distance(knobs, s["knobs"], ranges), s["_key"], s)
+            for s in pool
+        )
+        nearest = scored[:2]
+        weights = [1.0 / max(d, _EPS) ** 2 for d, _, _ in nearest]
+        total = sum(weights)
+        return sum(
+            w * s["metrics"][metric] for w, (_, _, s) in zip(weights, nearest)
+        ) / total
+
+    def predict_all(
+        self, knobs: Dict[str, Any], workload: Optional[str] = None
+    ) -> Dict[str, float]:
+        metrics = sorted({m for s in self._samples for m in s["metrics"]})
+        out: Dict[str, float] = {}
+        for m in metrics:
+            got = self.predict(knobs, m, workload=workload)
+            if got is not None:
+                out[m] = got
+        return out
+
+    # ------------------------------------------------------------------ #
+    # Recommendation
+    # ------------------------------------------------------------------ #
+
+    def _workload_weights(
+        self, profile: Optional[Dict[str, Any]]
+    ) -> Dict[str, float]:
+        """Per-workload weights from a profile fingerprint's workload /
+        class mix; uniform when the profile carries neither."""
+        mix: Dict[str, float] = {}
+        if profile:
+            raw = profile.get("workload_mix") or profile.get("class_mix") or {}
+            mix = {
+                str(k): float(v) for k, v in raw.items() if float(v) > 0.0
+            }
+        if not mix:
+            return {}
+        total = sum(mix.values())
+        return {k: v / total for k, v in mix.items()}
+
+    def _score(
+        self, key: str, weights: Dict[str, float]
+    ) -> Tuple[float, float]:
+        """(weighted attainment, weighted steps/s) for one recorded knob
+        vector across its per-workload samples."""
+        mine = [s for s in self._samples if s["_key"] == key]
+
+        def avg(metric: str, subset: List[Dict[str, Any]]) -> float:
+            vals = [s["metrics"][metric] for s in subset if metric in s["metrics"]]
+            return sum(vals) / len(vals) if vals else 0.0
+
+        if not weights:
+            return avg("attainment", mine), avg("steps_per_s", mine)
+        att = spd = wsum = 0.0
+        for workload, w in sorted(weights.items()):
+            subset = [s for s in mine if s["workload"] == workload]
+            if not subset:
+                subset = mine  # unmodeled workload: fall back to all
+            att += w * avg("attainment", subset)
+            spd += w * avg("steps_per_s", subset)
+            wsum += w
+        return (att / wsum, spd / wsum) if wsum else (0.0, 0.0)
+
+    def recommend(
+        self,
+        profile: Optional[Dict[str, Any]] = None,
+        default_knobs: Optional[Dict[str, Any]] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """Best recorded knob vector for ``profile``. Deterministic:
+        attainment desc, steps/s desc, canonical key asc. Returns the
+        knobs, their predicted metrics, the default's predictions and
+        the deltas (recommended − default); None with no samples."""
+        if not self._samples:
+            return None
+        weights = self._workload_weights(profile)
+        keys = sorted({s["_key"] for s in self._samples})
+        ranked = sorted(
+            keys,
+            key=lambda k: (
+                tuple(-x for x in self._score(k, weights)), k
+            ),
+        )
+        best_key = ranked[0]
+        best_knobs = next(
+            dict(s["knobs"]) for s in self._samples if s["_key"] == best_key
+        )
+        att, spd = self._score(best_key, weights)
+        out: Dict[str, Any] = {
+            "knobs": best_knobs,
+            "score": {"attainment": round(att, 6), "steps_per_s": round(spd, 6)},
+            "predicted": {
+                k: round(v, 6) for k, v in self.predict_all(best_knobs).items()
+            },
+        }
+        if default_knobs is not None:
+            datt, dspd = self._score(_canon(default_knobs), weights)
+            default_pred = {
+                k: round(v, 6)
+                for k, v in self.predict_all(default_knobs).items()
+            }
+            out["default_knobs"] = dict(default_knobs)
+            out["default_predicted"] = default_pred
+            out["delta"] = {
+                k: round(out["predicted"][k] - default_pred[k], 6)
+                for k in out["predicted"]
+                if k in default_pred
+            }
+            out["default_score"] = {
+                "attainment": round(datt, 6), "steps_per_s": round(dspd, 6)
+            }
+        out["violations"] = validate_knobs(best_knobs)
+        return out
+
+
+__all__ = ["CostModel", "KNOB_BOUNDS", "validate_knobs"]

@@ -121,11 +121,22 @@ class FlightRecorder:
         # here). Outside the lock; exceptions are swallowed — derived
         # telemetry must never fail the request path.
         self._listeners: List[Any] = []
+        # Start listeners: called once per flight on its FIRST start()
+        # (the idempotent re-entry that merely enriches attributes does
+        # not re-fire) — the arrival event the workload profiler and
+        # the seasonal forecaster key on. Same outside-the-lock,
+        # swallow-exceptions contract as finish listeners.
+        self._start_listeners: List[Any] = []
 
     def add_finish_listener(self, fn: Any) -> None:
         """Register ``fn(flight: RequestFlight)`` to run on every
         ``finish`` (any status)."""
         self._listeners.append(fn)
+
+    def add_start_listener(self, fn: Any) -> None:
+        """Register ``fn(flight: RequestFlight)`` to run once per flight
+        when it is first opened."""
+        self._start_listeners.append(fn)
 
     # ------------------------------------------------------------------ #
     # Lifecycle (handler / HTTP edge)
@@ -141,6 +152,7 @@ class FlightRecorder:
         the server may open it before the handler enriches it).
         ``trace_id`` defaults to the flight id for callers with a
         one-request trace (the HTTP edge)."""
+        created = False
         with self._lock:
             flight = self._active.get(flight_id)
             if flight is None:
@@ -148,8 +160,15 @@ class FlightRecorder:
                     flight_id=flight_id, trace_id=trace_id or flight_id
                 )
                 self._active[flight_id] = flight
+                created = True
             flight.attributes.update(attributes)
-            return flight
+        if created:
+            for listener in self._start_listeners:
+                try:
+                    listener(flight)
+                except Exception:  # noqa: BLE001 — telemetry must not raise
+                    pass
+        return flight
 
     def finish(self, flight_id: str, status: str = "ok") -> Optional[Dict[str, Any]]:
         """Close the flight: derive phase metrics, observe them into the

@@ -1,0 +1,235 @@
+"""Arrival-rate forecasting: EWMA level x seasonal decomposition.
+
+``DynamicScaling`` (orchestration/scaling.py) is purely reactive — every
+input it blends (queue fractions, device busy, SLO burn) is a symptom of
+load that already arrived, so a diurnal ramp or a scripted burst has to
+hurt before capacity moves, and the cooldown then delays the next step.
+This module closes ROADMAP item 5's predictive half: the profiler feeds
+every request arrival into :class:`ArrivalForecast`, which maintains
+
+* a **seasonal curve** — per-phase EWMA of the arrival rate across
+  periods (the diurnal shape, at ``bucket_s`` resolution over
+  ``period_s``), and
+* a **level multiplier** — EWMA of observed rate over the seasonal
+  expectation (how hot the deployment runs *relative to* its usual
+  shape right now),
+
+so ``forecast_rps(lead_s)`` = level x seasonal(now + lead) anticipates
+the next ramp from history instead of waiting for the queues to fill.
+This is classic multiplicative Holt-Winters without the trend term —
+arrival traces are shape-dominated, and a trend term turns one burst
+into runaway extrapolation.
+
+Everything takes explicit timestamps (``at`` / ``now``) so tests replay
+synthetic diurnal traces deterministically; live callers omit them and
+get ``time.time()``. Import cost: stdlib only (the obs constraint).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Dict, Optional
+
+_EPS = 1e-9
+
+
+class ArrivalForecast:
+    """Seasonal arrival-rate forecaster over bucketed request counts.
+
+    ``bucket_s`` is the aggregation step, ``period_s`` the seasonal
+    period (a day for real traffic; tests use seconds-long synthetic
+    periods — the math is scale-free). ``alpha`` smooths the level
+    multiplier, ``gamma`` the per-phase seasonal curve; both are EWMAs,
+    so one weird period fades instead of sticking.
+    """
+
+    def __init__(
+        self,
+        bucket_s: float = 60.0,
+        period_s: float = 86400.0,
+        alpha: float = 0.4,
+        gamma: float = 0.3,
+        clock=time.time,
+    ) -> None:
+        if bucket_s <= 0 or period_s < bucket_s:
+            raise ValueError("need bucket_s > 0 and period_s >= bucket_s")
+        # ``clock`` backs the implicit "now" when callers omit explicit
+        # timestamps (DynamicScaling does) — the bench's scripted burst
+        # simulation injects synthetic time through it.
+        self._clock = clock
+        self.bucket_s = float(bucket_s)
+        self.period_s = float(period_s)
+        self.alpha = float(alpha)
+        self.gamma = float(gamma)
+        self.n_phases = max(int(round(period_s / bucket_s)), 1)
+        self._lock = threading.Lock()
+        # Per-phase seasonal rate curve (rps); None until first closed
+        # bucket lands in that phase, so an unseen phase falls back to
+        # the overall mean instead of a fabricated zero.
+        self._season: Dict[int, float] = {}
+        self._level: Optional[float] = None  # observed / seasonal EWMA
+        self._bucket_idx: Optional[int] = None  # open bucket (abs index)
+        self._bucket_count = 0
+        self._closed_buckets = 0
+
+    # ------------------------------------------------------------------ #
+
+    def _phase(self, bucket_idx: int) -> int:
+        return bucket_idx % self.n_phases
+
+    def _seasonal_rate(self, phase: int) -> float:
+        """Seasonal expectation for ``phase`` (rps), mean-filled for
+        phases with no history yet."""
+        got = self._season.get(phase)
+        if got is not None:
+            return got
+        if self._season:
+            return sum(self._season.values()) / len(self._season)
+        return 0.0
+
+    def _close_bucket(self, bucket_idx: int, count: int) -> None:
+        """Fold one finished bucket into the seasonal curve + level."""
+        rate = count / self.bucket_s
+        phase = self._phase(bucket_idx)
+        expect = self._seasonal_rate(phase)
+        prev = self._season.get(phase)
+        if prev is None:
+            self._season[phase] = rate
+        else:
+            self._season[phase] = (
+                self.gamma * rate + (1.0 - self.gamma) * prev
+            )
+        # Level: how hot we run vs the seasonal shape. Only meaningful
+        # once the curve has an expectation for this phase.
+        ratio = rate / expect if expect > _EPS else (
+            1.0 if rate <= _EPS else None
+        )
+        if ratio is not None:
+            if self._level is None:
+                self._level = ratio
+            else:
+                self._level = (
+                    self.alpha * ratio + (1.0 - self.alpha) * self._level
+                )
+        self._closed_buckets += 1
+
+    def _roll(self, now: float) -> None:
+        """Close every bucket the clock has passed (empty ones count —
+        silence IS data for a rate). Gaps longer than one period close
+        at most one period of empty buckets: the seasonal curve only has
+        ``n_phases`` slots, so older silence adds nothing."""
+        idx = int(now // self.bucket_s)
+        if self._bucket_idx is None:
+            self._bucket_idx = idx
+            return
+        if idx <= self._bucket_idx:
+            return
+        gap = idx - self._bucket_idx
+        if gap > self.n_phases:
+            for empty in range(idx - self.n_phases, idx):
+                self._close_bucket(empty, 0)
+            self._bucket_idx = idx
+            self._bucket_count = 0
+            return
+        self._close_bucket(self._bucket_idx, self._bucket_count)
+        for empty in range(self._bucket_idx + 1, idx):
+            self._close_bucket(empty, 0)
+        self._bucket_idx = idx
+        self._bucket_count = 0
+
+    # ------------------------------------------------------------------ #
+    # Feeding
+    # ------------------------------------------------------------------ #
+
+    def observe(self, at: Optional[float] = None, n: int = 1) -> None:
+        """Record ``n`` arrivals at ``at`` (default: now)."""
+        if n <= 0:
+            return
+        at = self._clock() if at is None else at
+        with self._lock:
+            self._roll(at)
+            self._bucket_count += n
+
+    def ingest_bucket(self, count: int, at: float) -> None:
+        """Test/replay convenience: a whole bucket's count at once."""
+        self.observe(at=at, n=max(int(count), 0))
+
+    # ------------------------------------------------------------------ #
+    # Reading
+    # ------------------------------------------------------------------ #
+
+    def ready(self) -> bool:
+        """True once a full period of buckets has closed — before that
+        the seasonal curve is partial and forecasts fall back to the
+        current rate (consumers should treat them as advisory)."""
+        with self._lock:
+            return self._closed_buckets >= self.n_phases
+
+    def current_rps(self, now: Optional[float] = None) -> float:
+        """Smoothed current arrival rate: level x seasonal(now)."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            self._roll(now)
+            if self._level is None:
+                # No closed history: estimate from the open bucket.
+                elapsed = now - (self._bucket_idx or 0) * self.bucket_s
+                return self._bucket_count / max(elapsed, self.bucket_s / 4)
+            phase = self._phase(int(now // self.bucket_s))
+            return max(self._level * self._seasonal_rate(phase), 0.0)
+
+    def forecast_rps(
+        self, lead_s: float = 0.0, now: Optional[float] = None
+    ) -> float:
+        """Predicted arrival rate ``lead_s`` seconds from ``now``."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            self._roll(now)
+            if self._level is None:
+                elapsed = now - (self._bucket_idx or 0) * self.bucket_s
+                return self._bucket_count / max(elapsed, self.bucket_s / 4)
+            phase = self._phase(int((now + lead_s) // self.bucket_s))
+            return max(self._level * self._seasonal_rate(phase), 0.0)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            n = len(self._season)
+            mean = sum(self._season.values()) / n if n else 0.0
+            peak = max(self._season.values()) if n else 0.0
+            return {
+                "level": round(self._level if self._level is not None else 1.0, 4),
+                "seasonal_mean_rps": round(mean, 4),
+                "seasonal_peak_rps": round(peak, 4),
+                "phases_learned": n,
+                "n_phases": self.n_phases,
+                "bucket_s": self.bucket_s,
+                "period_s": self.period_s,
+                "ready": n >= self.n_phases,
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._season.clear()
+            self._level = None
+            self._bucket_idx = None
+            self._bucket_count = 0
+            self._closed_buckets = 0
+
+
+def burstiness_cv(inter_arrivals) -> float:
+    """Coefficient of variation of inter-arrival gaps: 1 ~ Poisson,
+    >1 bursty, <1 metronomic. The profiler fingerprints with this."""
+    xs = [x for x in inter_arrivals if x >= 0.0]
+    if len(xs) < 2:
+        return 0.0
+    mean = sum(xs) / len(xs)
+    if mean <= _EPS:
+        return 0.0
+    var = sum((x - mean) ** 2 for x in xs) / (len(xs) - 1)
+    return math.sqrt(var) / mean
+
+
+global_forecast = ArrivalForecast()
+
+__all__ = ["ArrivalForecast", "burstiness_cv", "global_forecast"]

@@ -1,0 +1,280 @@
+"""Workload profiler: a rolling per-deployment workload fingerprint.
+
+LLM-Pilot predicts the right configuration *per workload* — which
+requires knowing what the workload IS. Every signal already exists in
+the obs layer; this module is the aggregation point that turns them
+into one comparable fingerprint:
+
+* **prompt/output length** distributions — from finished flights (the
+  handler stamps ``prompt_tokens`` as a flight attribute; generated
+  tokens come from the flight's token ledger);
+* **arrival process** — rate + burstiness (CV of inter-arrival gaps)
+  from the flight recorder's *start* listener, which also feeds the
+  seasonal forecaster (obs/forecast.py);
+* **SLO-class mix** and **session fraction** — flight attributes;
+* **DAG stage mix** — which orchestration stages the traffic runs
+  (``dag_node`` attributes from the scheduler's ambient context);
+* **speculation acceptance** and **kvcache prefix hit rate** — read
+  back from the engine's exported gauges/counters.
+
+The fingerprint is exported three ways: ``profile.*`` gauges (declared
+here, so ``export_completeness`` covers them from import), the
+``/profile.json`` route on APIServer + dashboard (``fingerprint()``),
+and the per-deployment profile store next to ``autotune.json``
+(``persist()`` → ``utils.compile_cache.store_profile``) where
+``scripts/recommend.py`` picks it up.
+
+Import cost: stdlib + utils only (the obs constraint — no jax).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter, deque
+from typing import Any, Dict, List, Optional
+
+from pilottai_tpu.obs.forecast import (
+    ArrivalForecast,
+    burstiness_cv,
+    global_forecast,
+)
+from pilottai_tpu.utils.metrics import MetricsRegistry, global_metrics
+
+# Gauges the profiler owns. Declared at construction (import time for
+# the global instance) so the surface is export_completeness-clean
+# before the first request.
+_GAUGES = (
+    "profile.arrival_rps",
+    "profile.burstiness_cv",
+    "profile.prompt_tokens_p50",
+    "profile.prompt_tokens_p95",
+    "profile.output_tokens_p50",
+    "profile.output_tokens_p95",
+    "profile.session_frac",
+    "profile.dag_frac",
+    "profile.kv_hit_rate",
+    "profile.class_frac.interactive",
+    "profile.class_frac.batch",
+)
+
+_RATE_WINDOW_S = 300.0
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return float(sorted_vals[idx])
+
+
+class WorkloadProfiler:
+    """Rolling fingerprint over the last ``window`` finished requests.
+
+    Wired in ``obs/__init__`` as both a start listener (arrivals) and a
+    finish listener (lengths/mix) on the global flight recorder; tests
+    construct their own with an isolated registry/forecast.
+    """
+
+    def __init__(
+        self,
+        window: int = 2048,
+        registry: MetricsRegistry = global_metrics,
+        forecast: ArrivalForecast = global_forecast,
+    ) -> None:
+        self._registry = registry
+        self._forecast = forecast
+        self._lock = threading.Lock()
+        self._deployment: Optional[str] = None
+        self._prompt_tokens: deque = deque(maxlen=window)
+        self._output_tokens: deque = deque(maxlen=window)
+        self._arrivals: deque = deque(maxlen=window)     # wall-clock stamps
+        self._classes: deque = deque(maxlen=window)      # slo_class per finish
+        self._sessions: deque = deque(maxlen=window)     # bool per finish
+        self._dag: deque = deque(maxlen=window)          # dag_node or None
+        self._finished = 0
+        for name in _GAUGES:
+            registry.declare(name, "gauge")
+
+    # ------------------------------------------------------------------ #
+
+    def configure(self, deployment: Optional[str]) -> None:
+        """Set the deployment key the fingerprint persists under
+        (the engine passes its model name at boot)."""
+        with self._lock:
+            self._deployment = deployment
+
+    @property
+    def deployment(self) -> Optional[str]:
+        return self._deployment
+
+    # ------------------------------------------------------------------ #
+    # Flight listeners
+    # ------------------------------------------------------------------ #
+
+    def observe_start(self, flight: Any) -> None:
+        """Start listener: one arrival. Feeds the inter-arrival window
+        and the seasonal forecaster (wall clock — the forecaster's
+        seasonal phase is a time-of-day concept)."""
+        now = time.time()
+        with self._lock:
+            self._arrivals.append(now)
+        self._forecast.observe(at=now)
+
+    def observe_flight(self, flight: Any) -> None:
+        """Finish listener (any status): fold the flight's shape into
+        the rolling windows."""
+        attrs = getattr(flight, "attributes", {}) or {}
+        prompt = attrs.get("prompt_tokens")
+        tokens = getattr(flight, "n_tokens", 0) or attrs.get(
+            "completion_tokens", 0
+        )
+        with self._lock:
+            if isinstance(prompt, (int, float)) and prompt >= 0:
+                self._prompt_tokens.append(int(prompt))
+            if tokens:
+                self._output_tokens.append(int(tokens))
+            self._classes.append(str(attrs.get("slo_class") or "interactive"))
+            self._sessions.append(bool(attrs.get("session_id")))
+            self._dag.append(attrs.get("dag_node"))
+            self._finished += 1
+        if self._finished % 32 == 0:
+            self.refresh_gauges()
+
+    # ------------------------------------------------------------------ #
+    # Derived views
+    # ------------------------------------------------------------------ #
+
+    def _arrival_stats(self, now: Optional[float] = None) -> Dict[str, float]:
+        now = time.time() if now is None else now
+        with self._lock:
+            stamps = list(self._arrivals)
+        recent = [t for t in stamps if now - t <= _RATE_WINDOW_S]
+        span = (now - recent[0]) if recent else 0.0
+        rps = len(recent) / span if span > 1e-9 else float(len(recent))
+        gaps = [b - a for a, b in zip(stamps, list(stamps)[1:])]
+        gaps_sorted = sorted(gaps)
+        return {
+            "rps": round(rps, 4),
+            "burstiness_cv": round(burstiness_cv(gaps), 4),
+            "interarrival_p50_s": round(_pct(gaps_sorted, 0.50), 4),
+            "interarrival_p95_s": round(_pct(gaps_sorted, 0.95), 4),
+            "observed": len(stamps),
+        }
+
+    def _mix(self, values: List[Any]) -> Dict[str, float]:
+        total = len(values)
+        if not total:
+            return {}
+        counts = Counter(str(v) for v in values if v is not None)
+        return {
+            k: round(c / total, 4) for k, c in sorted(counts.items())
+        }
+
+    def _engine_signals(self) -> Dict[str, float]:
+        reg = self._registry
+        lookups = float(reg.get("engine.kvcache.lookups") or 0.0)
+        hits = float(reg.get("engine.kvcache.hits") or 0.0)
+        return {
+            "spec_acceptance": round(
+                float(reg.get("engine.spec_acceptance") or 0.0), 4
+            ),
+            "kv_hit_rate": round(hits / lookups, 4) if lookups else 0.0,
+        }
+
+    def fingerprint(self) -> Dict[str, Any]:
+        """The ``/profile.json`` body — everything the cost model needs
+        to match this deployment's traffic against recorded workloads."""
+        with self._lock:
+            prompts = sorted(self._prompt_tokens)
+            outputs = sorted(self._output_tokens)
+            classes = list(self._classes)
+            sessions = list(self._sessions)
+            dag = list(self._dag)
+            deployment = self._deployment
+            finished = self._finished
+        n = len(classes)
+        engine = self._engine_signals()
+        fp: Dict[str, Any] = {
+            "deployment": deployment,
+            "updated": round(time.time(), 3),
+            "requests": finished,
+            "window": n,
+            "prompt_tokens": {
+                "p50": _pct(prompts, 0.50),
+                "p95": _pct(prompts, 0.95),
+                "p99": _pct(prompts, 0.99),
+                "mean": round(sum(prompts) / len(prompts), 2) if prompts else 0.0,
+            },
+            "output_tokens": {
+                "p50": _pct(outputs, 0.50),
+                "p95": _pct(outputs, 0.95),
+                "p99": _pct(outputs, 0.99),
+                "mean": round(sum(outputs) / len(outputs), 2) if outputs else 0.0,
+            },
+            "arrival": self._arrival_stats(),
+            "class_mix": self._mix(classes),
+            "session_frac": round(sum(sessions) / n, 4) if n else 0.0,
+            "dag": {
+                "frac": round(
+                    sum(1 for d in dag if d) / n, 4
+                ) if n else 0.0,
+                "stage_mix": self._mix([d for d in dag if d]),
+            },
+            "spec_acceptance": engine["spec_acceptance"],
+            "kv_hit_rate": engine["kv_hit_rate"],
+            "forecast": self._forecast.snapshot(),
+        }
+        return fp
+
+    def refresh_gauges(self) -> None:
+        """Publish the fingerprint's headline numbers as ``profile.*``
+        gauges — the autoscaler-visible / Prometheus-scrapable view."""
+        fp = self.fingerprint()
+        reg = self._registry
+        reg.set_gauge("profile.arrival_rps", fp["arrival"]["rps"])
+        reg.set_gauge("profile.burstiness_cv", fp["arrival"]["burstiness_cv"])
+        reg.set_gauge("profile.prompt_tokens_p50", fp["prompt_tokens"]["p50"])
+        reg.set_gauge("profile.prompt_tokens_p95", fp["prompt_tokens"]["p95"])
+        reg.set_gauge("profile.output_tokens_p50", fp["output_tokens"]["p50"])
+        reg.set_gauge("profile.output_tokens_p95", fp["output_tokens"]["p95"])
+        reg.set_gauge("profile.session_frac", fp["session_frac"])
+        reg.set_gauge("profile.dag_frac", fp["dag"]["frac"])
+        reg.set_gauge("profile.kv_hit_rate", fp["kv_hit_rate"])
+        mix = fp["class_mix"]
+        for cls in sorted({"interactive", "batch"} | set(mix)):
+            reg.set_gauge(f"profile.class_frac.{cls}", mix.get(cls, 0.0))
+
+    # ------------------------------------------------------------------ #
+    # Persistence
+    # ------------------------------------------------------------------ #
+
+    def persist(self, key: Optional[str] = None) -> Optional[str]:
+        """Write the current fingerprint into the per-deployment profile
+        store (``profiles.json`` next to ``autotune.json``), preserving
+        any stored recommendation for the deployment. Returns the store
+        key used, or None when no deployment key is known."""
+        from pilottai_tpu.utils.compile_cache import load_profile, store_profile
+
+        key = key or self._deployment
+        if not key:
+            return None
+        blob = load_profile(key) or {}
+        blob["fingerprint"] = self.fingerprint()
+        store_profile(key, blob)
+        return key
+
+    def reset(self) -> None:
+        with self._lock:
+            self._prompt_tokens.clear()
+            self._output_tokens.clear()
+            self._arrivals.clear()
+            self._classes.clear()
+            self._sessions.clear()
+            self._dag.clear()
+            self._finished = 0
+
+
+global_profile = WorkloadProfiler()
+
+__all__ = ["WorkloadProfiler", "global_profile"]

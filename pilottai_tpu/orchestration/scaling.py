@@ -54,13 +54,19 @@ class DynamicScaling:
         agent_type: str = "worker",
         registry: MetricsRegistry = global_metrics,
         slo_tracker: Optional[Any] = None,
+        forecast: Optional[Any] = None,
     ) -> None:
-        from pilottai_tpu.obs import global_slo
+        from pilottai_tpu.obs import global_forecast, global_slo
 
         self.orchestrator = orchestrator
         self.config = config or ScalingConfig()
         self.agent_type = agent_type
         self._registry = registry
+        # Seasonal arrival forecaster (obs/forecast.py): the predictive
+        # input. Injectable for tests; shares the profiler's global
+        # instance by default (the flight recorder's start listener
+        # feeds it). ``forecast_enabled`` in ScalingConfig gates use.
+        self._forecast = forecast if forecast is not None else global_forecast
         # The burn-rate gauges are only WRITTEN when a flight finishes;
         # reading them raw after traffic stops would pin the last
         # (possibly alarming) value forever. When the scaler shares the
@@ -80,7 +86,8 @@ class DynamicScaling:
         self.scale_downs = 0
         for name in (
             "scaling.system_load", "scaling.recommendation",
-            "scaling.target_agents",
+            "scaling.target_agents", "scaling.forecast_rps",
+            "scaling.forecast_lead_s",
         ):
             registry.declare(name, "gauge")
 
@@ -151,7 +158,7 @@ class DynamicScaling:
         ref = gauges.get("engine.max_queue_depth") or float(
             self.config.queue_depth_ref
         )
-        return {
+        out = {
             "agent_queue_util": gauges.get(
                 "orchestrator.agent_queue_util", 0.0
             ),
@@ -163,6 +170,36 @@ class DynamicScaling:
             "slo_burn_rate": burn,
             "shed_rate": self._registry.rate("engine.shed", window=60.0),
         }
+        out["forecast_boost"] = self._forecast_boost(out)
+        return out
+
+    def _forecast_boost(self, signals: Dict[str, float]) -> float:
+        """Multiplier (≥ 1) the predicted arrival ramp applies to the
+        load signal: forecast(now + lead) over the current smoothed
+        rate, boost-only and capped. 1.0 (a no-op) when forecasting is
+        disabled or the seasonal curve hasn't seen a full period yet —
+        a cold forecaster must never move capacity. The inputs are
+        exported as ``scaling.forecast_*`` gauges either way, so the
+        dashboard can watch the forecaster warm up before trusting it."""
+        cfg = self.config
+        lead = float(cfg.forecast_lead_s)
+        fc = self._forecast
+        predicted = 0.0
+        boost = 1.0
+        if cfg.forecast_enabled and fc is not None:
+            try:
+                predicted = fc.forecast_rps(lead_s=lead)
+                current = fc.current_rps()
+                if fc.ready() and current > 1e-9:
+                    boost = min(
+                        max(predicted / current, 1.0), cfg.forecast_boost_cap
+                    )
+            except Exception:  # noqa: BLE001 — forecast is advisory
+                predicted, boost = 0.0, 1.0
+        self._registry.set_gauge("scaling.forecast_rps", predicted)
+        self._registry.set_gauge("scaling.forecast_lead_s", lead)
+        signals["forecast_rps"] = predicted
+        return boost
 
     def system_load(
         self, signals: Optional[Dict[str, float]] = None
@@ -195,7 +232,13 @@ class DynamicScaling:
             + 0.10 * min(s["slo_burn_rate"] / 2.0, 1.0)
         )
         burn_floor = min(s["slo_burn_rate"] / 2.0, 1.0)
-        return min(1.0, max(s["agent_queue_util"], burn_floor, weighted))
+        load = max(s["agent_queue_util"], burn_floor, weighted)
+        # Predictive term (ISSUE 18): scale the reactive load by the
+        # forecast ratio so a predicted ramp crosses the scale-up
+        # threshold BEFORE queues and burn do. Boost-only and capped
+        # (see _forecast_boost); 1.0 whenever forecasting is off/cold.
+        load *= s.get("forecast_boost", 1.0)
+        return min(1.0, load)
 
     def trend(self) -> float:
         """Recency-weighted slope (reference ``:157-167``)."""

@@ -446,6 +446,14 @@ class APIServer:
                 from pilottai_tpu.obs import global_slo
 
                 await self._send(writer, 200, global_slo.snapshot())
+        elif path == "/profile.json" and method == "GET":
+            # Workload fingerprint (obs/profile.py): the rolling
+            # length/arrival/class-mix shape of this deployment's
+            # traffic, plus the seasonal forecast state — the input
+            # `scripts/recommend.py` replays through the cost model.
+            from pilottai_tpu.obs import global_profile
+
+            await self._send(writer, 200, _jsonable(global_profile.fingerprint()))
         elif path == "/dag.json" and method == "GET":
             # Task-DAG attribution (obs/dag.py): active task summaries +
             # recent finished breakdowns with critical paths; ?task_id=

@@ -114,22 +114,72 @@ def cache_hits() -> int:
 # that reloads executables from here should reload the strip choice the
 # executables were compiled WITH (re-timing would risk picking a different
 # strip and recompiling the whole decode ladder it just restored).
+#
+# The same directory also carries the per-DEPLOYMENT workload profile
+# store (``profiles.json``): fingerprints from obs/profile.py and the
+# knob recommendations scripts/recommend.py derives from them. Both
+# files share one merge-under-race discipline below — two replicas in a
+# ServingCell point at one cache dir, and a plain read→merge→rename
+# loses whichever writer renamed first.
 # --------------------------------------------------------------------- #
 
 _AUTOTUNE_FILE = "autotune.json"
+_PROFILE_FILE = "profiles.json"
+_STORE_RETRIES = 4
+# Same-process writers (batcher tuner thread + profiler persist on the
+# event loop) serialize here; the verify-own-key retry below only has to
+# cover OTHER processes sharing the cache dir.
+_STORE_LOCK = threading.Lock()
 
 
 def _autotune_path() -> Path:
     return Path(_enabled_dir or default_cache_dir()) / _AUTOTUNE_FILE
 
 
+def _profile_path() -> Path:
+    return Path(_enabled_dir or default_cache_dir()) / _PROFILE_FILE
+
+
+def _read_json_store(path: Path) -> dict:
+    import json
+
+    try:
+        data = json.loads(path.read_text())
+        return data if isinstance(data, dict) else {}
+    except Exception:  # noqa: BLE001 — absence/corruption starts fresh
+        return {}
+
+
+def _store_json_key(path: Path, key: str, value) -> None:
+    """Merge ``{key: value}`` into the JSON dict at ``path`` atomically.
+
+    Write-temp + rename keeps readers torn-write-safe, but rename alone
+    does not make read-modify-write safe: two replicas sharing the cache
+    dir can both read, both merge their own key, and the second rename
+    erases the first one's entry. So after renaming we re-read and
+    verify OUR key landed; a concurrent winner that dropped it triggers
+    a re-merge on top of the winner's file (bounded retries — this is a
+    cache, livelock protection beats completeness)."""
+    import json
+
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_suffix(f".tmp{os.getpid()}-{threading.get_ident()}")
+    with _STORE_LOCK:
+        for _ in range(_STORE_RETRIES):
+            data = _read_json_store(path)
+            data[key] = value
+            tmp.write_text(json.dumps(data, indent=2, sort_keys=True))
+            tmp.replace(path)
+            check = _read_json_store(path)
+            if check.get(key) == value:
+                return
+    raise OSError(f"lost store race {_STORE_RETRIES}x on {path.name}:{key}")
+
+
 def load_autotune(key: str) -> Optional[int]:
     """Best-effort read of a previously tuned integer for ``key``."""
     try:
-        import json
-
-        data = json.loads(_autotune_path().read_text())
-        val = data.get(key)
+        val = _read_json_store(_autotune_path()).get(key)
         return int(val) if val is not None else None
     except Exception:  # noqa: BLE001 — a missing/corrupt cache just re-tunes
         return None
@@ -138,23 +188,34 @@ def load_autotune(key: str) -> Optional[int]:
 def store_autotune(key: str, value: int) -> None:
     """Best-effort persist of a tuned integer under ``key``."""
     try:
-        import json
-
-        path = _autotune_path()
-        path.parent.mkdir(parents=True, exist_ok=True)
-        try:
-            data = json.loads(path.read_text())
-        except Exception:  # noqa: BLE001 — start fresh on absence/corruption
-            data = {}
-        data[key] = int(value)
-        tmp = path.with_suffix(f".tmp{os.getpid()}")
-        tmp.write_text(json.dumps(data, indent=2, sort_keys=True))
-        tmp.replace(path)
+        _store_json_key(_autotune_path(), key, int(value))
     except Exception as exc:  # noqa: BLE001 — tuning cache is an optimization
         get_logger("utils.compile_cache").warning(
             "autotune cache write failed: %s", exc
         )
 
 
+def load_profile(key: str) -> Optional[dict]:
+    """Best-effort read of the stored profile/recommendation blob for a
+    deployment ``key`` (a dict as stored; None when absent/corrupt)."""
+    try:
+        val = _read_json_store(_profile_path()).get(key)
+        return dict(val) if isinstance(val, dict) else None
+    except Exception:  # noqa: BLE001 — profile store is advisory
+        return None
+
+
+def store_profile(key: str, value: dict) -> None:
+    """Best-effort persist of a deployment profile blob under ``key``
+    (same atomic merge-under-race discipline as the autotune store)."""
+    try:
+        _store_json_key(_profile_path(), key, dict(value))
+    except Exception as exc:  # noqa: BLE001 — profile store is advisory
+        get_logger("utils.compile_cache").warning(
+            "profile store write failed: %s", exc
+        )
+
+
 __all__ = ["enable_compilation_cache", "cache_hits", "default_cache_dir",
-           "load_autotune", "store_autotune", "HIT_METRIC"]
+           "load_autotune", "store_autotune", "load_profile",
+           "store_profile", "HIT_METRIC"]

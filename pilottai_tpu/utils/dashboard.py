@@ -17,6 +17,9 @@ Stdlib-only (http.server on a daemon thread), three routes:
 * ``/dag.json`` — task-DAG attribution snapshot (``obs.global_dag``):
   active tasks + recent finished breakdowns/critical paths;
   ``?task_id=`` for one task's full node ledger (API server parity).
+* ``/profile.json`` — the rolling workload fingerprint
+  (``obs.global_profile``): length/arrival/class-mix shape plus the
+  seasonal forecast state (API server parity).
 * ``/`` — a self-refreshing HTML table over the same JSON.
 
 Read-only and unauthenticated by design: bind to localhost (the default)
@@ -33,6 +36,7 @@ from urllib.parse import parse_qs
 
 from pilottai_tpu.obs import (
     global_dag,
+    global_profile,
     global_slo,
     global_steps,
     metrics_snapshot,
@@ -158,6 +162,11 @@ class MetricsDashboard:
                         self.send_error(404)
                         return
                     body = json.dumps(payload, default=str).encode()
+                    ctype = "application/json"
+                elif path == "/profile.json":
+                    body = json.dumps(
+                        global_profile.fingerprint(), default=str
+                    ).encode()
                     ctype = "application/json"
                 elif path == "/trace.json":
                     trace_id = (params.get("trace_id") or [None])[0]

@@ -17,9 +17,12 @@ scheduler-off sub-blocks; straggler_frac and — in this section only —
 critical_path_frac are down-good), ``MULTICHIP`` (per-chip steps/s,
 MFU and per_chip_efficiency up-good; ``collective_frac*`` /
 ``collective_ms*`` down-good; the single-device reference under
-``multichip.single``) and ``QUANT`` (per-quant-mode sub-blocks:
+``multichip.single``), ``QUANT`` (per-quant-mode sub-blocks:
 steps/s and MFU up-good, ``weight_bytes*`` / the bytes-per-token
-ratio down-good) blocks, compares numeric
+ratio down-good) and ``AUTOCONF`` (recommended / default knob-vector
+sub-blocks with their per-class breakdowns, plus the forecast-on /
+forecast-off burst sub-blocks: attainment and the measured forecast
+lead up-good, peak burn down-good) blocks, compares numeric
 metrics whose direction it knows (steps/s, MFU, attainment, busy_frac,
 recovered_frac, prefix_hit_rate, affinity_hit_rate,
 prefill_tokens_saved up = good; p50/p99, host_gap, burn_rate,
@@ -63,6 +66,10 @@ HIGHER_BETTER = (
     # post-soak migration landing every entry.
     "byte_identity", "identical_waves", "corruptions_detected",
     "export_completeness",
+    # AUTOCONF section (ISSUE 18): seconds of capacity lead the arrival
+    # forecast bought before the scripted burst (attainment_* headlines
+    # already match "attainment" above).
+    "forecast_lead",
 )
 LOWER_BETTER = (
     "overhead_frac", "straggler_frac", "p50", "p90", "p99", "host_gap",
@@ -85,6 +92,9 @@ LOWER_BETTER = (
     # exposure and wedged work are all cost (client_errors matches
     # "errors" above; recovered_frac is already up-good).
     "shard_losses", "integrity_failures", "stuck_flights", "mesh_rungs",
+    # AUTOCONF section (ISSUE 18): worst interactive burn seen during
+    # the scripted burst simulation.
+    "peak_burn",
 )
 
 
@@ -163,7 +173,7 @@ def _from_tail(tail: str) -> Dict[str, Any]:
     doc: Dict[str, Any] = {}
     remainder = tail
     for block in ("models", "SLO", "phases", "KVCACHE", "CELL", "SCHED",
-                  "MULTICHIP", "QUANT", "CHAOS"):
+                  "MULTICHIP", "QUANT", "CHAOS", "AUTOCONF"):
         marker = f'"{block}": '
         at = remainder.find(marker)
         if at < 0:
@@ -210,7 +220,8 @@ def _sections(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     out: Dict[str, Dict[str, Any]] = {"top": {}}
     for key, value in doc.items():
         if key in ("models", "SLO", "phases", "RECOVERY", "KVCACHE",
-                   "CELL", "SCHED", "MULTICHIP", "QUANT", "CHAOS"):
+                   "CELL", "SCHED", "MULTICHIP", "QUANT", "CHAOS",
+                   "AUTOCONF"):
             continue
         num = _numeric(value)
         if num is not None:
@@ -297,6 +308,39 @@ def _sections(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
             k: n for k, v in chaos.items()
             if (n := _numeric(v)) is not None
         }
+    autoconf = doc.get("AUTOCONF")
+    if isinstance(autoconf, dict):
+        # Section-root scalars (the measured forecast lead), the
+        # recommended / default knob-vector sub-blocks — each a measured
+        # bench_slo run: steps/s + per-class attainment/p99s/burn — and
+        # the forecast-on / forecast-off scripted-burst sub-blocks
+        # (peak_burn, forecast_lead_s; the phase indices carry no
+        # direction and stay out of the diff).
+        out["autoconf"] = {
+            k: n for k, v in autoconf.items()
+            if (n := _numeric(v)) is not None
+        }
+        for mode in ("recommended", "default"):
+            block = autoconf.get(mode)
+            if not isinstance(block, dict):
+                continue
+            out[f"autoconf.{mode}"] = {
+                k: n for k, v in block.items()
+                if (n := _numeric(v)) is not None
+            }
+            for cls, cblock in (block.get("classes") or {}).items():
+                if isinstance(cblock, dict):
+                    out[f"autoconf.{mode}.{cls}"] = {
+                        k: n for k, v in cblock.items()
+                        if (n := _numeric(v)) is not None
+                    }
+        for mode in ("on", "off"):
+            block = (autoconf.get("forecast") or {}).get(mode)
+            if isinstance(block, dict):
+                out[f"autoconf.forecast_{mode}"] = {
+                    k: n for k, v in block.items()
+                    if (n := _numeric(v)) is not None
+                }
     for name, block in (doc.get("models") or {}).items():
         if isinstance(block, dict):
             out[f"models.{name}"] = {

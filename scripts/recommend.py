@@ -1,0 +1,163 @@
+#!/usr/bin/env python3
+"""Replay a workload profile through the cost model and recommend knobs.
+
+The measurement loop (ISSUE 18): ``bench_slo`` runs under several knob
+vectors produce *sample points* (knobs + measured steps/s, TTFT/TPOT
+percentiles, attainment, per tagged workload — the AUTOCONF bench
+section emits them, ``tests/fixtures/autoconf_samples.json`` is a
+committed round), the serving deployment's workload profiler exports a
+*fingerprint* (``/profile.json``, persisted into the profile store next
+to ``autotune.json``), and this script closes the loop:
+
+    python scripts/recommend.py                       # committed fixtures
+    python scripts/recommend.py --samples S.json --profile P.json
+    python scripts/recommend.py --deployment llama-tiny    # profile store
+    python scripts/recommend.py --store               # persist the rec
+
+It fits :class:`pilottai_tpu.obs.costmodel.CostModel` over the samples,
+weights workloads by the profile's class mix, and prints the
+recommended knob vector with predicted-vs-default deltas. With
+``--store`` the recommendation lands in the profile store under the
+deployment key, where the engine's boot check compares it against the
+active config (``NativeEngine._warn_knob_divergence``).
+
+Deterministic by construction (the model's tie-breaks are total
+orders), and every recommended knob is validated against the modeled
+bounds — the CI ``autoconf`` lane runs this twice over the committed
+fixtures and gates on identical, in-bounds output.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from pilottai_tpu.obs.costmodel import CostModel, validate_knobs  # noqa: E402
+
+FIXTURES = REPO / "tests" / "fixtures"
+DEFAULT_SAMPLES = FIXTURES / "autoconf_samples.json"
+DEFAULT_PROFILE = FIXTURES / "autoconf_profile.json"
+
+
+def _default_knobs(names):
+    """Default value per knob name from LLMConfig's field defaults —
+    the 'do nothing' configuration the recommendation is diffed
+    against."""
+    from pilottai_tpu.core.config import LLMConfig
+
+    out = {}
+    for name in sorted(names):
+        field = LLMConfig.model_fields.get(name)
+        if field is not None:
+            out[name] = field.default
+    return out
+
+
+def _load_profile_blob(args) -> dict:
+    if args.deployment:
+        from pilottai_tpu.utils.compile_cache import load_profile
+
+        blob = load_profile(args.deployment)
+        if blob is None:
+            raise SystemExit(
+                f"no stored profile for deployment {args.deployment!r} "
+                "(is the profile store populated?)"
+            )
+        return blob
+    path = Path(args.profile) if args.profile else DEFAULT_PROFILE
+    blob = json.loads(path.read_text())
+    return blob
+
+
+def recommend(samples_path: Path, profile_blob: dict) -> dict:
+    model = CostModel.from_json(str(samples_path))
+    fingerprint = profile_blob.get("fingerprint", profile_blob)
+    knob_names = sorted({
+        n for s in model.samples for n in s["knobs"]
+    })
+    default = _default_knobs(knob_names)
+    rec = model.recommend(profile=fingerprint, default_knobs=default)
+    if rec is None:
+        raise SystemExit(f"no samples in {samples_path}")
+    deployment = fingerprint.get("deployment")
+    return {
+        "deployment": deployment,
+        "samples": len(model.samples),
+        "workload_weights": fingerprint.get("class_mix", {}),
+        **rec,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--samples", default=str(DEFAULT_SAMPLES),
+                    help="recorded sample points (knobs+metrics JSON)")
+    ap.add_argument("--profile", default=None,
+                    help="profile fingerprint JSON (default: committed fixture)")
+    ap.add_argument("--deployment", default=None,
+                    help="read the profile from the profile store by key")
+    ap.add_argument("--store", action="store_true",
+                    help="persist the recommendation into the profile store")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the raw recommendation JSON only")
+    args = ap.parse_args(argv)
+
+    blob = _load_profile_blob(args)
+    out = recommend(Path(args.samples), blob)
+
+    if out["violations"]:
+        print("RECOMMENDATION OUT OF BOUNDS:", file=sys.stderr)
+        for v in out["violations"]:
+            print(f"  {v}", file=sys.stderr)
+        return 2
+
+    if args.store:
+        from pilottai_tpu.utils.compile_cache import load_profile, store_profile
+
+        key = out["deployment"] or args.deployment
+        if not key:
+            print("--store needs a deployment key in the profile",
+                  file=sys.stderr)
+            return 2
+        stored = load_profile(key) or {}
+        stored["recommendation"] = {
+            "knobs": out["knobs"], "score": out["score"],
+            "predicted": out["predicted"],
+        }
+        store_profile(key, stored)
+
+    if args.as_json:
+        print(json.dumps(out, indent=2, sort_keys=True))
+        return 0
+
+    print(f"deployment : {out['deployment']}")
+    print(f"samples    : {out['samples']}")
+    if out["workload_weights"]:
+        print(f"class mix  : {out['workload_weights']}")
+    print("recommended knobs:")
+    for k, v in sorted(out["knobs"].items()):
+        dflt = out.get("default_knobs", {}).get(k, "-")
+        marker = "  " if v == dflt else "->"
+        print(f"  {marker} {k:28s} {v!r:>10}   (default {dflt!r})")
+    print("predicted (recommended vs default):")
+    for k, v in sorted(out["predicted"].items()):
+        dv = out.get("default_predicted", {}).get(k)
+        delta = out.get("delta", {}).get(k)
+        if dv is None:
+            print(f"     {k:28s} {v:>10.4f}")
+        else:
+            print(f"     {k:28s} {v:>10.4f}  vs {dv:>10.4f}  "
+                  f"(delta {delta:+.4f})")
+    score = out["score"]
+    print(f"score      : attainment={score['attainment']:.4f} "
+          f"steps_per_s={score['steps_per_s']:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
