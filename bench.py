@@ -360,7 +360,7 @@ async def bench_model(cfg, concurrency, steps, epochs, n_chips=1,
 
 
 async def bench_slo(cfg, rate_rps, duration_s=30.0, n_chips=1, seed=7,
-                    burst_factor=2.0):
+                    burst_factor=2.0, derate=False):
     """Open-loop SLO section (ROADMAP item 5): Poisson arrivals at
     ``rate_rps`` over a multi-tenant mix with a 2x burst through the
     middle fifth of the run. The mix carries the three first-class
@@ -461,6 +461,34 @@ async def bench_slo(cfg, rate_rps, duration_s=30.0, n_chips=1, seed=7,
     for tenant in tenants:
         await asyncio.gather(*[one(tenant, warm=True) for _ in range(2)])
 
+    names = [t[0] for t in tenants]
+    weights = [t[1] for t in tenants]
+    # Headline honesty (ISSUE 19 satellite): BENCH_r07 printed
+    # slo_attainment_interactive 0.0 because the offered rate was set
+    # from the agent-step rate, which overstates what one engine absorbs
+    # on this heavier mix (every arrival decodes 24-48 tokens; RAG pads
+    # 1200 chars) — the section measured unbounded queue growth, not SLO
+    # behavior. With ``derate`` on, a short closed-loop burst over the
+    # same weighted mix measures the mix's own capacity and the offered
+    # rate clamps to 80% of it (the requested rate is still reported as
+    # ``target_rps``). Off by default: AUTOCONF replays this harness per
+    # knob candidate and must offer every candidate the SAME load.
+    measured_capacity_rps = None
+    effective_rate = rate_rps
+    if derate:
+        calib_rng = _random.Random(seed ^ 0x5CA1AB1E)
+        calib_t0 = time.perf_counter()
+        calib_reqs = 0
+        for _ in range(3):
+            wave = calib_rng.choices(tenants, weights=weights, k=4)
+            await asyncio.gather(*[one(t, warm=True) for t in wave])
+            calib_reqs += len(wave)
+        calib_wall = max(time.perf_counter() - calib_t0, 1e-6)
+        measured_capacity_rps = round(calib_reqs / calib_wall, 2)
+        effective_rate = min(
+            rate_rps, max(round(0.8 * measured_capacity_rps, 2), 0.5)
+        )
+
     # Section-pure SLO windows: the warmup's compile-wall misses must
     # not burn this section's budget. requests/missed are cumulative
     # process counters (earlier bench sections feed the same global
@@ -472,8 +500,6 @@ async def bench_slo(cfg, rate_rps, duration_s=30.0, n_chips=1, seed=7,
         for cls in global_slo.classes
     }
 
-    names = [t[0] for t in tenants]
-    weights = [t[1] for t in tenants]
     t_start = time.perf_counter()
     burst_lo = t_start + 0.4 * duration_s
     burst_hi = t_start + 0.6 * duration_s
@@ -483,7 +509,9 @@ async def bench_slo(cfg, rate_rps, duration_s=30.0, n_chips=1, seed=7,
         now = time.perf_counter()
         if now >= t_start + duration_s:
             break
-        rate = rate_rps * (burst_factor if burst_lo <= now < burst_hi else 1.0)
+        rate = effective_rate * (
+            burst_factor if burst_lo <= now < burst_hi else 1.0
+        )
         await asyncio.sleep(rng.expovariate(max(rate, 1e-3)))
         tenant = rng.choices(tenants, weights=weights, k=1)[0]
         offered[tenant[0]] += 1
@@ -515,14 +543,27 @@ async def bench_slo(cfg, rate_rps, duration_s=30.0, n_chips=1, seed=7,
             "missed": int(entry["missed"] - miss0),
             "targets": entry["targets"],
         }
+    completed = outcomes.count("ok")
+    offered_rps = sum(offered.values()) / arrival_wall
+    # Saturation stamp: if completions couldn't keep pace with arrivals
+    # (or the post-arrival drain dwarfs the run), the percentiles above
+    # describe queueing collapse and the attainment headline must be
+    # read with that caveat.
+    saturated = bool(
+        completed / arrival_wall < 0.8 * offered_rps
+        or drain_wall > 0.5 * arrival_wall
+    )
     return {
-        "offered_rps": round(sum(offered.values()) / arrival_wall, 2),
+        "offered_rps": round(offered_rps, 2),
         "target_rps": rate_rps,
+        "derated_rps": effective_rate if derate else None,
+        "measured_capacity_rps": measured_capacity_rps,
+        "saturated": saturated,
         "burst_factor": burst_factor,
         "duration_s": round(arrival_wall, 1),
         "drain_s": round(drain_wall, 1),
         "offered": offered,
-        "completed": outcomes.count("ok"),
+        "completed": completed,
         "shed": outcomes.count("shed"),
         "errors": outcomes.count("error"),
         "classes": per_class,
@@ -1159,6 +1200,229 @@ async def bench_cell(cfg, n_replicas=3, rate_rps=8.0, duration_s=12.0,
             (drain_report or {}).get("migrated_sessions")
         ),
         "classes": classes,
+        "model": cfg.model_name,
+        "n_chips": n_chips,
+    }
+
+
+async def bench_disagg(cfg, rate_rps, prefill_rps, duration_s=6.0,
+                       n_sessions=4, seed=13, n_chips=1):
+    """DISAGG section (ISSUE 19): the same mixed workload — sticky
+    interactive sessions (decode-heavy) plus a stream of long cold RAG
+    prefills — against a 2-replica cell COLOCATED (both mixed) and then
+    DISAGGREGATED (``1p1d``). Each run measures two phases: decode
+    traffic alone (baseline TPOT), then decode traffic with the long
+    prefills running concurrently. The headline is the interference
+    ratio — mixed-phase interactive TPOT p99 over baseline — which
+    disaggregation must hold closer to 1.0 than colocation: the prefill
+    tier absorbs the chunked prefill work, the decode tier restores the
+    handed-off KV and only decodes. Handoff health rides along:
+    ``handoff_success`` ((handoffs - fallbacks) / handoffs) and the
+    ``cell.handoff_ms`` p50/p99.
+
+    Caveat (stamped as ``host_cores`` / ``isolation_measurable``):
+    in-process replicas share the host's cores, so on a single-core
+    CPU host the prefill work steals the decode tier's cycles through
+    the OS scheduler no matter which replica runs it — the interference
+    ratios then read as parity and the measurable claims are handoff
+    health + tier routing; the TPOT separation needs per-replica
+    silicon (accelerator hosts, or a multi-core CPU host).
+
+    TPOT percentiles come from the SLO tracker's flight listener. The
+    1-token prefill legs of handoffs contribute no TPOT sample (TPOT
+    needs a second token), so the interference axis is clean; their
+    TTFT samples do land in the interactive pool, so the disagg run's
+    TTFT p99 reads as the p99 over client requests AND prefill legs —
+    a mild downward dilution, called out here rather than filtered."""
+    import random as _random
+
+    from pilottai_tpu.distributed import ServingCell
+    from pilottai_tpu.engine.handler import LLMHandler
+    from pilottai_tpu.engine.types import GenerationParams
+    from pilottai_tpu.obs import global_slo
+    from pilottai_tpu.reliability import EngineOverloaded
+    from pilottai_tpu.utils.metrics import global_metrics as _gm
+
+    counters = (
+        "cell.handoffs", "cell.handoff_fallbacks", "cell.handoff_rejected",
+        "cell.handoff_tokens", "cell.tier.prefill_routed",
+        "cell.tier.decode_routed", "cell.tier.bypass",
+    )
+
+    async def _run(disagg):
+        cell = ServingCell(
+            [LLMHandler(cfg) for _ in range(2)],
+            cell_disagg="1p1d" if disagg else None,
+        )
+        await cell.start()
+        rng = _random.Random(seed)
+        uid = [0]
+        session_log: dict = {}
+
+        async def decode_turn(k):
+            uid[0] += 1
+            log = session_log.setdefault(k, [
+                f"Session disagg-{k:02d} memory: persona agent-{k}; "
+                + f"context: thread {k} telemetry baseline; " * 3
+            ])
+            log.append(f"turn {len(log)}: user question {uid[0]}")
+            if len(log) > 6:
+                # Bound transcript growth but keep the head line — it
+                # carries the session's routing-table identity.
+                del log[1:len(log) - 5]
+            params = GenerationParams(
+                max_new_tokens=16, temperature=0.0,
+                slo_class="interactive", session_id=f"disagg-sess-{k}",
+            )
+            try:
+                await cell.apredict("\n".join(log), params=params)
+                return "ok"
+            except EngineOverloaded:
+                return "shed"
+            except Exception as exc:  # noqa: BLE001 — harness runs on
+                _note("disagg decode FAILED", {"error": str(exc)[:200]})
+                return "error"
+
+        async def rag_one():
+            uid[0] += 1
+            # Unique per-request body: a shared preamble would go
+            # prefix-hot after the first arrival and bypass the prefill
+            # tier — the section exists to measure the handoff path.
+            seg = f"retrieved shard {uid[0]}: fleet telemetry chunk; "
+            # 420 + suffix + chat-template overhead stays under the
+            # handoff keep-window (engine_max_seq - 1 - max_new_tokens):
+            # a longer body is non-migratable and serves colocated.
+            body = (seg * 12)[:420] + f" summarize incident {uid[0]}."
+            params = GenerationParams(
+                max_new_tokens=8, temperature=0.0, slo_class="batch",
+            )
+            try:
+                await cell.apredict(body, params=params)
+                return "ok"
+            except EngineOverloaded:
+                return "shed"
+            except Exception as exc:  # noqa: BLE001 — harness runs on
+                _note("disagg rag FAILED", {"error": str(exc)[:200]})
+                return "error"
+
+        # Warm: establish every session's pin (first turns hand off on
+        # the disagg run) and compile the decode + RAG prefill shapes.
+        # Seven rounds, not one — transcripts grow until the 6-line
+        # bound and walk through new prefill buckets on the way; a
+        # compile landing inside the baseline phase would dominate its
+        # TPOT p99 (the first topology run pays all compiles for both
+        # otherwise).
+        for _ in range(7):
+            await asyncio.gather(*[decode_turn(k) for k in range(n_sessions)])
+            await rag_one()
+
+        before = {k: _gm.get(k) for k in counters}
+        _gm.reset_histograms("cell.handoff_ms")
+
+        async def phase(with_prefills):
+            global_slo.reset()
+            _gm.reset_histograms("request.")
+            rag_offered = [0]
+            t0 = time.perf_counter()
+            t_end = t0 + duration_s
+            inflight: list = []
+            next_dec = t0
+            next_rag = t0
+            while True:
+                now = time.perf_counter()
+                if now >= t_end:
+                    break
+                while next_dec <= now and next_dec < t_end:
+                    inflight.append(asyncio.create_task(
+                        decode_turn(rng.randrange(n_sessions))
+                    ))
+                    next_dec += rng.expovariate(max(rate_rps, 1e-3))
+                while with_prefills and next_rag <= now and next_rag < t_end:
+                    rag_offered[0] += 1
+                    inflight.append(asyncio.create_task(rag_one()))
+                    next_rag += rng.expovariate(max(prefill_rps, 1e-3))
+                nxt = min(next_dec, next_rag) if with_prefills else next_dec
+                await asyncio.sleep(min(max(nxt - now, 0.0), 0.02))
+            outcomes = await asyncio.gather(*inflight)
+            inter = (global_slo.snapshot() or {}).get("interactive") or {}
+            return {
+                "offered": len(outcomes),
+                "rag_offered": rag_offered[0],
+                "completed": outcomes.count("ok"),
+                "shed": outcomes.count("shed"),
+                "errors": outcomes.count("error"),
+                "ttft_p99_s": inter.get("ttft_p99_s"),
+                "tpot_p50_s": inter.get("tpot_p50_s"),
+                "tpot_p99_s": inter.get("tpot_p99_s"),
+                "e2e_p99_s": inter.get("e2e_p99_s"),
+                "attainment": inter.get("attainment"),
+            }
+
+        base = await phase(False)
+        mixed = await phase(True)
+        delta = {k: _gm.get(k) - before[k] for k in counters}
+        hand_hist = (
+            _gm.snapshot()["histograms"].get("cell.handoff_ms") or {}
+        )
+        await cell.stop()
+        gc.collect()
+
+        tp_base = base.get("tpot_p99_s")
+        tp_mixed = mixed.get("tpot_p99_s")
+        tp50_base = base.get("tpot_p50_s")
+        tp50_mixed = mixed.get("tpot_p50_s")
+        handoffs = int(delta["cell.handoffs"])
+        fallbacks = int(delta["cell.handoff_fallbacks"])
+        return {
+            "topology": "1p1d" if disagg else "colocated",
+            "baseline": base,
+            "mixed": mixed,
+            "tpot_interference": (
+                round(tp_mixed / tp_base, 3)
+                if tp_base and tp_mixed else None
+            ),
+            # p50-based secondary: far fewer samples land in a short
+            # phase's p99 (it degenerates toward the max), so the p50
+            # ratio is the stabler read on a noisy host.
+            "tpot_interference_p50": (
+                round(tp50_mixed / tp50_base, 3)
+                if tp50_base and tp50_mixed else None
+            ),
+            "handoffs": handoffs,
+            "handoff_fallbacks": fallbacks,
+            "handoff_rejected": int(delta["cell.handoff_rejected"]),
+            "handoff_tokens": int(delta["cell.handoff_tokens"]),
+            "handoff_success": (
+                round((handoffs - fallbacks) / handoffs, 4)
+                if handoffs else None
+            ),
+            "handoff_ms_p50": hand_hist.get("p50"),
+            "handoff_ms_p99": hand_hist.get("p99"),
+            "prefill_routed": int(delta["cell.tier.prefill_routed"]),
+            "decode_routed": int(delta["cell.tier.decode_routed"]),
+            "prefix_bypass": int(delta["cell.tier.bypass"]),
+        }
+
+    colocated = await _run(False)
+    disagg = await _run(True)
+    import os as _os
+
+    host_cores = len(_os.sched_getaffinity(0)) if hasattr(
+        _os, "sched_getaffinity") else (_os.cpu_count() or 1)
+    return {
+        "colocated": colocated,
+        "disagg": disagg,
+        "rate_rps": rate_rps,
+        "prefill_rps": prefill_rps,
+        "duration_s": duration_s,
+        # Honesty stamp: in-process replicas timeshare the host's
+        # cores. On a single-core host the compute-isolation half of
+        # disaggregation is physically invisible (both topologies burn
+        # the same core) and the interference ratios read as parity —
+        # the split shows up in handoff health, tier routing and slot
+        # separation; the TPOT win needs per-replica silicon.
+        "host_cores": host_cores,
+        "isolation_measurable": host_cores > 1,
         "model": cfg.model_name,
         "n_chips": n_chips,
     }
@@ -2076,6 +2340,11 @@ async def run_bench():
             rate_rps=round(slo_rate, 1),
             duration_s=30.0 if on_accel else 12.0,
             n_chips=n_chips,
+            # Clamp the offered rate to the mix's own measured capacity
+            # (ISSUE 19 satellite): the r07 headline printed attainment
+            # 0.0 purely from CPU saturation, which the CELL section
+            # then contradicted at 0.958.
+            derate=True,
         )
         _note("slo", sec_slo)
     except Exception as exc:  # noqa: BLE001 — keep earlier sections
@@ -2262,6 +2531,45 @@ async def run_bench():
         _note("autoconf FAILED", {"error": str(exc)})
         sec_autoconf = {"autoconf_error": str(exc)}
 
+    # Section 15: DISAGG (ISSUE 19) — disaggregated prefill/decode
+    # serving: the same sessions+RAG mix against a colocated then a
+    # 1p1d 2-replica cell; interference ratio (mixed-phase interactive
+    # TPOT p99 / decode-only baseline) per topology, plus handoff
+    # success rate and handoff_ms percentiles.
+    sec_disagg = None
+    try:
+        from pilottai_tpu.core.config import ReliabilityConfig
+
+        single_rps = sec_1b["steps_per_sec_per_chip"] * n_chips
+        # Below the knee on purpose: at ~0.5x single-engine rate the
+        # decode stream alone saturates a 2x2-slot cell, the prefill
+        # tier's queue backs up, handoff legs get shed mid-flight and
+        # handoff_ms degenerates into queue wait — measuring overload,
+        # not the handoff. (The SLO/CELL sections own the saturation
+        # story; this one isolates the handoff + interference axes.)
+        disagg_rate = max(1.0, min(0.25 * single_rps, 12.0))
+        sec_disagg = await bench_disagg(
+            LLMConfig(
+                model_name="llama3-1b-byte" if on_accel else "llama-tiny",
+                # Scarce slots: slot occupancy is the interference axis
+                # an in-process cell can demonstrate even where compute
+                # isolation can't be (see bench_disagg's caveat).
+                engine_slots=2, engine_chunk=8,
+                engine_prefix_cache=2,
+                engine_kvcache_host_mb=64,
+                reliability=ReliabilityConfig(max_queue_depth=32),
+                **common,
+            ),
+            rate_rps=round(disagg_rate, 1),
+            prefill_rps=round(max(disagg_rate / 4.0, 0.5), 1),
+            duration_s=10.0 if on_accel else 6.0,
+            n_chips=n_chips,
+        )
+        _note("disagg", sec_disagg)
+    except Exception as exc:  # noqa: BLE001 — keep earlier sections
+        _note("disagg FAILED", {"error": str(exc)})
+        sec_disagg = {"disagg_error": str(exc)}
+
     headline = sec_8b or sec_1b
     out = {
         "metric": "agent_steps_per_sec_per_chip",
@@ -2301,6 +2609,12 @@ async def run_bench():
         "slo_attainment_interactive": (
             (sec_slo.get("classes") or {}).get("interactive", {})
             .get("attainment") if sec_slo else None
+        ),
+        # Honesty caveat (ISSUE 19 satellite): when the SLO section
+        # saturated anyway, the attainment headline above describes
+        # queueing collapse, not serving quality.
+        "slo_saturated": (
+            sec_slo.get("saturated") if sec_slo else None
         ),
         "SLO": sec_slo,
         # Fault-domain headline (ISSUE 9): fraction of fault-interrupted
@@ -2405,6 +2719,27 @@ async def run_bench():
             sec_autoconf.get("forecast_lead_s") if sec_autoconf else None
         ),
         "AUTOCONF": sec_autoconf,
+        # Disaggregated-serving headlines (ISSUE 19): the decode-tier
+        # interference ratio for each topology (disagg must hold closer
+        # to 1.0), handoff success and the handoff wall (full per-phase
+        # breakdown under DISAGG).
+        "disagg_tpot_interference": (
+            (sec_disagg.get("disagg") or {}).get("tpot_interference")
+            if sec_disagg else None
+        ),
+        "colocated_tpot_interference": (
+            (sec_disagg.get("colocated") or {}).get("tpot_interference")
+            if sec_disagg else None
+        ),
+        "disagg_handoff_success": (
+            (sec_disagg.get("disagg") or {}).get("handoff_success")
+            if sec_disagg else None
+        ),
+        "disagg_handoff_ms_p99": (
+            (sec_disagg.get("disagg") or {}).get("handoff_ms_p99")
+            if sec_disagg else None
+        ),
+        "DISAGG": sec_disagg,
         **sec_pipeline,
         **(sec_swarm or {}),
         # Orchestrator-path phase percentiles: traffic since the last
@@ -2443,6 +2778,14 @@ async def run_bench():
         # scalars are what the driver must see).
         "autoconf_attainment_recommended", "autoconf_attainment_default",
         "autoconf_forecast_lead_s",
+        # DISAGG headlines (ISSUE 19): the round's point is the
+        # interference split — both topology ratios, the handoff health
+        # scalars and the (small) DISAGG block ride the tail so the
+        # driver's 2,000-byte window keeps them.
+        "DISAGG",
+        "disagg_tpot_interference", "colocated_tpot_interference",
+        "disagg_handoff_success", "disagg_handoff_ms_p99",
+        "slo_saturated",
         "pipeline_error", "swarm_error", "pipeline_success", "swarm_success",
     ):
         if key in out:

@@ -396,6 +396,31 @@ class LLMConfig(BaseModel):
     # Warm restarts (FaultTolerance respawns, worker redeploys) reuse
     # compiled programs instead of paying minutes of recompilation.
     engine_compile_cache: Optional[str] = None
+    # Disaggregated prefill/decode serving (distributed/cell.py, ISSUE
+    # 19): per-tier replica counts as "<P>p<D>d" (e.g. "1p2d" = one
+    # prefill-tier replica, two decode-tier replicas; replicas past
+    # P+D stay "mixed"). A ServingCell built over handlers with this
+    # config splits its replicas into tiers and moves freshly prefilled
+    # requests to the decode tier via the KV handoff path. None (the
+    # default) keeps every replica "mixed" — the colocated topology, an
+    # exact no-op on routing and output.
+    cell_disagg: Optional[str] = None
+
+    @field_validator("cell_disagg")
+    @classmethod
+    def _valid_cell_disagg(cls, v: Optional[str]) -> Optional[str]:
+        if v is None:
+            return v
+        import re
+
+        spec = v.strip().lower()
+        m = re.fullmatch(r"(\d+)p\+?(\d+)d", spec)
+        if not m or int(m.group(1)) + int(m.group(2)) < 1:
+            raise ValueError(
+                "cell_disagg must be '<P>p<D>d' (e.g. '1p2d'); "
+                f"got {v!r}"
+            )
+        return spec
     seed: int = 0                                    # param init seed when no checkpoint
     # Deadlines, shedding, breaker (reliability/): defaults keep the seed
     # behavior except the breaker, which only changes anything once the

@@ -20,6 +20,7 @@ Two layers live here:
 from pilottai_tpu.distributed.cell import (
     CellReplica,
     ServingCell,
+    parse_disagg_spec,
     session_kv_from_wire,
     session_kv_to_wire,
 )
@@ -48,6 +49,7 @@ __all__ = [
     "RoutingTable",
     "ServeEndpoint",
     "ServingCell",
+    "parse_disagg_spec",
     "route_key",
     "session_kv_from_wire",
     "session_kv_to_wire",

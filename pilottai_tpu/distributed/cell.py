@@ -49,9 +49,11 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import re
 import time
+import uuid
 from collections import OrderedDict
-from typing import Any, Dict, Iterable, List, Optional, Sequence
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -64,7 +66,8 @@ from pilottai_tpu.distributed.router import (
 )
 from pilottai_tpu.engine.handler import LLMHandler
 from pilottai_tpu.engine.kvcache.integrity import KV_FRAME_VERSION
-from pilottai_tpu.obs import DEFAULT_CLASS, SLOTracker
+from pilottai_tpu.engine.types import ChatMessage, GenerationParams, ToolSpec
+from pilottai_tpu.obs import DEFAULT_CLASS, SLOTracker, global_flight
 from pilottai_tpu.reliability import (
     CircuitOpenError,
     DeadlineExceeded,
@@ -74,6 +77,24 @@ from pilottai_tpu.reliability import (
 from pilottai_tpu.reliability.inject import global_injector
 from pilottai_tpu.utils.logging import get_logger
 from pilottai_tpu.utils.metrics import MetricsRegistry, global_metrics
+
+
+class _HandoffUnavailable(Exception):
+    """Internal: this handoff attempt can't complete (nothing cached,
+    frame rejected, target refused) — serve the request colocated."""
+
+
+def parse_disagg_spec(spec: str) -> Tuple[int, int]:
+    """``"<P>p<D>d"`` → ``(prefill_count, decode_count)`` — the
+    ``cell_disagg`` knob's shape (core/config.py validates the same
+    grammar at config time; this is the one parser both share a regex
+    with). Replicas beyond P+D stay ``mixed``."""
+    m = re.fullmatch(r"(\d+)p\+?(\d+)d", str(spec).strip().lower())
+    if m is None:
+        raise ValueError(
+            f"cell_disagg must be '<P>p<D>d' (e.g. '1p2d'); got {spec!r}"
+        )
+    return int(m.group(1)), int(m.group(2))
 
 
 class CellReplica:
@@ -87,9 +108,14 @@ class CellReplica:
         handler: LLMHandler,
         slo_classes=None,
         soft_inflight: Optional[int] = None,
+        tier: str = "mixed",
     ) -> None:
         self.replica_id = replica_id
         self.handler = handler
+        #: disaggregated-serving role (ISSUE 19): "prefill" / "decode" /
+        #: "mixed". Assigned by the cell from ``cell_disagg``; "mixed"
+        #: (every replica, colocated cells) serves both phases.
+        self.tier = tier
         #: Per-replica obs registry: the replica's SLO series live here,
         #: namespaced by object instead of by string prefix — N replicas
         #: in one process can't collide on ``slo.interactive.*``.
@@ -149,6 +175,7 @@ class CellReplica:
             healthy=healthy,
             breaker_open=breaker_open,
             draining=self.draining,
+            tier=self.tier,
         )
 
 
@@ -164,6 +191,15 @@ class ServingCell:
         reroute_attempts: int = 2,
         table_capacity: int = 4096,
         max_sessions: int = 4096,
+        cell_disagg: Optional[str] = None,
+        #: prefix-hot bypass threshold (ISSUE 19): a prompt whose
+        #: routing-table hit covers at least this fraction of its key
+        #: skips the prefill tier — its KV mostly exists already, so a
+        #: handoff would move less than it costs.
+        prefix_hot_frac: float = 0.5,
+        #: prompts with keys shorter than this (bytes) route straight to
+        #: the decode tier: their prefill is too small to interfere.
+        disagg_min_key: int = 64,
     ) -> None:
         self.replicas: Dict[str, CellReplica] = {}
         for i, rep in enumerate(replicas):
@@ -175,6 +211,31 @@ class ServingCell:
         self.router = router if router is not None else ReplicaRouter(
             RoutingTable(capacity=table_capacity)
         )
+        # Disaggregated topology (ISSUE 19): assign tier roles from the
+        # explicit kwarg or the shared config knob. Unset → every
+        # replica stays "mixed" and every disagg branch below is dead
+        # code — the exact-no-op contract of the colocated cell.
+        spec = cell_disagg
+        if spec is None:
+            first_cfg = next(iter(self.replicas.values())).handler.config
+            spec = getattr(first_cfg, "cell_disagg", None)
+        self.prefix_hot_frac = float(prefix_hot_frac)
+        self.disagg_min_key = int(disagg_min_key)
+        self._disagg = False
+        if spec:
+            n_p, n_d = parse_disagg_spec(spec)
+            order = list(self.replicas.values())
+            for rep in order[:n_p]:
+                rep.tier = "prefill"
+            for rep in order[n_p:n_p + n_d]:
+                rep.tier = "decode"
+            # Handoff needs a prefill source AND a distinct target; a
+            # degenerate spec (0 prefill, or prefill-only) keeps the
+            # colocated path.
+            self._disagg = (
+                any(r.tier == "prefill" for r in order)
+                and any(r.tier != "prefill" for r in order)
+            )
         self.reroute_attempts = max(0, int(reroute_attempts))
         #: session id → owning replica id (sticky affinity pins).
         #: Bounded LRU, same rationale as ``HostTier``'s session table:
@@ -301,6 +362,12 @@ class ServingCell:
             float(sum(s.mesh_rung > 0 for s in sigs)),
         )
         global_metrics.set_gauge("cell.sessions", float(len(self.sessions)))
+        if self._disagg:
+            for t in ("prefill", "decode", "mixed"):
+                global_metrics.set_gauge(
+                    f"cell.tier.{t}_replicas",
+                    float(sum(s.tier == t for s in sigs)),
+                )
         lookups = global_metrics.get("cell.affinity_lookups")
         if lookups:
             global_metrics.set_gauge(
@@ -314,12 +381,14 @@ class ServingCell:
         cls: str,
         session_id: Optional[str],
         exclude: List[str],
+        tier: Optional[str] = None,
     ) -> tuple:
         pinned = self.sessions.get(session_id) if session_id else None
         sigs = self.signals()
         try:
             rid, lcp = self.router.pick(
                 key, sigs, slo_class=cls, pinned=pinned, exclude=exclude,
+                tier=tier,
             )
         except CellOverloaded as exc:
             global_metrics.inc(f"cell.shed.{cls}")
@@ -360,6 +429,257 @@ class ServingCell:
             self.sessions.popitem(last=False)
 
     # ------------------------------------------------------------------ #
+    # Disaggregated prefill/decode (ISSUE 19)
+    # ------------------------------------------------------------------ #
+
+    def _disagg_decision(
+        self, key: Sequence[int], sid: Optional[str],
+        gang_id: Optional[str],
+    ) -> str:
+        """Admission policy of the disaggregated cell: ``"handoff"``
+        sends the request through the prefill tier + KV handoff;
+        ``"decode"`` admits it to the decode tier directly. Decode-
+        direct shapes: sticky sessions (their KV lives on the decode
+        tier already), gang members (the DAG scheduler co-schedules a
+        gang on ONE engine's backlog), short prompts (nothing to
+        disaggregate), and prefix-hot prompts — a routing-table hit
+        covering ``prefix_hot_frac`` of the key means most of the
+        prefill is a cache restore wherever it lands."""
+        if sid and sid in self.sessions:
+            return "decode"
+        if gang_id:
+            return "decode"
+        if len(key) < self.disagg_min_key:
+            return "decode"
+        alive = [
+            s.replica_id for s in self.signals()
+            if s.routable() and s.tier != "prefill"
+        ]
+        if alive:
+            _owner, lcp = self.router.table.lookup(key, alive=alive)
+            if lcp >= self.prefix_hot_frac * len(key):
+                global_metrics.inc("cell.tier.bypass")
+                return "decode"
+        return "handoff"
+
+    async def _handoff(
+        self,
+        messages,
+        tools,
+        params: Optional[GenerationParams],
+        json_mode,
+        json_schema,
+        *,
+        cls: str,
+        sid: Optional[str],
+        priority: Optional[int],
+        key: Sequence[int],
+        t0: float,
+    ):
+        """The disaggregated hot path: prefill on the prefill tier,
+        stream the fresh KV to a decode-tier replica over the PR 14
+        checksummed wire frames, then serve the FULL request there in
+        decode-resume mode — admission restores the imported KV
+        (``_PreparedAdmission`` prefix / prefix_paged, a PR 9 host-tier
+        restore), so the decode replica never re-prefills. Greedy output
+        is byte-identical to the colocated path by the KV tier's parity
+        contract.
+
+        Returns ``(response, params)``; ``response is None`` means the
+        caller must serve colocated (empty/ineligible prefill tier, a
+        non-migratable shape, or a failed handoff — ``params`` rides
+        back so a flight the handoff already opened closes on the
+        fallback attempt). Client-semantic failures (deadline, cancel)
+        propagate — a dead budget is dead on every tier."""
+        # Normalize params exactly like the handler would, so the
+        # prompt ids rendered here match both legs' submissions.
+        if params is None:
+            s = self.config.sampling
+            params = GenerationParams(
+                max_new_tokens=s.max_new_tokens, temperature=s.temperature,
+                top_k=s.top_k, top_p=s.top_p, seed=s.seed,
+                json_mode=s.json_mode,
+            )
+        if params.max_new_tokens <= 1:
+            return None, params  # no decode phase to protect
+        sigs = self.signals()
+        try:
+            pre_rid, _ = self.router.pick(
+                key, sigs, slo_class=cls, tier="prefill",
+            )
+        except CellOverloaded:
+            return None, params
+        pre = self.replicas[pre_rid]
+        if pre.tier != "prefill":
+            # The prefill tier is empty/unroutable and pick degraded to
+            # a mixed sibling — that IS the colocated path; a same-
+            # replica "handoff" would only add wire overhead.
+            return None, params
+        render = getattr(pre.handler.backend, "render_request_ids", None)
+        exporter = getattr(pre.handler.backend, "export_request_kv", None)
+        if not callable(render) or not callable(exporter):
+            return None, params  # backend without the engine surface
+        try:
+            # Same coercion as the handler's normalize path — the ids
+            # rendered here must be the ids both legs submit.
+            msgs = [ChatMessage.coerce(m) for m in messages]
+            specs = [
+                t if isinstance(t, ToolSpec) else ToolSpec(**t)
+                for t in (tools or [])
+            ]
+            ids, truncated = render(msgs, specs, params)
+        except Exception:  # noqa: BLE001 — engine not booted etc.
+            return None, params
+        if truncated or not ids:
+            # Non-migratable shape: the keep-window truncation depends
+            # on max_new_tokens, which differs between the legs — the
+            # two would prefill DIFFERENT ids (docs/SERVING.md).
+            return None, params
+        try:
+            dst_rid, _ = self.router.pick(
+                key, sigs, slo_class=cls, exclude=[pre_rid], tier="decode",
+            )
+        except CellOverloaded:
+            return None, params
+        dst = self.replicas[dst_rid]
+        importer = getattr(dst.handler.backend, "import_request_kv", None)
+        if not callable(importer):
+            return None, params
+        # Committed: both legs picked, the shape is migratable. The
+        # client flight opens HERE so its ledger carries the handoff
+        # span; both legs (and any fallback) ride the same id, so the
+        # serving attempt's handler closes it — never a leaked flight.
+        update: Dict[str, Any] = {}
+        if params.flight_id is None:
+            update["flight_id"] = uuid.uuid4().hex[:16]
+        if params.trace_id is None:
+            update["trace_id"] = uuid.uuid4().hex[:16]
+        if update:
+            params = params.model_copy(update=update)
+        fid = params.flight_id
+        global_flight.start(
+            fid, trace_id=params.trace_id, model=self.config.model_name,
+            slo_class=cls, session_id=sid,
+        )
+        global_metrics.inc("cell.handoffs")
+        global_metrics.inc("cell.tier.prefill_routed")
+        h0 = time.perf_counter()
+        loop = asyncio.get_running_loop()
+        try:
+            # Prefill leg: one token on the prefill replica. Its own
+            # flight is deliberately NOT the client's (the client flight
+            # must finish exactly once, on the serving leg); admission
+            # caches the prompt KV (dense panel / pinned page chain), so
+            # the export below finds it. max_new_tokens=1 keeps the
+            # keep-window maximal — ``truncated`` above was checked
+            # against the CLIENT's window, the stricter of the two.
+            pre_params = params.model_copy(update={
+                "max_new_tokens": 1, "flight_id": None,
+            })
+            pre.inflight += 1
+            try:
+                await pre.handler.generate_response(
+                    messages, tools=tools, params=pre_params,
+                    json_mode=json_mode, json_schema=json_schema,
+                    slo_class=cls, session_id=sid, priority=priority,
+                )
+            finally:
+                pre.inflight -= 1
+            global_flight.mark(fid, "handoff")
+            # Export the fresh KV (blocking device→host gathers — off
+            # the event loop) and round-trip the canonical wire frame,
+            # same as migrate_session: the integrity framing is live on
+            # the hot path and ``cell.handoff.corrupt`` has a real
+            # payload to rot.
+            export = await loop.run_in_executor(None, exporter, ids, sid)
+            if not export:
+                raise _HandoffUnavailable("nothing cached to hand off")
+
+            def _wire_roundtrip(exp):
+                # Serialization + checksums over a whole prompt's KV —
+                # executor work, or it would stall every in-flight
+                # request's bookkeeping on the event loop.
+                w = session_kv_to_wire(exp)
+                if global_injector.fire("cell.handoff.corrupt"):
+                    corrupt_wire_payload(w)
+                return session_kv_from_wire(w), len(w.get("entries", ()))
+
+            try:
+                export, _ = await loop.run_in_executor(
+                    None, _wire_roundtrip, export
+                )
+            except ValueError as exc:
+                n = len(export.get("entries", ()))
+                global_metrics.inc(
+                    "engine.kvcache.integrity_failures", n
+                )
+                global_metrics.inc("cell.handoff_rejected", n)
+                raise _HandoffUnavailable(f"frame rejected: {exc}")
+            landed = await loop.run_in_executor(None, importer, export)
+            accepted = int(landed.get("accepted", 0))
+            rejected = int(landed.get("rejected", 0))
+            if rejected:
+                global_metrics.inc("cell.handoff_rejected", rejected)
+            if not accepted:
+                raise _HandoffUnavailable("no entry landed on the target")
+            global_flight.mark(fid, "handoff_done")
+            global_metrics.inc(
+                "cell.handoff_tokens", int(landed.get("tokens", 0))
+            )
+            global_metrics.observe(
+                "cell.handoff_ms", (time.perf_counter() - h0) * 1e3
+            )
+        except (asyncio.CancelledError, DeadlineExceeded):
+            raise
+        except Exception as exc:  # noqa: BLE001 — any leg failure
+            # Prefill replica died mid-handoff, export raced a rebuild,
+            # frame rotted, target rejected: all one outcome — colocated
+            # fallback, full re-execution, byte-identical output. The
+            # open flight rides back on ``params`` and closes there.
+            global_metrics.inc("cell.handoff_fallbacks")
+            self._log.warning(
+                "handoff via %s -> %s fell back to colocated: %s",
+                pre_rid, dst_rid, exc,
+            )
+            return None, params
+        # Decode leg: the FULL original request on the target. Its
+        # admission takes the prefix restore from the imported KV
+        # (lcp = n-1 dense, the page chain paged) — decode resumes with
+        # no re-prefill. Failures here re-route through the caller's
+        # loop like any replica fault.
+        global_metrics.inc("cell.tier.decode_routed")
+        dst.inflight += 1
+        task = asyncio.ensure_future(dst.handler.generate_response(
+            messages, tools=tools, params=params, json_mode=json_mode,
+            json_schema=json_schema, slo_class=cls, session_id=sid,
+            priority=priority,
+        ))
+        dst._calls.add(task)
+        try:
+            response = await task
+        except asyncio.CancelledError:
+            if task in dst._drain_cancelled:
+                dst._drain_cancelled.discard(task)
+                global_metrics.inc("cell.handoff_fallbacks")
+                return None, params
+            task.cancel()
+            raise
+        except DeadlineExceeded:
+            dst.slo.record(cls, ok=False)
+            raise
+        except Exception:
+            dst.slo.record(cls, ok=False)
+            global_metrics.inc("cell.handoff_fallbacks")
+            return None, params
+        finally:
+            dst.inflight -= 1
+            dst._calls.discard(task)
+        dst.slo.record(cls, e2e_s=time.perf_counter() - t0, ok=True)
+        global_metrics.inc(f"cell.routed.{cls}")
+        self._after_success(dst_rid, key, sid)
+        return response, params
+
+    # ------------------------------------------------------------------ #
     # Request execution
     # ------------------------------------------------------------------ #
 
@@ -391,8 +711,23 @@ class ServingCell:
         # attempts the client also waited through, charged to the
         # replica that finally served it.
         t0 = time.perf_counter()
+        tier = None
+        if self._disagg:
+            if self._disagg_decision(key, sid, gang_id) == "handoff":
+                response, params = await self._handoff(
+                    messages, tools, params, json_mode, json_schema,
+                    cls=cls, sid=sid, priority=priority, key=key, t0=t0,
+                )
+                if response is not None:
+                    return response
+                # Colocated fallback: no tier filter — a dead prefill
+                # replica is already excluded by its health signals, and
+                # the decode tier alone may not have the headroom.
+            else:
+                global_metrics.inc("cell.tier.decode_routed")
+                tier = "decode"
         while True:
-            rid, _lcp = self._route(key, cls, sid, excluded)
+            rid, _lcp = self._route(key, cls, sid, excluded, tier=tier)
             rep = self.replicas[rid]
             rep.inflight += 1
             task = asyncio.ensure_future(rep.handler.generate_response(
@@ -481,7 +816,11 @@ class ServingCell:
         )
         sid = session_id or getattr(params, "session_id", None)
         key = route_key(self._route_text(messages))
-        rid, _lcp = self._route(key, cls, sid, [])
+        # Streams are the non-migratable shape (deltas on the wire), so
+        # a disaggregated cell admits them to the decode tier directly.
+        rid, _lcp = self._route(
+            key, cls, sid, [], tier="decode" if self._disagg else None,
+        )
         rep = self.replicas[rid]
         t0 = time.perf_counter()
         rep.inflight += 1
@@ -532,9 +871,16 @@ class ServingCell:
             raise CellOverloaded(
                 "no routable replica to migrate the session to"
             )
+        # Tier preference (disaggregated cells): a migrated session's
+        # next turns are decode traffic — parking its KV on a prefill-
+        # tier replica guarantees a second move. Colocated cells are
+        # all-"mixed", so the extra sort key is a constant there.
         return min(
             candidates,
-            key=lambda s: (s.mesh_rung > 0, s.queue_frac, s.replica_id),
+            key=lambda s: (
+                s.tier == "prefill", s.mesh_rung > 0, s.queue_frac,
+                s.replica_id,
+            ),
         ).replica_id
 
     async def migrate_session(
@@ -763,6 +1109,7 @@ class ServingCell:
             "stalled": sorted(
                 s.replica_id for s in sigs if not s.healthy
             ),
+            "tiers": {s.replica_id: s.tier for s in sigs},
             "per_replica": {s.replica_id: s.to_payload() for s in sigs},
         }
 
@@ -817,7 +1164,10 @@ class ServingCell:
                 "cell.affinity_hit_rate", "cell.rerouted",
                 "cell.migrations", "cell.migrated_tokens",
                 "cell.migrate_rejected", "cell.degraded_replicas",
-                "cell.drains",
+                "cell.drains", "cell.handoffs", "cell.handoff_fallbacks",
+                "cell.handoff_rejected", "cell.handoff_tokens",
+                "cell.tier.bypass", "cell.tier.prefill_routed",
+                "cell.tier.decode_routed",
             )
         }
         for cls in sorted(self._classes):
@@ -924,6 +1274,7 @@ __all__ = [
     "CellReplica",
     "ServingCell",
     "corrupt_wire_payload",
+    "parse_disagg_spec",
     "session_kv_from_wire",
     "session_kv_to_wire",
 ]

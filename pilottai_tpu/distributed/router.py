@@ -72,6 +72,12 @@ class ReplicaSignals:
     healthy: bool = True          # watchdog / EngineHealth verdict
     breaker_open: bool = False
     draining: bool = False
+    #: disaggregated-serving role (ISSUE 19): "prefill" replicas take
+    #: cold long prompts, "decode" replicas take sticky/decode traffic,
+    #: "mixed" (the default — and the ONLY value in a colocated cell)
+    #: serves both. Control-plane heartbeats carry it so remote workers
+    #: are tierable by the same policy as in-process replicas.
+    tier: str = "mixed"
 
     def routable(self) -> bool:
         return self.healthy and not self.draining and not self.breaker_open
@@ -88,6 +94,7 @@ class ReplicaSignals:
             "healthy": self.healthy,
             "breaker_open": self.breaker_open,
             "draining": self.draining,
+            "tier": self.tier,
         }
 
     @classmethod
@@ -105,6 +112,7 @@ class ReplicaSignals:
             healthy=bool(payload.get("healthy", True)),
             breaker_open=bool(payload.get("breaker_open", False)),
             draining=bool(payload.get("draining", False)),
+            tier=str(payload.get("tier", "mixed") or "mixed"),
         )
 
 
@@ -237,6 +245,13 @@ class ReplicaRouter:
         #: itself (reliability/degrade.py SHED_BATCH) — the router skips
         #: it for batch-class work instead of bouncing off its 429.
         batch_shed_level: int = 4,
+        #: sticky affinity wins outright unless the owner is more than
+        #: this much queue_frac above the least-loaded candidate. Before
+        #: this gate existed, a single extra in-flight request
+        #: (1/soft_inflight = 0.125 queue_frac at the default 8) was
+        #: enough for the queue term to steal a session from the replica
+        #: holding its KV — BENCH_r07's CELL affinity_hit_rate of 0.29.
+        affinity_tie_margin: float = 0.25,
     ) -> None:
         self.table = table if table is not None else RoutingTable()
         self.affinity_weight = affinity_weight
@@ -246,6 +261,7 @@ class ReplicaRouter:
         self.mesh_weight = mesh_weight
         self.batch_shed_frac = batch_shed_frac
         self.batch_shed_level = batch_shed_level
+        self.affinity_tie_margin = affinity_tie_margin
         self._rr = 0  # tiebreak rotation
         self._log = get_logger("cell.router")
 
@@ -303,6 +319,7 @@ class ReplicaRouter:
         slo_class: str = "interactive",
         pinned: Optional[str] = None,
         exclude: Optional[Sequence[str]] = None,
+        tier: Optional[str] = None,
     ) -> Tuple[str, int]:
         """Choose a replica for a request with routing key ``key``.
 
@@ -310,7 +327,11 @@ class ReplicaRouter:
         current owner) wins outright while routable and class-admitting
         — sticky sessions are the cheapest affinity there is.
         ``exclude`` removes replicas a retry already failed on.
-        Raises :class:`CellOverloaded` when the class sheds."""
+        ``tier`` (disaggregated cells) restricts candidates to that tier
+        plus "mixed" replicas; an empty tier falls back to ALL
+        class-admitting candidates — disaggregation degrades to the
+        colocated policy, it never sheds. Raises
+        :class:`CellOverloaded` when the class sheds."""
         excluded = set(exclude or ())
         signals = [s for s in signals if s.replica_id not in excluded]
         if not any(s.routable() for s in signals):
@@ -321,11 +342,26 @@ class ReplicaRouter:
                 f"all routable replicas past the {slo_class!r}-class "
                 f"admission threshold; shedding at the cell boundary"
             )
+        if tier is not None:
+            tiered = [s for s in candidates if s.tier in (tier, "mixed")]
+            if tiered:
+                candidates = tiered
         by_id = {s.replica_id: s for s in candidates}
         if pinned is not None and pinned in by_id:
             _, lcp = self.table.lookup(key, alive=[pinned])
             return pinned, lcp
         owner, lcp = self.table.lookup(key, alive=list(by_id))
+        if owner is not None and owner in by_id and lcp > 0:
+            # Affinity wins ties BEFORE the headroom/queue terms get a
+            # vote: stealing a warm session over a fraction of a queue
+            # slot re-prefills the whole prompt elsewhere, which costs
+            # far more than the queue imbalance it "fixes". Only a real
+            # load gap (owner past the least-loaded candidate by more
+            # than the margin) overrides locality.
+            floor = min(c.queue_frac for c in by_id.values())
+            if by_id[owner].queue_frac <= floor + self.affinity_tie_margin:
+                self._rr += 1
+                return owner, lcp
         best_id, best_score = None, None
         order = sorted(by_id)
         for i, rid in enumerate(order):

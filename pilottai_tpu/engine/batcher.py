@@ -1409,6 +1409,41 @@ class ContinuousBatcher:
         with self._lock:
             return self.kvcache.import_session(export)
 
+    def export_request_kv(self, prompt_ids, session_id: Optional[str] = None):
+        """Prefill→decode handoff, source side (ISSUE 19): package the
+        KV a just-prefilled request left in this engine's cache tier —
+        the admission-time dense panel, pinned page chain, or host
+        spills covering the prompt — as the same checksummed wire
+        frames session migration uses. Copy-only (no session pin
+        moves): a failed handoff leaves this replica able to serve the
+        colocated fallback from its own warm cache. Taken under the
+        slot lock so the export overlaps only between device steps,
+        never mid-gather. None when the cache tier is off or holds
+        nothing for this prompt — the caller serves colocated."""
+        if self.kvcache is None:
+            return None
+        with self._lock:
+            return self.kvcache.export_request(
+                tuple(prompt_ids), session_id=session_id
+            )
+
+    def import_request_kv(self, export) -> Dict[str, int]:
+        """Prefill→decode handoff, target side: land the prefilled
+        request's KV in this engine's host tier so admitting the
+        request here restores it (``_PreparedAdmission`` in prefix /
+        prefix_paged mode — decode resumes, no re-prefill). Same
+        integrity gate as session import: a corrupt frame rejects,
+        counts ``engine.kvcache.integrity_failures``, and the request
+        falls back to colocated serving."""
+        if self.kvcache is None or self.kvcache.host is None or not export:
+            return {"accepted": 0, "tokens": 0, "rejected": 0}
+        # Deliberately NOT under the batcher lock: the import only
+        # writes the host tier (which takes its own lock per op), and
+        # holding the admission lock through checksums + array copies
+        # of a whole prompt's KV would stall the decode loop this tier
+        # exists to keep smooth.
+        return self.kvcache.import_session(export)
+
     def saturated(self) -> bool:
         return (
             self.max_queue_depth is not None

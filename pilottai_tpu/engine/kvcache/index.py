@@ -47,6 +47,7 @@ from pilottai_tpu.engine.kvcache.host_tier import HostTier
 from pilottai_tpu.engine.kvcache.integrity import (
     corrupt_arrays,
     entry_header,
+    frame_ok,
     header_matches,
     kv_checksum,
 )
@@ -518,6 +519,40 @@ class KVCacheIndex:
         ids = self.host.lineage(session_id)
         if not ids:
             return None
+        entries = self._export_entries(ids)
+        self.host.drop_session(session_id)
+        return {"session_id": session_id, "ids": list(ids),
+                "entries": entries}
+
+    def export_request(self, ids, *, session_id: Optional[str] = None):
+        """Live-request export for the prefill→decode handoff (ISSUE
+        19): same sealed transfer format as :meth:`export_session`, but
+        keyed by the request's explicit prompt ids rather than a
+        recorded session lineage — a cold prompt that just finished
+        prefill has cached KV (the admission-time dense panel or pinned
+        page chain) without ever being a sticky session. Copy-only in
+        the strictest sense: unlike ``export_session`` no session pin
+        leaves this replica, so a handoff that fails downstream leaves
+        the source able to serve the colocated fallback from its own
+        warm cache. Called under the batcher's slot lock. Returns None
+        when nothing covering ``ids`` is cached (the caller falls back
+        to colocated serving)."""
+        ids = tuple(ids)
+        if not ids:
+            return None
+        entries = self._export_entries(ids)
+        if not entries:
+            return None
+        return {"session_id": session_id, "ids": list(ids),
+                "entries": entries}
+
+    def _export_entries(self, ids) -> List[dict]:
+        """Collect (COPY) every cached span covering a prefix of
+        ``ids``: verified host-tier entries (rot is scrubbed, never
+        shipped), the hot dense prefix panel, and the paged prefix
+        chain gathered from the live pool — each sealed with an
+        integrity frame at pack time. Shared by the session-migration
+        and request-handoff exports; caller holds the slot lock."""
         entries: List[dict] = []
         have: set = set()
 
@@ -539,14 +574,19 @@ class KVCacheIndex:
                 "crc": kv_checksum((k_np, v_np)),
             })
 
-        for e in self.host.prefix_entries(ids):
-            # A host entry that no longer verifies must not migrate —
-            # exporting rot just moves the fault to another replica.
-            if not self._entry_ok(e):
-                self.host.take(e.key)
-                continue
-            arrays = e.copy.wait() if hasattr(e.copy, "wait") else list(e.copy)
-            add(e.key, arrays[0], arrays[1], e.tokens, e.rows, e.meta, e.kind)
+        if self.host is not None:
+            for e in self.host.prefix_entries(ids):
+                # A host entry that no longer verifies must not migrate
+                # — exporting rot just moves the fault to another
+                # replica.
+                if not self._entry_ok(e):
+                    self.host.take(e.key)
+                    continue
+                arrays = (
+                    e.copy.wait() if hasattr(e.copy, "wait") else list(e.copy)
+                )
+                add(e.key, arrays[0], arrays[1], e.tokens, e.rows, e.meta,
+                    e.kind)
         store = self.prefix_store
         if store is not None:
             hot = store.match(ids)
@@ -576,10 +616,8 @@ class KVCacheIndex:
                         continue
                     add(key, ks, vs, self.page_size, self.page_size, b,
                         "page")
-        self.host.drop_session(session_id)
         entries.sort(key=lambda e: len(e["key"]))
-        return {"session_id": session_id, "ids": list(ids),
-                "entries": entries}
+        return entries
 
     def import_session(self, export) -> Dict[str, int]:
         """Accept a session export from another replica: every record
@@ -606,13 +644,8 @@ class KVCacheIndex:
         rejected = 0
         for e in export.get("entries", ()):
             arrays = (np.asarray(e["k"]), np.asarray(e["v"]))
-            crc = e.get("crc")
-            if crc is not None and kv_checksum(arrays) != int(crc):
-                rejected += 1
-                global_metrics.inc("engine.kvcache.integrity_failures")
-                continue
-            header = e.get("header")
-            if header is not None and not header_matches(header, arrays):
+            framed = e.get("crc") is not None or e.get("header") is not None
+            if framed and not frame_ok(e, arrays):
                 rejected += 1
                 global_metrics.inc("engine.kvcache.integrity_failures")
                 continue

@@ -87,6 +87,17 @@ def header_matches(
     return True
 
 
+def frame_ok(entry: Dict[str, Any], arrays: Sequence[Any]) -> bool:
+    """Full frame check for one sealed export entry: CRC over the raw
+    bytes AND the layout header, in that order. The single gate every
+    import path (session migration, prefill→decode handoff) runs before
+    a byte of the payload is interpreted."""
+    crc = entry.get("crc")
+    if crc is None or kv_checksum(arrays) != int(crc):
+        return False
+    return header_matches(entry.get("header"), arrays)
+
+
 def corrupt_arrays(arrays: Sequence[np.ndarray]) -> None:
     """Chaos helper: flip one byte of the first non-empty array IN
     PLACE — the canonical 'host RAM rotted' injection the
@@ -108,5 +119,6 @@ __all__ = [
     "kv_checksum",
     "entry_header",
     "header_matches",
+    "frame_ok",
     "corrupt_arrays",
 ]

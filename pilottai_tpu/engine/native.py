@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import time
-from typing import Any, Dict, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -684,6 +684,47 @@ class NativeEngine(LLMBackend):
         return (
             self.batcher.import_session_kv(export)
             if self.batcher is not None else {"accepted": 0, "tokens": 0}
+        )
+
+    def render_request_ids(
+        self,
+        messages: Sequence[ChatMessage],
+        tools: Optional[Sequence[ToolSpec]],
+        params: GenerationParams,
+    ) -> Tuple[List[int], bool]:
+        """``(prompt_ids, truncated)`` for a request WITHOUT submitting
+        it — the exact token ids ``generate`` would run, plus whether
+        the batcher's keep-window would truncate them. The handoff path
+        (ISSUE 19) needs both: the ids key the KV export, and a
+        truncated prompt is a non-migratable shape — the prefill and
+        decode legs could truncate differently (their ``max_new_tokens``
+        differ by construction), so handoff is gated to prompts that fit
+        whole."""
+        if self.batcher is None:
+            raise RuntimeError("engine not started")
+        ids = list(self._build_request(messages, tools, params).prompt_ids)
+        # Mirror submit()'s keep-window clamp (engine/batcher.py): room
+        # for one generated token, never a non-positive slice.
+        keep = self.batcher.max_seq_len - 1 - params.max_new_tokens
+        keep = min(max(keep, 1), self.batcher.max_seq_len - 2)
+        return ids, len(ids) > keep
+
+    def export_request_kv(self, prompt_ids, session_id=None):
+        """Handoff source (ISSUE 19): a just-prefilled request's KV in
+        the wire transfer format, keyed by its prompt ids (blocking
+        device→host gathers — run off the event loop)."""
+        return (
+            self.batcher.export_request_kv(prompt_ids, session_id)
+            if self.batcher is not None else None
+        )
+
+    def import_request_kv(self, export) -> Dict[str, int]:
+        """Handoff target: land a prefilled request's KV so admission
+        here decode-resumes instead of re-prefilling."""
+        return (
+            self.batcher.import_request_kv(export)
+            if self.batcher is not None
+            else {"accepted": 0, "tokens": 0, "rejected": 0}
         )
 
     def get_metrics(self) -> Dict[str, Any]:

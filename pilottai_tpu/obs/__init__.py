@@ -162,6 +162,21 @@ _gm.declare("cell.degraded_replicas", "gauge")       # serving on sub-mesh
 _gm.declare("cell.migration_ms", "histogram")        # export→import wall
 _gm.declare("cell.drains", "counter")
 _gm.declare("cell.drain_s", "histogram")             # full drain wall
+# Disaggregated prefill/decode serving (ISSUE 19): tier topology +
+# the prefill→decode KV handoff hot path. All read 0 / stay unset in a
+# colocated cell — declared here so the export surface is complete
+# (and export_completeness-clean) whether or not ``cell_disagg`` is on.
+_gm.declare("cell.tier.prefill_replicas", "gauge")
+_gm.declare("cell.tier.decode_replicas", "gauge")
+_gm.declare("cell.tier.mixed_replicas", "gauge")
+_gm.declare("cell.tier.prefill_routed", "counter")   # handoff admissions
+_gm.declare("cell.tier.decode_routed", "counter")    # decode-direct + legs
+_gm.declare("cell.tier.bypass", "counter")           # prefix-hot bypasses
+_gm.declare("cell.handoffs", "counter")              # attempts committed
+_gm.declare("cell.handoff_fallbacks", "counter")     # fell back colocated
+_gm.declare("cell.handoff_rejected", "counter")      # integrity rejections
+_gm.declare("cell.handoff_tokens", "counter")        # KV tokens moved
+_gm.declare("cell.handoff_ms", "histogram")          # prefill-done → landed
 # DAG-aware scheduler (pilottai_tpu/sched/ + the batcher's priority
 # backlog, ROADMAP item 4): declared at boot so the scheduling surface
 # is export_completeness-clean before the first boosted admission.

@@ -446,6 +446,28 @@ class APIServer:
                 from pilottai_tpu.obs import global_slo
 
                 await self._send(writer, 200, global_slo.snapshot())
+        elif path == "/topology.json" and method == "GET":
+            # Disaggregated-serving topology (ISSUE 19): per-replica
+            # tier roles plus the handoff counters — the page the drain
+            # runbook reads before draining a prefill-tier replica
+            # (docs/SERVING.md). A single engine reports itself as one
+            # "mixed" replica so the shape is stable across deployments.
+            from pilottai_tpu.utils.metrics import global_metrics as _gm
+
+            cell_health = getattr(self.handler, "health_snapshot", None)
+            tiers = (
+                cell_health().get("tiers", {}) if callable(cell_health)
+                else {"engine": "mixed"}
+            )
+            await self._send(writer, 200, {
+                "tiers": tiers,
+                "disaggregated": any(t != "mixed" for t in tiers.values()),
+                "handoffs": _gm.get("cell.handoffs"),
+                "handoff_fallbacks": _gm.get("cell.handoff_fallbacks"),
+                "handoff_rejected": _gm.get("cell.handoff_rejected"),
+                "handoff_tokens": _gm.get("cell.handoff_tokens"),
+                "prefix_bypass": _gm.get("cell.tier.bypass"),
+            })
         elif path == "/profile.json" and method == "GET":
             # Workload fingerprint (obs/profile.py): the rolling
             # length/arrival/class-mix shape of this deployment's

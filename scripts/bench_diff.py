@@ -22,7 +22,11 @@ steps/s and MFU up-good, ``weight_bytes*`` / the bytes-per-token
 ratio down-good) and ``AUTOCONF`` (recommended / default knob-vector
 sub-blocks with their per-class breakdowns, plus the forecast-on /
 forecast-off burst sub-blocks: attainment and the measured forecast
-lead up-good, peak burn down-good) blocks, compares numeric
+lead up-good, peak burn down-good) and ``DISAGG`` (colocated /
+disagg topology sub-blocks with their decode-only baseline and
+mixed-workload phase sub-blocks: ttft/tpot percentiles, handoff_ms
+and the interference ratios down-good; handoff_success and
+attainment up-good) blocks, compares numeric
 metrics whose direction it knows (steps/s, MFU, attainment, busy_frac,
 recovered_frac, prefix_hit_rate, affinity_hit_rate,
 prefill_tokens_saved up = good; p50/p99, host_gap, burn_rate,
@@ -95,6 +99,12 @@ LOWER_BETTER = (
     # AUTOCONF section (ISSUE 18): worst interactive burn seen during
     # the scripted burst simulation.
     "peak_burn",
+    # DISAGG section (ISSUE 19): interference ratios (mixed-phase TPOT
+    # over decode-only baseline — disaggregation exists to hold them
+    # down), handoff fallbacks and integrity-rejected frames are cost;
+    # handoff_success already matches "success", handoff_ms_* matches
+    # "_ms", ttft/tpot percentiles match "p50"/"p99".
+    "interference", "fallbacks", "rejected",
 )
 
 
@@ -173,7 +183,7 @@ def _from_tail(tail: str) -> Dict[str, Any]:
     doc: Dict[str, Any] = {}
     remainder = tail
     for block in ("models", "SLO", "phases", "KVCACHE", "CELL", "SCHED",
-                  "MULTICHIP", "QUANT", "CHAOS", "AUTOCONF"):
+                  "MULTICHIP", "QUANT", "CHAOS", "AUTOCONF", "DISAGG"):
         marker = f'"{block}": '
         at = remainder.find(marker)
         if at < 0:
@@ -221,7 +231,7 @@ def _sections(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     for key, value in doc.items():
         if key in ("models", "SLO", "phases", "RECOVERY", "KVCACHE",
                    "CELL", "SCHED", "MULTICHIP", "QUANT", "CHAOS",
-                   "AUTOCONF"):
+                   "AUTOCONF", "DISAGG"):
             continue
         num = _numeric(value)
         if num is not None:
@@ -341,6 +351,32 @@ def _sections(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
                     k: n for k, v in block.items()
                     if (n := _numeric(v)) is not None
                 }
+    disagg = doc.get("DISAGG")
+    if isinstance(disagg, dict):
+        # Section-root scalars (rates, host_cores carries no direction)
+        # plus one sub-block per topology — each with its interference
+        # ratios and handoff health — and each topology's decode-only
+        # baseline / mixed-workload phase sub-blocks (ttft/tpot/e2e
+        # percentiles + attainment, SLO-style).
+        out["disagg"] = {
+            k: n for k, v in disagg.items()
+            if (n := _numeric(v)) is not None
+        }
+        for topo in ("colocated", "disagg"):
+            block = disagg.get(topo)
+            if not isinstance(block, dict):
+                continue
+            out[f"disagg.{topo}"] = {
+                k: n for k, v in block.items()
+                if (n := _numeric(v)) is not None
+            }
+            for phase in ("baseline", "mixed"):
+                pblock = block.get(phase)
+                if isinstance(pblock, dict):
+                    out[f"disagg.{topo}.{phase}"] = {
+                        k: n for k, v in pblock.items()
+                        if (n := _numeric(v)) is not None
+                    }
     for name, block in (doc.get("models") or {}).items():
         if isinstance(block, dict):
             out[f"models.{name}"] = {

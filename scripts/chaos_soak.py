@@ -7,7 +7,8 @@ ladders over the virtual 8-device CPU platform.
 Each round runs a fixed greedy probe wave plus a session turn, injects
 ONE fault drawn from the shuffled deck (shard loss, mid-decode step
 fault, prefill fault, host-RAM rot at spill/restore, migration-frame
-rot, a stuck-dispatch latency blip), and the soak then asserts the
+rot, prefill→decode handoff-frame rot, a stuck-dispatch latency
+blip), and the soak then asserts the
 system-wide invariants the fault domain promises:
 
 * ``recovered_frac == 1.0`` — every non-shed request completed;
@@ -98,6 +99,9 @@ def _build_deck(rng: random.Random):
         ("cell.migrate.corrupt", lambda: inj.arm(
             "cell.migrate.corrupt", value=True, times=1,
         )),
+        ("cell.handoff.corrupt", lambda: inj.arm(
+            "cell.handoff.corrupt", value=True, times=1,
+        )),
         ("engine.dispatch.hang", lambda: inj.arm(
             "engine.dispatch.hang", delay=0.2, times=1,
         )),
@@ -125,7 +129,14 @@ async def soak(seed: int, rounds: int, budget_s: float):
             engine_prefix_cache=1, engine_kvcache_host_mb=64,
         )
 
-    cell = ServingCell([LLMHandler(cfg()) for _ in range(2)])
+    # Disaggregated topology (ISSUE 19): cold long prompts route
+    # through the prefill tier + KV handoff, so the handoff wire frame
+    # is live in the soak and ``cell.handoff.corrupt`` has a real
+    # payload to rot. Short probes go decode-direct; a corrupted or
+    # unavailable handoff falls back colocated — every invariant below
+    # must hold regardless of which path served a request.
+    cell = ServingCell([LLMHandler(cfg()) for _ in range(2)],
+                       cell_disagg="1p1d")
     await cell.start()
     global_injector.reset()
     params = GenerationParams(**GREEDY)
@@ -156,6 +167,7 @@ async def soak(seed: int, rounds: int, budget_s: float):
 
     fails0 = global_metrics.get("engine.kvcache.integrity_failures")
     losses0 = global_metrics.get("engine.shard_losses")
+    handoffs0 = global_metrics.get("cell.handoffs")
 
     reference = await probe_wave()
     if any(isinstance(g, Exception) for g in reference):
@@ -174,6 +186,20 @@ async def soak(seed: int, rounds: int, budget_s: float):
                 continue
             shard_events += 1
         arm()
+        if name == "cell.handoff.corrupt":
+            # A fresh cold long prompt forces a handoff attempt; the
+            # rotted frame must be rejected by the integrity framing
+            # (counted below) and the request served colocated anyway.
+            prompt = (
+                f"cold dossier {i}: "
+                + f"shard {i} telemetry segment; " * 6
+                + "summarize."
+            )
+            try:
+                await cell.apredict(prompt, params=params)
+                results.append("ok")
+            except Exception:  # noqa: BLE001 — scored, not fatal
+                results.append("error")
         if name == "cell.migrate.corrupt" and session_turns:
             sid = rng.choice(sorted(session_turns))
             try:
@@ -284,6 +310,10 @@ async def soak(seed: int, rounds: int, budget_s: float):
         "mesh_rungs": mesh_rungs,
         "corruptions_injected": corrupt_fires,
         "corruptions_detected": int(detected),
+        "handoffs": int(global_metrics.get("cell.handoffs") - handoffs0),
+        "handoff_fallbacks": int(
+            global_metrics.get("cell.handoff_fallbacks")
+        ),
         "stuck_flights": int(stuck),
         "export_completeness": export_complete,
         "injections": injections,

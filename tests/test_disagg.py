@@ -1,0 +1,418 @@
+"""Disaggregated prefill/decode serving (distributed/cell.py, ISSUE 19).
+
+Contracts pinned here:
+
+* ``cell_disagg`` parses ``"<P>p<D>d"`` (config validator and cell
+  parser share the grammar); unset is an EXACT no-op — every replica
+  stays ``mixed``, every handoff counter stays zero and the colocated
+  cell behaves as before;
+* the router's tier filter restricts candidates to ``tier`` + ``mixed``
+  and degrades to the full candidate set when a tier is empty —
+  disaggregation never sheds where colocation would serve;
+* sticky-prefix affinity wins ties BEFORE the headroom/queue terms get
+  a vote (the BENCH_r07 ``cell_affinity_hit_rate == 0.29`` bug): only a
+  queue gap past ``affinity_tie_margin`` overrides locality;
+* greedy output across prefill→handoff→decode is byte-identical to the
+  colocated single-engine run, across dense/paged × spec on/off ×
+  int8/int4 quantization, and the decode replica RESTORED the handed-off
+  KV instead of re-prefilling;
+* a corrupted handoff frame is rejected by the integrity framing and the
+  request falls back colocated, still byte-identical;
+* a prefill replica killed mid-handoff falls back colocated with
+  identical output (recovered_frac == 1.0), and once health marks it
+  unroutable the cell serves on without the prefill tier.
+"""
+
+import asyncio
+
+import pytest
+
+from pilottai_tpu.core.config import LLMConfig
+from pilottai_tpu.distributed import (
+    CellOverloaded,
+    CellReplica,
+    ReplicaRouter,
+    ReplicaSignals,
+    RoutingTable,
+    ServingCell,
+    parse_disagg_spec,
+)
+from pilottai_tpu.engine.handler import LLMHandler
+from pilottai_tpu.engine.types import GenerationParams
+from pilottai_tpu.reliability import global_engine_health
+from pilottai_tpu.utils.metrics import global_metrics
+
+
+# --------------------------------------------------------------------- #
+# Spec parsing + config knob
+# --------------------------------------------------------------------- #
+
+def test_parse_disagg_spec():
+    assert parse_disagg_spec("1p2d") == (1, 2)
+    assert parse_disagg_spec("2P+1D") == (2, 1)
+    assert parse_disagg_spec("  3p3d ") == (3, 3)
+    for bad in ("", "pd", "1p", "2d", "p2d", "1p2d3x", "one-p-two-d"):
+        with pytest.raises(ValueError):
+            parse_disagg_spec(bad)
+
+
+def test_config_knob_validates_and_normalizes():
+    assert LLMConfig(cell_disagg="1p2d").cell_disagg == "1p2d"
+    assert LLMConfig(cell_disagg="2P+1D").cell_disagg == "2p+1d"
+    assert LLMConfig().cell_disagg is None
+    with pytest.raises(Exception):
+        LLMConfig(cell_disagg="two-p-one-d")
+
+
+# --------------------------------------------------------------------- #
+# Router: tier signal + tier filter
+# --------------------------------------------------------------------- #
+
+def _sig(rid, **kw):
+    return ReplicaSignals(replica_id=rid, **kw)
+
+
+def test_signals_tier_payload_roundtrip():
+    s = _sig("a", tier="prefill")
+    back = ReplicaSignals.from_payload(s.to_payload())
+    assert back.tier == "prefill"
+    # Old heartbeat payloads (no tier key) default to "mixed".
+    legacy = s.to_payload()
+    del legacy["tier"]
+    assert ReplicaSignals.from_payload(legacy).tier == "mixed"
+
+
+def test_pick_tier_filter():
+    r = ReplicaRouter()
+    sigs = [
+        _sig("p0", tier="prefill"),
+        _sig("d0", tier="decode"),
+        _sig("m0", tier="mixed"),
+    ]
+    for _ in range(8):
+        rid, _ = r.pick((1, 2, 3), sigs, tier="prefill")
+        assert rid in ("p0", "m0")
+    for _ in range(8):
+        rid, _ = r.pick((4, 5, 6), sigs, tier="decode")
+        assert rid in ("d0", "m0")
+
+
+def test_pick_empty_tier_falls_back_to_all_candidates():
+    r = ReplicaRouter()
+    sigs = [_sig("p0", tier="prefill"), _sig("p1", tier="prefill")]
+    # No decode or mixed replica: the tier filter must degrade to the
+    # colocated policy, not shed.
+    rid, _ = r.pick((1, 2, 3), sigs, tier="decode")
+    assert rid in ("p0", "p1")
+    # ...but unroutable replicas still shed as before.
+    dead = [_sig("p0", tier="prefill", healthy=False)]
+    with pytest.raises(CellOverloaded):
+        r.pick((1, 2, 3), dead, tier="decode")
+
+
+def test_affinity_wins_ties_within_margin():
+    """The BENCH_r07 bug: one extra in-flight request (queue_frac
+    0.125 at the default soft_inflight 8) must NOT steal a warm prefix
+    from its owner. Only a gap past ``affinity_tie_margin`` may."""
+    table = RoutingTable()
+    key = tuple(range(100, 140))
+    table.note(key[:4], "a")  # shallow hit: affinity fraction 0.1
+    r = ReplicaRouter(table)
+    busy_owner = [
+        _sig("a", queue_frac=0.125),  # one in-flight request ahead
+        _sig("b", queue_frac=0.0),
+    ]
+    for _ in range(6):
+        rid, lcp = r.pick(key, busy_owner)
+        assert (rid, lcp) == ("a", 4)
+    # A real load gap (past the margin) still overrides locality.
+    swamped_owner = [
+        _sig("a", queue_frac=1.5),
+        _sig("b", queue_frac=0.0),
+    ]
+    rid, lcp = r.pick(key, swamped_owner)
+    assert (rid, lcp) == ("b", 0)
+
+
+# --------------------------------------------------------------------- #
+# Cell topology (mock provider)
+# --------------------------------------------------------------------- #
+
+def _mock_cell(n=3, **kw):
+    reps = [
+        CellReplica(f"r{i}", LLMHandler(LLMConfig(provider="mock")))
+        for i in range(n)
+    ]
+    return ServingCell(reps, **kw)
+
+
+_HANDOFF_COUNTERS = (
+    "cell.handoffs",
+    "cell.handoff_fallbacks",
+    "cell.handoff_rejected",
+    "cell.handoff_tokens",
+    "cell.tier.prefill_routed",
+    "cell.tier.decode_routed",
+    "cell.tier.bypass",
+)
+
+
+def _counters():
+    return {name: global_metrics.get(name) for name in _HANDOFF_COUNTERS}
+
+
+@pytest.mark.asyncio
+async def test_colocated_cell_is_exact_noop():
+    """No ``cell_disagg`` → no tiers, no handoff counters, no disagg
+    branches: the colocated cell must be indistinguishable from PR 11."""
+    before = _counters()
+    cell = _mock_cell()
+    await cell.start()
+    try:
+        assert not cell._disagg
+        assert all(s.tier == "mixed" for s in cell.signals())
+        for i in range(4):
+            out = await cell.apredict(
+                "please analyze the fleet report, section %d" % i
+            )
+            assert out
+        snap = cell.health_snapshot()
+        assert set(snap["tiers"].values()) == {"mixed"}
+    finally:
+        await cell.stop()
+    assert _counters() == before
+
+
+@pytest.mark.asyncio
+async def test_disagg_tiers_assigned_and_mock_backend_serves_colocated():
+    """``cell_disagg`` splits replicas into tiers; a backend without the
+    handoff surface (mock) early-outs BEFORE committing a handoff and
+    the request is served colocated — no counter moves, no error."""
+    before = _counters()
+    cell = _mock_cell(3, cell_disagg="1p2d")
+    await cell.start()
+    try:
+        assert cell._disagg
+        tiers = [cell.replicas[r].tier for r in sorted(cell.replicas)]
+        assert tiers == ["prefill", "decode", "decode"]
+        snap = cell.health_snapshot()
+        assert sorted(snap["tiers"].values()) == ["decode", "decode", "prefill"]
+        out = await cell.apredict(
+            "a cold prompt long enough to clear the minimum key gate "
+            "for the prefill tier decision path"
+        )
+        assert out
+    finally:
+        await cell.stop()
+    after = _counters()
+    assert after["cell.handoffs"] == before["cell.handoffs"]
+    assert after["cell.handoff_fallbacks"] == before["cell.handoff_fallbacks"]
+
+
+def test_degenerate_specs_stay_colocated():
+    # Prefill-only and decode-only cells cannot hand off.
+    assert not _mock_cell(2, cell_disagg="2p0d")._disagg
+    assert not _mock_cell(2, cell_disagg="0p2d")._disagg
+    assert _mock_cell(2, cell_disagg="1p1d")._disagg
+
+
+@pytest.mark.asyncio
+async def test_short_and_sticky_prompts_route_to_decode_tier():
+    """Short prompts and pinned sessions skip the prefill tier: their
+    prefill is too small (or already owned) to be worth moving."""
+    cell = _mock_cell(2, cell_disagg="1p1d")
+    await cell.start()
+    try:
+        assert cell._disagg_decision((1, 2, 3), None, None) == "decode"
+        cell.sessions["s-1"] = "r1"
+        long_key = tuple(range(200))
+        assert cell._disagg_decision(long_key, "s-1", None) == "decode"
+        assert cell._disagg_decision(long_key, None, "gang-1") == "decode"
+        assert cell._disagg_decision(long_key, None, None) == "handoff"
+    finally:
+        await cell.stop()
+
+
+@pytest.mark.asyncio
+async def test_prefix_hot_prompt_bypasses_prefill_tier():
+    cell = _mock_cell(2, cell_disagg="1p1d")
+    await cell.start()
+    try:
+        key = tuple(range(500, 700))
+        # A decode-tier replica already holds most of this prefix.
+        cell.router.table.note(key[:150], "r1")
+        bypass0 = global_metrics.get("cell.tier.bypass")
+        assert cell._disagg_decision(key, None, None) == "decode"
+        assert global_metrics.get("cell.tier.bypass") == bypass0 + 1
+        # A hit on the PREFILL replica doesn't count: the decode tier
+        # would still have to prefill from scratch.
+        key2 = tuple(range(900, 1100))
+        cell.router.table.note(key2[:150], "r0")
+        assert cell._disagg_decision(key2, None, None) == "handoff"
+    finally:
+        await cell.stop()
+
+
+# --------------------------------------------------------------------- #
+# Engine-level: byte-identical handoff (cpu llama-tiny)
+# --------------------------------------------------------------------- #
+
+GREEDY = dict(max_new_tokens=6, temperature=0.0)
+# Long enough to clear disagg_min_key, short enough to clear the
+# truncation gate (engine_max_seq 256 - 1 - max_new_tokens).
+RAG_PROMPT = (
+    "RAG context: "
+    + "fleet telemetry shows sustained decode pressure on cell nine. " * 2
+    + "question: summarize the incident."
+)
+
+
+def _engine_cfg(**kw):
+    base = dict(
+        model_name="llama-tiny", provider="cpu", dtype="float32",
+        engine_slots=2, engine_max_seq=256, engine_chunk=8,
+        engine_prefix_cache=1, engine_kvcache_host_mb=64,
+    )
+    base.update(kw)
+    return LLMConfig(**base)
+
+
+async def _reference_out(cfg, prompt=RAG_PROMPT):
+    h = LLMHandler(cfg)
+    await h.start()
+    try:
+        return await h.apredict(prompt, params=GenerationParams(**GREEDY))
+    finally:
+        await h.stop()
+
+
+async def _disagg_out(cfg, prompt=RAG_PROMPT):
+    cell = ServingCell(
+        [LLMHandler(cfg) for _ in range(2)], cell_disagg="1p1d"
+    )
+    await cell.start()
+    try:
+        return await cell.apredict(prompt, params=GenerationParams(**GREEDY))
+    finally:
+        await cell.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "paged,speculate,kv_int8,weight_quant",
+    [
+        (False, 0, True, None),
+        (False, 4, False, "int4"),
+        (True, 0, False, "int4"),
+        (True, 4, True, None),
+    ],
+    ids=["dense-kvint8", "dense-spec-int4", "paged-int4", "paged-spec-kvint8"],
+)
+def test_handoff_byte_identity_matrix(paged, speculate, kv_int8, weight_quant):
+    """The ISSUE 19 acceptance bar: greedy output across
+    prefill→handoff→decode matches the colocated single-engine run byte
+    for byte, across dense/paged × spec on/off × int8/int4 — and the
+    decode replica really RESTORED the handed-off KV (a handoff was
+    committed, nothing fell back, prefill work was saved)."""
+    cfg = _engine_cfg(
+        engine_paged_kv=paged,
+        engine_page_size=16,
+        engine_speculate=speculate,
+        engine_kv_quantize="int8" if kv_int8 else None,
+        engine_quant=weight_quant,
+    )
+    ref = asyncio.run(_reference_out(cfg))
+    assert ref  # non-vacuous
+
+    h0 = global_metrics.get("cell.handoffs")
+    f0 = global_metrics.get("cell.handoff_fallbacks")
+    saved0 = global_metrics.get("engine.kvcache.prefill_tokens_saved")
+    out = asyncio.run(_disagg_out(cfg))
+
+    assert out == ref
+    assert global_metrics.get("cell.handoffs") - h0 >= 1
+    assert global_metrics.get("cell.handoff_fallbacks") - f0 == 0
+    assert global_metrics.get("engine.kvcache.prefill_tokens_saved") > saved0
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_handoff_corrupt_frame_falls_back_byte_identical():
+    """A handoff frame corrupted on the wire is caught by the PR 14
+    integrity framing: the import is rejected, the request falls back
+    colocated, and greedy output is still byte-identical."""
+    from pilottai_tpu.reliability.inject import global_injector
+
+    cfg = _engine_cfg()
+    ref = asyncio.run(_reference_out(cfg))
+
+    h0 = global_metrics.get("cell.handoffs")
+    f0 = global_metrics.get("cell.handoff_fallbacks")
+    r0 = global_metrics.get("cell.handoff_rejected")
+    i0 = global_metrics.get("engine.kvcache.integrity_failures")
+    global_injector.arm("cell.handoff.corrupt", value=True, times=1)
+    try:
+        out = asyncio.run(_disagg_out(cfg))
+    finally:
+        global_injector.reset()
+
+    assert out == ref
+    assert global_metrics.get("cell.handoffs") - h0 == 1
+    assert global_metrics.get("cell.handoff_fallbacks") - f0 == 1
+    assert global_metrics.get("cell.handoff_rejected") - r0 >= 1
+    assert global_metrics.get("engine.kvcache.integrity_failures") - i0 >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_kill_prefill_replica_mid_handoff():
+    """Chaos: the prefill replica dies under the prefill leg of a
+    handoff. The request must fall back colocated (recovered_frac ==
+    1.0) with byte-identical output; once health marks the replica
+    unroutable the cell keeps serving without a prefill tier."""
+    cfg = _engine_cfg()
+    ref = asyncio.run(_reference_out(cfg))
+
+    async def _run():
+        cell = ServingCell(
+            [LLMHandler(cfg) for _ in range(2)], cell_disagg="1p1d"
+        )
+        await cell.start()
+        try:
+            pre = next(
+                r for r in cell.replicas.values() if r.tier == "prefill"
+            )
+            h0 = global_metrics.get("cell.handoffs")
+            f0 = global_metrics.get("cell.handoff_fallbacks")
+
+            # Kill: the prefill replica dies after the handoff is
+            # committed — its KV export never comes back.
+            def _dead(*a, **k):
+                raise RuntimeError("replica killed mid-handoff")
+
+            pre.handler.backend.export_request_kv = _dead
+            out = await cell.apredict(RAG_PROMPT,
+                                      params=GenerationParams(**GREEDY))
+            assert out == ref
+            assert global_metrics.get("cell.handoffs") - h0 == 1
+            assert global_metrics.get("cell.handoff_fallbacks") - f0 == 1
+            # Health catches up: the replica is out of the rotation and
+            # the empty prefill tier degrades to colocated serving
+            # without committing doomed handoffs.
+            global_engine_health.mark_stalled(
+                source=pre.health_source, reason="chaos kill",
+                retry_after=60.0,
+            )
+            assert not pre.signals().routable()
+            h1 = global_metrics.get("cell.handoffs")
+            out2 = await cell.apredict(
+                RAG_PROMPT + " and the follow-up question please.",
+                params=GenerationParams(**GREEDY),
+            )
+            assert out2
+            assert global_metrics.get("cell.handoffs") == h1
+        finally:
+            await cell.stop()
+            global_engine_health.reset()
+
+    asyncio.run(_run())

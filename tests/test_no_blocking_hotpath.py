@@ -47,11 +47,14 @@ HOT_PATH = {
 # would re-serialize host and device exactly like one in the batcher.
 # The whole package is scanned; the only sanctioned waits are
 # ``SpillCopy.wait`` (materializes a copy STARTED at spill time — the
-# _HostCopy discipline) and the session-migration export
-# (``export_session`` + its ``add`` closure, ISSUE 11): a control-plane
-# operation the cell runs in an executor, never on the device/prep/
-# reader threads.
-KV_ASARRAY_ALLOWED_FUNCS = {"wait", "export_session", "add"}
+# _HostCopy discipline) and the cross-replica transfer surface
+# (ISSUE 11/19): ``export_session`` / ``_export_entries`` + its ``add``
+# closure, and ``import_session`` landing wire-decoded host arrays —
+# control-plane operations the cell runs in an executor, never on the
+# device/prep/reader threads.
+KV_ASARRAY_ALLOWED_FUNCS = {
+    "wait", "export_session", "_export_entries", "add", "import_session",
+}
 
 # Attribute calls that block the calling thread on the device, in any
 # spelling (``jax.device_get(x)`` and ``x.block_until_ready()`` are both
